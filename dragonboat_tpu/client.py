@@ -1,0 +1,87 @@
+"""Client sessions for exactly-once proposal semantics.
+
+reference: client/session.go [U].  A ``Session`` carries (client_id,
+series_id, responded_to); the RSM's session manager caches the result of
+each (client_id, series_id) so a retried proposal returns the cached result
+instead of re-applying.  ``NoOPSession`` opts out (at-most-once).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+NOOP_SERIES_ID = 0
+SERIES_ID_REGISTER = 0xFFFFFFFFFFFFFFFD
+SERIES_ID_UNREGISTER = 0xFFFFFFFFFFFFFFFC
+SERIES_ID_FIRST_PROPOSAL = 1
+
+_client_id_counter = [0]
+
+
+def _next_client_id() -> int:
+    # Deterministic per-process id allocation; the uniqueness domain is the
+    # shard (ids are registered through the raft log, so collisions across
+    # processes are resolved by the session registry entry itself).
+    import os
+    import time
+
+    _client_id_counter[0] += 1
+    return (
+        ((os.getpid() & 0xFFFF) << 48)
+        | ((int(time.time()) & 0xFFFFFFFF) << 16)
+        | (_client_id_counter[0] & 0xFFFF)
+    )
+
+
+@dataclass
+class Session:
+    shard_id: int = 0
+    client_id: int = 0
+    series_id: int = 0
+    responded_to: int = 0
+
+    @classmethod
+    def new_session(cls, shard_id: int) -> "Session":
+        return cls(
+            shard_id=shard_id,
+            client_id=_next_client_id(),
+            series_id=SERIES_ID_REGISTER,
+        )
+
+    @classmethod
+    def noop(cls, shard_id: int) -> "Session":
+        return cls(shard_id=shard_id, client_id=0, series_id=NOOP_SERIES_ID)
+
+    def is_noop(self) -> bool:
+        return self.client_id == 0 and self.series_id == NOOP_SERIES_ID
+
+    def prepare_for_register(self) -> None:
+        self.series_id = SERIES_ID_REGISTER
+
+    def prepare_for_propose(self) -> None:
+        self.series_id = SERIES_ID_FIRST_PROPOSAL
+        self.responded_to = 0
+
+    def prepare_for_unregister(self) -> None:
+        self.series_id = SERIES_ID_UNREGISTER
+
+    def proposal_completed(self) -> None:
+        """Call after a successful proposal so the server can GC the cached
+        result for the completed series."""
+        if self.series_id in (SERIES_ID_REGISTER, SERIES_ID_UNREGISTER):
+            raise RuntimeError("proposal_completed on a register/unregister session")
+        self.responded_to = self.series_id
+        self.series_id += 1
+
+    def valid_for_proposal(self, shard_id: int) -> bool:
+        if self.shard_id != shard_id:
+            return False
+        if self.is_noop():
+            return True
+        return self.series_id not in (SERIES_ID_REGISTER, SERIES_ID_UNREGISTER) or True
+
+    def valid_for_session_op(self, shard_id: int) -> bool:
+        if self.shard_id != shard_id:
+            return False
+        if self.is_noop():
+            return False
+        return self.series_id in (SERIES_ID_REGISTER, SERIES_ID_UNREGISTER)

@@ -1,0 +1,57 @@
+"""Per-shard idle detection.
+
+reference: quiesce.go (quiesceManager) [U].  After ``threshold`` ticks with
+no activity the shard enters quiesce: no heartbeats are exchanged, ticks
+become a counter increment — which is what lets one NodeHost hold millions
+of idle groups.  Any message or proposal exits quiesce (with a burst of
+LEADER_HEARTBEAT pokes so peers exit too).
+"""
+from __future__ import annotations
+
+from ..pb import Message, MessageType
+
+
+class QuiesceManager:
+    def __init__(self, enabled: bool, election_timeout: int, threshold_mult: int = 10):
+        self.enabled = enabled
+        self.threshold = election_timeout * threshold_mult
+        self.idle_ticks = 0
+        self.quiesced = False
+        self.exit_grace = 0
+
+    def is_quiesced(self) -> bool:
+        return self.quiesced
+
+    def tick(self) -> bool:
+        """Advance one tick; returns True if (now) quiesced."""
+        if not self.enabled:
+            return False
+        self.idle_ticks += 1
+        if self.exit_grace > 0:
+            self.exit_grace -= 1
+            return False
+        if not self.quiesced and self.idle_ticks >= self.threshold:
+            self.quiesced = True
+        return self.quiesced
+
+    def record_activity(self, msg_type: MessageType) -> bool:
+        """Returns True if this activity exits quiesce (caller must then
+        poke peers with LEADER_HEARTBEAT)."""
+        if not self.enabled:
+            return False
+        if msg_type in (MessageType.HEARTBEAT, MessageType.HEARTBEAT_RESP):
+            # heartbeats are not "activity": an idle-but-led group must
+            # still be able to quiesce (reference: quiesceManager [U])
+            if not self.quiesced:
+                return False
+        was = self.quiesced
+        self.idle_ticks = 0
+        if self.quiesced:
+            self.quiesced = False
+            self.exit_grace = self.threshold
+        return was
+
+    def new_to_quiesce(self) -> bool:
+        return (
+            self.enabled and not self.quiesced and self.idle_ticks >= self.threshold
+        )

@@ -1,0 +1,120 @@
+"""In-memory multi-replica harness for pure protocol tests.
+
+Modelled on the etcd-raft test "network" (reference: internal/raft/
+raft_etcd_test.go [U]): N Raft instances wired through an in-memory message
+bus with optional drops/partitions, no I/O, fully deterministic.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from dragonboat_tpu.pb import Entry, Message, MessageType
+from dragonboat_tpu.raft import InMemLogReader, Raft
+from dragonboat_tpu.raft.raft import RaftRole
+
+
+def new_raft(
+    replica_id: int,
+    peers: List[int],
+    election: int = 10,
+    heartbeat: int = 1,
+    check_quorum: bool = False,
+    pre_vote: bool = False,
+    non_votings: Optional[List[int]] = None,
+    witnesses: Optional[List[int]] = None,
+    **kw,
+) -> Raft:
+    return Raft(
+        shard_id=1,
+        replica_id=replica_id,
+        peers={p: f"a{p}" for p in peers},
+        non_votings={p: f"a{p}" for p in (non_votings or [])},
+        witnesses={p: f"a{p}" for p in (witnesses or [])},
+        election_timeout=election,
+        heartbeat_timeout=heartbeat,
+        check_quorum=check_quorum,
+        pre_vote=pre_vote,
+        log_reader=InMemLogReader(),
+        is_non_voting=replica_id in (non_votings or []),
+        is_witness=replica_id in (witnesses or []),
+        **kw,
+    )
+
+
+class Network:
+    def __init__(self, rafts: Dict[int, Optional[Raft]]):
+        self.peers: Dict[int, Raft] = {k: v for k, v in rafts.items() if v}
+        self.dropped: Set[Tuple[int, int]] = set()  # (from, to)
+        self.isolated: Set[int] = set()
+        self.drop_types: Set[MessageType] = set()
+
+    @classmethod
+    def of(cls, n: int, **kw) -> "Network":
+        ids = list(range(1, n + 1))
+        return cls({i: new_raft(i, ids, **kw) for i in ids})
+
+    def cut(self, a: int, b: int) -> None:
+        self.dropped.add((a, b))
+        self.dropped.add((b, a))
+
+    def isolate(self, a: int) -> None:
+        self.isolated.add(a)
+
+    def recover(self) -> None:
+        self.dropped.clear()
+        self.isolated.clear()
+        self.drop_types.clear()
+
+    def _deliverable(self, m: Message) -> bool:
+        if m.type in self.drop_types:
+            return False
+        if m.from_ in self.isolated or m.to in self.isolated:
+            return False
+        return (m.from_, m.to) not in self.dropped
+
+    def send(self, msgs: List[Message]) -> None:
+        """Deliver messages (and all cascading responses) until quiet."""
+        queue = list(msgs)
+        while queue:
+            m = queue.pop(0)
+            target = self.peers.get(m.to)
+            if target is None or not self._deliverable(m):
+                continue
+            target.handle(m)
+            queue.extend(self.drain(target))
+
+    def drain(self, r: Raft) -> List[Message]:
+        out = [m for m in r.drain_messages() if not m.is_local()]
+        return out
+
+    def submit(self, from_id: int, m: Message) -> None:
+        """Inject a local message at a replica and run the network."""
+        r = self.peers[from_id]
+        r.handle(m)
+        self.send(self.drain(r))
+
+    def elect(self, leader_id: int) -> None:
+        self.submit(leader_id, Message(type=MessageType.ELECTION))
+        assert self.peers[leader_id].role == RaftRole.LEADER, (
+            f"replica {leader_id} failed to become leader: "
+            f"{self.peers[leader_id].role}"
+        )
+
+    def propose(self, leader_id: int, cmd: bytes = b"x", **kw) -> None:
+        self.submit(
+            leader_id,
+            Message(type=MessageType.PROPOSE, entries=(Entry(cmd=cmd, **kw),)),
+        )
+
+    def tick_all(self, n: int = 1) -> None:
+        for _ in range(n):
+            for r in self.peers.values():
+                r.handle(Message(type=MessageType.LOCAL_TICK))
+            for r in list(self.peers.values()):
+                self.send(self.drain(r))
+
+    def leader(self) -> Optional[Raft]:
+        for r in self.peers.values():
+            if r.role == RaftRole.LEADER:
+                return r
+        return None

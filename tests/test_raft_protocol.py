@@ -1,0 +1,701 @@
+"""Pure-protocol unit tests — the etcd-style golden suite.
+
+Modelled on the reference's internal/raft/raft_test.go + raft_etcd_test.go
+[U].  These tests define the semantics the vectorized TPU kernel must
+reproduce; test_step_kernel_parity.py fuzzes the kernel against this core.
+"""
+import pytest
+
+from dragonboat_tpu.pb import (
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Message,
+    MessageType,
+    NO_LEADER,
+    SystemCtx,
+)
+from dragonboat_tpu.raft.raft import RaftRole, election_jitter
+from dragonboat_tpu.raft.remote import RemoteState
+
+from raft_harness import Network, new_raft
+
+
+# ---------------------------------------------------------------------------
+# elections
+# ---------------------------------------------------------------------------
+class TestElection:
+    def test_initial_state_is_follower(self):
+        r = new_raft(1, [1, 2, 3])
+        assert r.role == RaftRole.FOLLOWER
+        assert r.term == 0
+        assert r.leader_id == NO_LEADER
+
+    def test_single_replica_becomes_leader_immediately(self):
+        r = new_raft(1, [1])
+        r.handle(Message(type=MessageType.ELECTION))
+        assert r.role == RaftRole.LEADER
+        assert r.term == 1
+        # noop entry appended and committed
+        assert r.log.last_index() == 1
+        assert r.log.committed == 1
+
+    def test_three_replica_election(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        assert l.term == 1
+        assert net.peers[2].role == RaftRole.FOLLOWER
+        assert net.peers[2].leader_id == 1
+        assert net.peers[3].leader_id == 1
+
+    def test_election_timeout_randomized_and_deterministic(self):
+        r1 = new_raft(1, [1, 2, 3])
+        r2 = new_raft(1, [1, 2, 3])
+        # same identity + seq -> identical jitter (replay determinism)
+        assert r1.randomized_election_timeout == r2.randomized_election_timeout
+        assert (
+            r1.election_timeout
+            <= r1.randomized_election_timeout
+            < 2 * r1.election_timeout
+        )
+        vals = {election_jitter(1, 1, s, 10) for s in range(50)}
+        assert len(vals) > 1  # actually varies
+
+    def test_tick_triggers_election(self):
+        net = Network.of(3)
+        r = net.peers[1]
+        for _ in range(r.randomized_election_timeout):
+            r.handle(Message(type=MessageType.LOCAL_TICK))
+        net.send(net.drain(r))
+        assert r.role == RaftRole.LEADER
+
+    def test_vote_rejected_when_log_behind(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1)
+        # isolate 3 so it misses an entry
+        net.isolate(3)
+        net.propose(1)
+        net.recover()
+        # replica 3 campaigns with a stale log: must lose
+        net.submit(3, Message(type=MessageType.ELECTION))
+        assert net.peers[3].role != RaftRole.LEADER
+
+    def test_vote_granted_once_per_term(self):
+        r = new_raft(1, [1, 2, 3])
+        r.handle(
+            Message(type=MessageType.REQUEST_VOTE, from_=2, to=1, term=1)
+        )
+        msgs = r.drain_messages()
+        assert msgs[0].type == MessageType.REQUEST_VOTE_RESP
+        assert not msgs[0].reject
+        assert r.vote == 2
+        # second candidate same term -> reject
+        r.handle(
+            Message(type=MessageType.REQUEST_VOTE, from_=3, to=1, term=1)
+        )
+        msgs = r.drain_messages()
+        assert msgs[0].reject
+
+    def test_duelling_candidates(self):
+        net = Network.of(3)
+        net.cut(1, 3)
+        # both 1 and 3 campaign; 2 votes for whoever asks first
+        net.submit(1, Message(type=MessageType.ELECTION))
+        assert net.peers[1].role == RaftRole.LEADER
+        net.submit(3, Message(type=MessageType.ELECTION))
+        # 3 cannot win (2 already voted for 1 in term 1... but 3 campaigns at
+        # term 2 and 2 grants): either way exactly one leader at the end
+        net.recover()
+        net.tick_all(25)
+        leaders = [r for r in net.peers.values() if r.role == RaftRole.LEADER]
+        assert len(leaders) == 1
+
+    def test_leader_steps_down_on_higher_term(self):
+        net = Network.of(3)
+        net.elect(1)
+        assert net.peers[1].role == RaftRole.LEADER
+        net.peers[1].handle(
+            Message(type=MessageType.REQUEST_VOTE, from_=3, to=1, term=99)
+        )
+        assert net.peers[1].role == RaftRole.FOLLOWER
+        assert net.peers[1].term == 99
+
+    def test_candidate_falls_back_on_replicate(self):
+        r = new_raft(1, [1, 2, 3])
+        r.handle(Message(type=MessageType.ELECTION))
+        assert r.role == RaftRole.CANDIDATE
+        r.drain_messages()
+        r.handle(Message(type=MessageType.REPLICATE, from_=2, to=1, term=r.term))
+        assert r.role == RaftRole.FOLLOWER
+        assert r.leader_id == 2
+
+
+# ---------------------------------------------------------------------------
+# prevote
+# ---------------------------------------------------------------------------
+class TestPreVote:
+    def test_prevote_does_not_bump_term(self):
+        net = Network.of(3, pre_vote=True)
+        r3 = net.peers[3]
+        # isolate 3; its campaigns must not disturb term
+        net.isolate(3)
+        for _ in range(50):
+            r3.handle(Message(type=MessageType.LOCAL_TICK))
+            net.send(net.drain(r3))
+        assert r3.term == 0
+        assert r3.role == RaftRole.PRE_CANDIDATE
+        # now the cluster elects a leader at term 1 — rejoining 3 does not
+        # force an election (the classic partition-rejoin disruption)
+        net.recover()
+        net.elect(1)
+        assert net.peers[1].term == 1
+
+    def test_prevote_then_real_election(self):
+        net = Network.of(3, pre_vote=True)
+        net.elect(1)
+        assert net.peers[1].role == RaftRole.LEADER
+        assert net.peers[1].term == 1
+
+    def test_prevote_rejected_by_leader_lease(self):
+        net = Network.of(3, pre_vote=True, check_quorum=True)
+        net.elect(1)
+        net.propose(1)
+        # 3 tries to campaign while leader is live: followers in lease drop it
+        net.submit(3, Message(type=MessageType.ELECTION))
+        assert net.peers[1].role == RaftRole.LEADER
+        assert net.peers[1].term == 1
+
+
+# ---------------------------------------------------------------------------
+# replication
+# ---------------------------------------------------------------------------
+class TestReplication:
+    def test_basic_commit(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"hello")
+        l = net.peers[1]
+        assert l.log.committed == 2  # noop + proposal
+        for pid in (2, 3):
+            assert net.peers[pid].log.committed == 2
+
+    def test_commit_requires_quorum(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.isolate(2)
+        net.isolate(3)
+        net.propose(1, b"nope")
+        assert net.peers[1].log.committed == 1  # only the noop
+        assert net.peers[1].log.last_index() == 2
+
+    def test_commit_current_term_only(self):
+        """An old-term entry is only committed via a new-term commit
+        (raft paper §5.4.2; reference: raft.tryCommit [U])."""
+        net = Network.of(3)
+        net.elect(1)
+        net.isolate(2)
+        net.isolate(3)
+        net.propose(1, b"old-term")  # index 2, replicated nowhere
+        net.recover()
+        net.isolate(1)
+        net.elect(2)  # term 2
+        l2 = net.peers[2]
+        # entry at index 2 from term 2 (its noop barrier)
+        assert l2.log.committed == 2
+        assert l2.log.term(2) == l2.term
+
+    def test_follower_log_divergence_truncated(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"a")
+        net.isolate(1)
+        # 1 appends entries that never replicate
+        net.propose(1, b"lost1")
+        net.propose(1, b"lost2")
+        assert net.peers[1].log.last_index() == 4
+        net.recover()
+        net.isolate(1)
+        net.elect(2)
+        net.propose(2, b"b")
+        net.recover()
+        # heartbeats bring 1 back in line
+        net.tick_all(3)
+        r1 = net.peers[1]
+        assert r1.role == RaftRole.FOLLOWER
+        l2 = net.peers[2]
+        assert r1.log.last_index() == l2.log.last_index()
+        assert r1.log.committed == l2.log.committed
+        for i in range(1, r1.log.last_index() + 1):
+            assert r1.log.term(i) == l2.log.term(i)
+
+    def test_replicate_resp_advances_match(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        for pid in (2, 3):
+            assert l.remotes[pid].match == 1
+            assert l.remotes[pid].next == 2
+            assert l.remotes[pid].state == RemoteState.REPLICATE
+
+    def test_stale_replicate_acked_with_committed(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        r2 = net.peers[2]
+        r2.handle(
+            Message(
+                type=MessageType.REPLICATE,
+                from_=1,
+                to=2,
+                term=net.peers[1].term,
+                log_index=0,
+                log_term=0,
+                entries=(),
+                commit=0,
+            )
+        )
+        msgs = r2.drain_messages()
+        assert msgs[0].type == MessageType.REPLICATE_RESP
+        assert msgs[0].log_index == r2.log.committed
+
+    def test_proposal_forwarded_by_follower(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(2, b"via-follower")
+        assert net.peers[1].log.committed == 2
+
+    def test_proposal_dropped_without_leader(self):
+        r = new_raft(1, [1, 2, 3])
+        r.handle(
+            Message(type=MessageType.PROPOSE, entries=(Entry(cmd=b"x"),))
+        )
+        de, _ = r.drain_dropped()
+        assert len(de) == 1
+
+    def test_old_term_messages_ignored(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        before = l.log.last_index()
+        l.handle(
+            Message(
+                type=MessageType.REPLICATE,
+                from_=2,
+                to=1,
+                term=0,
+                entries=(Entry(term=0, index=before + 1),),
+            )
+        )
+        assert l.log.last_index() == before
+
+
+# ---------------------------------------------------------------------------
+# check quorum / leader lease
+# ---------------------------------------------------------------------------
+class TestCheckQuorum:
+    def test_leader_steps_down_without_quorum(self):
+        net = Network.of(3, check_quorum=True)
+        net.elect(1)
+        net.isolate(2)
+        net.isolate(3)
+        l = net.peers[1]
+        for _ in range(2 * l.election_timeout + 1):
+            l.handle(Message(type=MessageType.LOCAL_TICK))
+            net.send(net.drain(l))
+        assert l.role == RaftRole.FOLLOWER
+
+    def test_leader_stays_with_quorum(self):
+        net = Network.of(3, check_quorum=True)
+        net.elect(1)
+        net.isolate(3)
+        net.tick_all(25)
+        assert net.peers[1].role == RaftRole.LEADER
+
+    def test_lease_blocks_disruptive_vote(self):
+        net = Network.of(3, check_quorum=True)
+        net.elect(1)
+        net.tick_all(1)  # heartbeats establish recent contact
+        r2 = net.peers[2]
+        r2.handle(
+            Message(type=MessageType.REQUEST_VOTE, from_=3, to=2, term=5)
+        )
+        # in lease: ignored, term unchanged
+        assert r2.term == net.peers[1].term
+        assert not r2.drain_messages()
+
+
+# ---------------------------------------------------------------------------
+# leader transfer
+# ---------------------------------------------------------------------------
+class TestLeaderTransfer:
+    def test_transfer_to_up_to_date_follower(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        net.submit(1, Message(type=MessageType.LEADER_TRANSFER, hint=2))
+        assert net.peers[2].role == RaftRole.LEADER
+        assert net.peers[1].role == RaftRole.FOLLOWER
+        assert net.peers[2].term == net.peers[1].term
+
+    def test_transfer_ignored_for_unknown_target(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.submit(1, Message(type=MessageType.LEADER_TRANSFER, hint=99))
+        assert net.peers[1].role == RaftRole.LEADER
+
+    def test_proposals_dropped_during_transfer(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        net.isolate(2)
+        net.submit(1, Message(type=MessageType.LEADER_TRANSFER, hint=2))
+        assert l.leader_transfer_target == 2
+        l.handle(Message(type=MessageType.PROPOSE, entries=(Entry(cmd=b"x"),)))
+        de, _ = l.drain_dropped()
+        assert len(de) == 1
+
+    def test_transfer_aborts_after_election_timeout(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        net.isolate(2)
+        net.submit(1, Message(type=MessageType.LEADER_TRANSFER, hint=2))
+        for _ in range(l.election_timeout + 1):
+            l.handle(Message(type=MessageType.LOCAL_TICK))
+        assert l.leader_transfer_target == 0
+        assert l.role == RaftRole.LEADER  # still leader, transfer aborted
+
+    def test_transfer_via_follower_forwarded(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        net.submit(3, Message(type=MessageType.LEADER_TRANSFER, hint=2))
+        assert net.peers[2].role == RaftRole.LEADER
+
+
+# ---------------------------------------------------------------------------
+# ReadIndex
+# ---------------------------------------------------------------------------
+class TestReadIndex:
+    def test_leader_read_index_quorum(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        l = net.peers[1]
+        ctx = SystemCtx(low=7, high=9)
+        net.submit(
+            1, Message(type=MessageType.READ_INDEX, hint=7, hint_high=9)
+        )
+        rtr = l.drain_ready_to_reads()
+        assert len(rtr) == 1
+        assert rtr[0].system_ctx == ctx
+        assert rtr[0].index == l.log.committed
+
+    def test_single_node_read_index_immediate(self):
+        r = new_raft(1, [1])
+        r.handle(Message(type=MessageType.ELECTION))
+        r.drain_messages()
+        r.handle(Message(type=MessageType.READ_INDEX, hint=1, hint_high=2))
+        rtr = r.drain_ready_to_reads()
+        assert len(rtr) == 1
+        assert rtr[0].index == r.log.committed
+
+    def test_follower_read_index_forwarded(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        net.submit(
+            2, Message(type=MessageType.READ_INDEX, hint=3, hint_high=4)
+        )
+        rtr = net.peers[2].drain_ready_to_reads()
+        assert len(rtr) == 1
+        assert rtr[0].index == net.peers[1].log.committed
+
+    def test_read_index_dropped_before_first_commit(self):
+        r = new_raft(1, [1, 2, 3])
+        r.handle(Message(type=MessageType.ELECTION))
+        r.drain_messages()
+        r.votes = {1: True, 2: True}
+        r.handle(
+            Message(type=MessageType.REQUEST_VOTE_RESP, from_=2, to=1, term=r.term)
+        )
+        assert r.role == RaftRole.LEADER
+        # noop not yet committed (no acks): read index must be dropped
+        r.drain_messages()
+        r.handle(Message(type=MessageType.READ_INDEX, hint=5, hint_high=6))
+        _, dropped = r.drain_dropped()
+        assert len(dropped) == 1
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+class TestMembership:
+    def test_add_replica(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        l.apply_config_change(
+            ConfigChange(
+                type=ConfigChangeType.ADD_REPLICA, replica_id=4, address="a4"
+            )
+        )
+        assert 4 in l.remotes
+        assert l.quorum() == 3
+
+    def test_remove_replica_advances_commit(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        net.isolate(3)
+        net.propose(1, b"x")  # only 1+2 have it: committed (quorum 2)
+        net.propose(1, b"y")
+        assert l.log.committed == 3
+        l.apply_config_change(
+            ConfigChange(type=ConfigChangeType.REMOVE_REPLICA, replica_id=3)
+        )
+        assert 3 not in l.remotes
+        assert l.quorum() == 2
+
+    def test_pending_config_change_blocks_second(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        e = Entry(type=EntryType.CONFIG_CHANGE, cmd=b"cc1")
+        l.handle(Message(type=MessageType.PROPOSE, entries=(e,)))
+        assert l.pending_config_change
+        e2 = Entry(type=EntryType.CONFIG_CHANGE, cmd=b"cc2")
+        l.handle(Message(type=MessageType.PROPOSE, entries=(e2,)))
+        de, _ = l.drain_dropped()
+        assert len(de) == 1
+
+    def test_promote_non_voting(self):
+        net = Network.of(2)
+        rafts = dict(net.peers)
+        r3 = new_raft(3, [1, 2], non_votings=[3])
+        net.peers[3] = r3
+        for r in rafts.values():
+            r._add_non_voting(3, "a3")
+        net.elect(1)
+        l = net.peers[1]
+        assert l.quorum() == 2
+        net.propose(1, b"x")
+        # non-voting receives entries
+        assert r3.log.last_index() == l.log.last_index()
+        assert r3.role == RaftRole.NON_VOTING
+        # promote
+        for r in net.peers.values():
+            r.apply_config_change(
+                ConfigChange(
+                    type=ConfigChangeType.ADD_REPLICA, replica_id=3, address="a3"
+                )
+            )
+        assert r3.role == RaftRole.FOLLOWER
+        assert net.peers[1].quorum() == 2
+        net.propose(1, b"y")
+        assert r3.log.committed == l.log.committed
+
+
+# ---------------------------------------------------------------------------
+# witness
+# ---------------------------------------------------------------------------
+class TestWitness:
+    def _witness_net(self):
+        rafts = {
+            1: new_raft(1, [1, 2], witnesses=[3]),
+            2: new_raft(2, [1, 2], witnesses=[3]),
+            3: new_raft(3, [1, 2], witnesses=[3]),
+        }
+        return Network(rafts)
+
+    def test_witness_counts_for_quorum(self):
+        net = self._witness_net()
+        net.elect(1)
+        l = net.peers[1]
+        assert l.quorum() == 2
+        net.isolate(2)
+        net.propose(1, b"x")  # 1 + witness 3 = quorum
+        assert l.log.committed == 2
+
+    def test_witness_gets_metadata_entries(self):
+        net = self._witness_net()
+        net.elect(1)
+        net.propose(1, b"secret-payload")
+        w = net.peers[3]
+        assert w.log.last_index() == 2
+        e = w.log._get_entries(2, 3, 2**62)[0]
+        assert e.type == EntryType.METADATA
+        assert e.cmd == b""
+
+    def test_witness_never_campaigns(self):
+        net = self._witness_net()
+        w = net.peers[3]
+        for _ in range(50):
+            w.handle(Message(type=MessageType.LOCAL_TICK))
+        assert w.role == RaftRole.WITNESS
+        assert not [m for m in w.drain_messages() if not m.is_local()]
+
+    def test_witness_votes(self):
+        net = self._witness_net()
+        net.isolate(2)
+        net.elect(1)  # needs witness vote
+        assert net.peers[1].role == RaftRole.LEADER
+
+
+# ---------------------------------------------------------------------------
+# snapshot / compaction interaction with replication
+# ---------------------------------------------------------------------------
+class TestSnapshotReplication:
+    def test_leader_sends_snapshot_for_compacted_follower(self):
+        from dragonboat_tpu.pb import Membership, Snapshot
+
+        net = Network.of(3)
+        net.elect(1)
+        for i in range(5):
+            net.propose(1, b"e%d" % i)
+        l = net.peers[1]
+        # simulate compaction: logdb keeps a snapshot at index 4
+        ss = Snapshot(
+            index=4,
+            term=l.log.term(4),
+            membership=Membership(addresses={1: "a1", 2: "a2", 3: "a3"}),
+        )
+        l.log.logdb.apply_snapshot(ss)
+        l.log.logdb.compact(4)
+        l.log.inmem.applied_log_to(l.log.last_index())
+        # force 3 far behind
+        rm = l.remotes[3]
+        rm.become_retry()
+        rm.next = 2
+        rm.match = 1
+        l.send_replicate(3)
+        msgs = l.drain_messages()
+        assert msgs[0].type == MessageType.INSTALL_SNAPSHOT
+        assert msgs[0].snapshot.index == 4
+        assert rm.state == RemoteState.SNAPSHOT
+
+    def test_follower_restores_from_snapshot(self):
+        from dragonboat_tpu.pb import Membership, Snapshot
+
+        r = new_raft(2, [1, 2, 3])
+        ss = Snapshot(
+            index=10,
+            term=3,
+            membership=Membership(addresses={1: "a1", 2: "a2", 3: "a3"}),
+        )
+        r.handle(
+            Message(
+                type=MessageType.INSTALL_SNAPSHOT, from_=1, to=2, term=3, snapshot=ss
+            )
+        )
+        assert r.log.committed == 10
+        assert r.log.inmem.snapshot.index == 10
+        msgs = r.drain_messages()
+        assert msgs[0].type == MessageType.REPLICATE_RESP
+        assert msgs[0].log_index == 10
+
+    def test_stale_snapshot_rejected(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        from dragonboat_tpu.pb import Snapshot
+
+        r2 = net.peers[2]
+        committed = r2.log.committed
+        ss = Snapshot(index=1, term=1)
+        r2.handle(
+            Message(
+                type=MessageType.INSTALL_SNAPSHOT,
+                from_=1,
+                to=2,
+                term=net.peers[1].term,
+                snapshot=ss,
+            )
+        )
+        msgs = r2.drain_messages()
+        assert msgs[0].log_index == committed
+
+
+# ---------------------------------------------------------------------------
+# flow control / remote states
+# ---------------------------------------------------------------------------
+class TestRemoteFlow:
+    def test_unreachable_backs_off(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        assert l.remotes[2].state == RemoteState.REPLICATE
+        l.handle(Message(type=MessageType.UNREACHABLE, from_=2))
+        assert l.remotes[2].state == RemoteState.RETRY
+
+    def test_heartbeat_resp_resumes_wait(self):
+        net = Network.of(3)
+        net.elect(1)
+        l = net.peers[1]
+        rm = l.remotes[2]
+        rm.become_wait()
+        l.handle(
+            Message(type=MessageType.HEARTBEAT_RESP, from_=2, to=1, term=l.term)
+        )
+        assert rm.state != RemoteState.WAIT
+
+    def test_reject_decrements_next(self):
+        r = new_raft(1, [1, 2])
+        r.handle(Message(type=MessageType.ELECTION))
+        r.drain_messages()
+        r.handle(
+            Message(type=MessageType.REQUEST_VOTE_RESP, from_=2, to=1, term=r.term)
+        )
+        assert r.is_leader()
+        for i in range(4):  # log: noop@1 + entries 2..5
+            r.handle(
+                Message(type=MessageType.PROPOSE, entries=(Entry(cmd=b"x"),))
+            )
+        rm = r.remotes[2]
+        rm.become_retry()
+        rm.next = 5
+        rm.state = RemoteState.WAIT
+        r.drain_messages()
+        r.handle(
+            Message(
+                type=MessageType.REPLICATE_RESP,
+                from_=2,
+                to=1,
+                term=r.term,
+                reject=True,
+                log_index=4,
+                hint=2,
+            )
+        )
+        assert rm.next == 3  # min(rejected=4, hint+1=3)
+
+
+# ---------------------------------------------------------------------------
+# quiesce
+# ---------------------------------------------------------------------------
+class TestQuiesce:
+    def test_enter_and_exit(self):
+        from dragonboat_tpu.raft.quiesce import QuiesceManager
+
+        q = QuiesceManager(enabled=True, election_timeout=10)
+        for _ in range(q.threshold):
+            q.tick()
+        assert q.is_quiesced()
+        assert q.record_activity(MessageType.PROPOSE)  # exits
+        assert not q.is_quiesced()
+        # grace period prevents immediate re-entry
+        q.tick()
+        assert not q.is_quiesced()
+
+    def test_heartbeat_does_not_reset_idle(self):
+        from dragonboat_tpu.raft.quiesce import QuiesceManager
+
+        q = QuiesceManager(enabled=True, election_timeout=10)
+        for _ in range(q.threshold - 1):
+            q.tick()
+            q.record_activity(MessageType.HEARTBEAT)
+        q.tick()
+        assert q.is_quiesced()
