@@ -1,0 +1,85 @@
+"""tpu-raft: a TPU-native multi-group Raft consensus framework.
+
+A brand-new framework with the capabilities of dragonboat
+(awesome-golang/dragonboat, upstream lni/dragonboat): NodeHost hosting
+many independent raft shards, leader election, log replication,
+linearizable reads, client sessions, snapshotting, membership change,
+batched WAL, pluggable transport — with the pure raft step function
+runnable as a vectorized JAX kernel over [groups x peers] state tensors
+sharded across TPU chips.
+"""
+__version__ = "0.1.0"
+
+from .client import Session
+from .config import Config, EngineConfig, ExpertConfig, GossipConfig, NodeHostConfig
+from .nodehost import (
+    NodeHost,
+    NodeHostClosed,
+    RequestDropped,
+    RequestRejected,
+    RequestTerminated,
+    TimeoutError_,
+)
+from .pb import (
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    State,
+    Update,
+)
+from .request import (
+    RequestError,
+    RequestResultCode,
+    RequestState,
+    ShardNotFound,
+    SystemBusy,
+)
+from .statemachine import (
+    IConcurrentStateMachine,
+    IOnDiskStateMachine,
+    IStateMachine,
+    Result,
+    SMEntry,
+    SnapshotStopped,
+)
+
+__all__ = [
+    "Session",
+    "Config",
+    "EngineConfig",
+    "ExpertConfig",
+    "GossipConfig",
+    "NodeHostConfig",
+    "NodeHost",
+    "NodeHostClosed",
+    "RequestDropped",
+    "RequestRejected",
+    "RequestTerminated",
+    "TimeoutError_",
+    "ConfigChange",
+    "ConfigChangeType",
+    "Entry",
+    "EntryType",
+    "Membership",
+    "Message",
+    "MessageType",
+    "Snapshot",
+    "State",
+    "Update",
+    "RequestError",
+    "RequestResultCode",
+    "RequestState",
+    "ShardNotFound",
+    "SystemBusy",
+    "IConcurrentStateMachine",
+    "IOnDiskStateMachine",
+    "IStateMachine",
+    "Result",
+    "SMEntry",
+    "SnapshotStopped",
+]

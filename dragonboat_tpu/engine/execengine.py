@@ -1,0 +1,214 @@
+"""The host execution engine: fixed worker pools over all shards.
+
+reference: engine.go [U].  The shape is the reference's exactly:
+
+  * shards are partitioned by ``shard_id % worker_count``;
+  * each **step worker** drains its ready set, calls ``node.step()`` for
+    each ready shard, then issues ONE batched ``logdb.save_raft_state``
+    for all their Updates (the single-fsync-per-iteration trick), then
+    ``node.process_update`` per shard (send + schedule apply);
+  * **apply workers** drain ``rsm.TaskQueue``s;
+  * ``WorkReady`` is the per-partition ready-set + condition pair so idle
+    shards cost nothing.
+
+This is also the "StepEngineFactory" seam: a vectorized engine replaces
+the per-shard ``node.step()`` loop with one device call over the whole
+partition (see engine/tpu_engine.py).
+"""
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from ..logger import get_logger
+
+if TYPE_CHECKING:
+    from ..node import Node
+
+_log = get_logger("engine")
+
+
+class WorkReady:
+    """Per-partition ready-shard set with wakeup (reference: workReady [U])."""
+
+    def __init__(self, partitions: int):
+        self.partitions = partitions
+        self._sets: List[set] = [set() for _ in range(partitions)]
+        self._conds = [threading.Condition() for _ in range(partitions)]
+
+    def partition(self, shard_id: int) -> int:
+        return shard_id % self.partitions
+
+    def notify(self, shard_id: int) -> None:
+        p = self.partition(shard_id)
+        with self._conds[p]:
+            self._sets[p].add(shard_id)
+            self._conds[p].notify()
+
+    def notify_all(self, shard_ids) -> None:
+        by_p: Dict[int, List[int]] = {}
+        for s in shard_ids:
+            by_p.setdefault(self.partition(s), []).append(s)
+        for p, ids in by_p.items():
+            with self._conds[p]:
+                self._sets[p].update(ids)
+                self._conds[p].notify()
+
+    def wait(self, p: int, timeout: float, stop: threading.Event) -> List[int]:
+        with self._conds[p]:
+            if not self._sets[p] and not stop.is_set():
+                self._conds[p].wait(timeout)
+            out = list(self._sets[p])
+            self._sets[p].clear()
+            return out
+
+    def wake(self) -> None:
+        for c in self._conds:
+            with c:
+                c.notify_all()
+
+
+class IStepEngine(abc.ABC):
+    """The sanctioned plug point (north star: StepEngineFactory beside
+    LogDBFactory/TransportFactory under ExpertConfig)."""
+
+    @abc.abstractmethod
+    def step_shards(self, nodes: List["Node"], worker_id: int) -> None:
+        """Step every node, batch-persist, dispatch."""
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+
+class HostStepEngine(IStepEngine):
+    """Default serial step loop with cross-shard batched WAL writes."""
+
+    def __init__(self, logdb):
+        self.logdb = logdb
+
+    def step_shards(self, nodes: List["Node"], worker_id: int) -> None:
+        updates = []
+        stepped = []
+        for node in nodes:
+            u = node.step()
+            if u is not None:
+                updates.append(u)
+                stepped.append((node, u))
+        if not updates:
+            return
+        # one batched fsync for every shard stepped this iteration
+        self.logdb.save_raft_state(updates, worker_id)
+        for node, u in stepped:
+            if node.process_update(u):
+                node.engine_apply_ready(node.shard_id)  # type: ignore[attr-defined]
+
+
+class ExecEngine:
+    def __init__(
+        self,
+        logdb,
+        step_workers: int = 16,
+        apply_workers: int = 16,
+        step_engine: Optional[IStepEngine] = None,
+    ):
+        self.logdb = logdb
+        self.step_ready = WorkReady(step_workers)
+        self.apply_ready = WorkReady(apply_workers)
+        self.step_engine = step_engine or HostStepEngine(logdb)
+        self._nodes: Dict[int, "Node"] = {}  # shard_id -> node
+        self._nodes_lock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        for i in range(step_workers):
+            t = threading.Thread(
+                target=self._step_worker_main,
+                args=(i,),
+                daemon=True,
+                name=f"tpu-raft-step-{i}",
+            )
+            self._threads.append(t)
+        for i in range(apply_workers):
+            t = threading.Thread(
+                target=self._apply_worker_main,
+                args=(i,),
+                daemon=True,
+                name=f"tpu-raft-apply-{i}",
+            )
+            self._threads.append(t)
+
+    def start(self) -> None:
+        self.step_engine.start()
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.step_ready.wake()
+        self.apply_ready.wake()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.step_engine.stop()
+
+    # -- registration -----------------------------------------------------
+    def register(self, node: "Node") -> None:
+        with self._nodes_lock:
+            self._nodes[node.shard_id] = node
+        node.notify_work = lambda s=node.shard_id: self.step_ready.notify(s)
+        node.engine_apply_ready = lambda s: self.apply_ready.notify(s)
+        self.step_ready.notify(node.shard_id)
+
+    def unregister(self, shard_id: int) -> None:
+        with self._nodes_lock:
+            self._nodes.pop(shard_id, None)
+
+    def nodes_for_partition(self, shard_ids: List[int]) -> List["Node"]:
+        with self._nodes_lock:
+            return [
+                self._nodes[s]
+                for s in shard_ids
+                if s in self._nodes and not self._nodes[s].stopped
+            ]
+
+    def notify(self, shard_id: int) -> None:
+        self.step_ready.notify(shard_id)
+
+    def notify_many(self, shard_ids) -> None:
+        self.step_ready.notify_all(shard_ids)
+
+    # -- workers ----------------------------------------------------------
+    def _step_worker_main(self, worker_id: int) -> None:
+        while not self._stop.is_set():
+            ready = self.step_ready.wait(worker_id, timeout=0.1, stop=self._stop)
+            if self._stop.is_set():
+                return
+            nodes = self.nodes_for_partition(ready)
+            if not nodes:
+                continue
+            try:
+                self.step_engine.step_shards(nodes, worker_id)
+            except Exception:  # noqa: BLE001
+                _log.exception("step worker %d failed", worker_id)
+            # shards with remaining work re-arm immediately
+            for n in nodes:
+                if n.has_work():
+                    self.step_ready.notify(n.shard_id)
+
+    def _apply_worker_main(self, worker_id: int) -> None:
+        while not self._stop.is_set():
+            ready = self.apply_ready.wait(worker_id, timeout=0.1, stop=self._stop)
+            if self._stop.is_set():
+                return
+            with self._nodes_lock:
+                nodes = [self._nodes[s] for s in ready if s in self._nodes]
+            for node in nodes:
+                try:
+                    node.apply()
+                except Exception:  # noqa: BLE001
+                    _log.exception(
+                        "apply worker %d shard %d failed", worker_id, node.shard_id
+                    )
+                # applying may have unblocked step work (e.g. config change)
+                if node.has_work():
+                    self.step_ready.notify(node.shard_id)

@@ -1,0 +1,77 @@
+"""Non-blocking fan-out of raft/system events to user listeners.
+
+reference: event.go [U].  Listener callbacks run on a dedicated thread so
+a slow listener can never stall the step loop; the queue is bounded and
+drops (with a log line) under pressure, as the reference does.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from .logger import get_logger
+from .raftio import IRaftEventListener, ISystemEventListener, LeaderInfo
+
+_log = get_logger("nodehost")
+
+
+class EventFanout(ISystemEventListener):
+    def __init__(
+        self,
+        raft_listener: Optional[IRaftEventListener] = None,
+        system_listener: Optional[ISystemEventListener] = None,
+        maxsize: int = 4096,
+    ):
+        self.raft_listener = raft_listener
+        self.system_listener = system_listener
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._main, daemon=True, name="tpu-raft-events"
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        self._thread.join(timeout=1.0)
+
+    def _main(self) -> None:
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — listener bugs must not kill us
+                _log.exception("event listener raised")
+
+    def _post(self, fn, *args) -> None:
+        try:
+            self._q.put_nowait((fn, args))
+        except queue.Full:
+            _log.warning("event queue full, dropping event")
+
+    # -- raft events ------------------------------------------------------
+    def leader_updated(self, info: LeaderInfo) -> None:
+        if self.raft_listener is not None:
+            self._post(self.raft_listener.leader_updated, info)
+
+    # -- system events ----------------------------------------------------
+    def __getattr__(self, name):
+        # forward any ISystemEventListener callback asynchronously
+        if name.startswith("_"):
+            raise AttributeError(name)
+        base = getattr(ISystemEventListener, name, None)
+        if base is None:
+            raise AttributeError(name)
+
+        def forward(*args):
+            if self.system_listener is not None:
+                target = getattr(self.system_listener, name, None)
+                if target is not None:
+                    self._post(target, *args)
+
+        return forward

@@ -1,0 +1,545 @@
+"""Per-(shard, replica) node: binds the pure raft peer to queues, the RSM,
+the LogDB and the transport.
+
+reference: node.go [U].  Threading contract (same as the reference's):
+``step()``/``process_update()`` run only on the one step worker that owns
+this shard; ``apply()`` only on its apply worker; public-API threads touch
+only the thread-safe queues and pending tables.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .client import Session
+from .config import Config
+from .logger import get_logger
+from .pb import (
+    Bootstrap,
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    State,
+    SystemCtx,
+    Update,
+)
+from .raft.peer import Peer
+from .raft.quiesce import QuiesceManager
+from .request import (
+    PendingConfigChange,
+    PendingLeaderTransfer,
+    PendingProposal,
+    PendingReadIndex,
+    PendingSnapshot,
+    RequestState,
+)
+from .rsm.managed import wrap_state_machine
+from .rsm.statemachine import ApplyResult, StateMachine, Task, TaskType
+from .statemachine import Result
+from .storage.logdb import LogDBLogReader
+
+_log = get_logger("nodehost")
+
+
+class Node:
+    def __init__(
+        self,
+        config: Config,
+        initial_members: Dict[int, str],
+        join: bool,
+        sm_factory: Callable,
+        logdb,
+        snapshot_storage,
+        transport,
+        on_leader_updated: Optional[Callable] = None,
+        event_listener=None,
+        registry=None,
+    ):
+        self.config = config
+        self.shard_id = config.shard_id
+        self.replica_id = config.replica_id
+        self.logdb = logdb
+        self.snapshot_storage = snapshot_storage
+        self.transport = transport
+        self.on_leader_updated = on_leader_updated
+        self.events = event_listener
+        self.registry = registry
+
+        # --- queues (thread-safe inputs to step) -------------------------
+        self._qlock = threading.Lock()
+        self._received: deque = deque()
+        self._proposals: deque = deque()  # Entry
+        self._read_indexes: deque = deque()  # SystemCtx
+        self._config_changes: deque = deque()  # (key, ConfigChange)
+        self._cc_to_apply: deque = deque()  # (ConfigChange|None, accepted)
+        self._snapshot_reqs: deque = deque()  # (key, overhead)
+        self._leader_transfers: deque = deque()  # target
+        self._pending_ticks = 0
+
+        # --- pending futures --------------------------------------------
+        key_base = config.replica_id << 48
+        self.pending_proposal = PendingProposal()
+        self.pending_proposal._next_key = key_base
+        self.pending_read_index = PendingReadIndex()
+        self.pending_read_index._next_key = key_base
+        self.pending_config_change = PendingConfigChange()
+        self.pending_config_change._next_key = key_base
+        self.pending_snapshot = PendingSnapshot()
+        self.pending_leader_transfer = PendingLeaderTransfer()
+
+        self.tick_count = 0
+        self.leader_id = 0
+        self.stopped = False
+        self._snapshotting = False
+        self._applied_since_snapshot = 0
+        # set by the engine at registration; wakes the owning step worker
+        self.notify_work: Optional[Callable[[], None]] = None
+
+        # --- storage views ----------------------------------------------
+        bootstrap = logdb.get_bootstrap_info(config.shard_id, config.replica_id)
+        new_node = bootstrap is None
+        if new_node:
+            members = {} if join else dict(initial_members)
+            logdb.save_bootstrap_info(
+                config.shard_id,
+                config.replica_id,
+                Bootstrap(addresses=members, join=join),
+            )
+        else:
+            members = dict(bootstrap.addresses)
+
+        self.log_reader, saved_state = LogDBLogReader.from_existing(
+            config.shard_id, config.replica_id, logdb
+        )
+        ss = logdb.get_snapshot(config.shard_id, config.replica_id)
+
+        # --- RSM ---------------------------------------------------------
+        managed = wrap_state_machine(sm_factory(config.shard_id, config.replica_id))
+        self.sm = StateMachine(
+            config.shard_id,
+            config.replica_id,
+            managed,
+            ordered_config_change=config.ordered_config_change,
+            is_witness=config.is_witness,
+        )
+        self._stop_event = threading.Event()
+        self.sm.open(self._stop_event)
+
+        membership: Optional[Membership] = None
+        if not ss.is_empty():
+            if not ss.dummy and not config.is_witness:
+                payload = snapshot_storage.load(ss.filepath)
+                self.sm.recover_from_snapshot_data(payload)
+            else:
+                self.sm.last_applied = max(self.sm.last_applied, ss.index)
+            membership = ss.membership
+        if membership is None:
+            # initial_members are always voters; non-voting/witness replicas
+            # enter via config change or join an existing shard
+            self.sm.set_initial_membership(dict(members))
+            membership = self.sm.get_membership()
+        else:
+            self.sm.members.restore(membership)
+        self._sync_registry(membership)
+
+        # --- raft peer ---------------------------------------------------
+        self.peer = Peer.launch(
+            config,
+            self.log_reader,
+            saved_state,
+            dict(membership.addresses),
+            non_votings=dict(membership.non_votings),
+            witnesses=dict(membership.witnesses),
+        )
+        self.quiesce = QuiesceManager(
+            enabled=config.quiesce, election_timeout=config.election_rtt
+        )
+
+    # ------------------------------------------------------------------
+    # public-API-side entry points (any thread)
+    # ------------------------------------------------------------------
+    def add_tick(self) -> None:
+        with self._qlock:
+            self._pending_ticks += 1
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout_ticks: int
+    ) -> RequestState:
+        entry, rs = self.pending_proposal.propose(
+            session, cmd, self.tick_count + timeout_ticks
+        )
+        with self._qlock:
+            self._proposals.append(entry)
+        return rs
+
+    def propose_session_op(self, session: Session, timeout_ticks: int) -> RequestState:
+        entry, rs = self.pending_proposal.propose(
+            session, b"", self.tick_count + timeout_ticks
+        )
+        with self._qlock:
+            self._proposals.append(entry)
+        return rs
+
+    def read_index(self, timeout_ticks: int) -> RequestState:
+        ctx, rs = self.pending_read_index.read(self.tick_count + timeout_ticks)
+        with self._qlock:
+            self._read_indexes.append(ctx)
+        return rs
+
+    def request_config_change(
+        self, cc: ConfigChange, timeout_ticks: int
+    ) -> RequestState:
+        key, rs = self.pending_config_change.request(
+            cc, self.tick_count + timeout_ticks
+        )
+        with self._qlock:
+            self._config_changes.append((key, cc))
+        return rs
+
+    def request_snapshot(self, overhead: int, timeout_ticks: int) -> RequestState:
+        rs = self.pending_snapshot.request(self.tick_count + timeout_ticks)
+        with self._qlock:
+            self._snapshot_reqs.append((rs.key, overhead))
+        return rs
+
+    def request_leader_transfer(self, target: int, timeout_ticks: int) -> RequestState:
+        rs = self.pending_leader_transfer.request(
+            target, self.tick_count + timeout_ticks
+        )
+        with self._qlock:
+            self._leader_transfers.append(target)
+        return rs
+
+    def enqueue_received(self, m: Message) -> None:
+        with self._qlock:
+            self._received.append(m)
+
+    def enqueue_config_change_result(self, cc, accepted: bool) -> None:
+        """Called from the apply worker; consumed by step (single-writer
+        raft rule)."""
+        with self._qlock:
+            self._cc_to_apply.append((cc, accepted))
+
+    def has_work(self) -> bool:
+        with self._qlock:
+            if (
+                self._received
+                or self._proposals
+                or self._read_indexes
+                or self._config_changes
+                or self._cc_to_apply
+                or self._snapshot_reqs
+                or self._leader_transfers
+                or self._pending_ticks
+            ):
+                return True
+        return self.peer.has_update()
+
+    # ------------------------------------------------------------------
+    # step path (owning step worker only)
+    # ------------------------------------------------------------------
+    def step(self) -> Optional[Update]:
+        """Drain inputs into the raft peer and produce this shard's Update
+        (reference: node.stepNode [U])."""
+        if self.stopped:
+            return None
+        with self._qlock:
+            received = list(self._received)
+            self._received.clear()
+            proposals = list(self._proposals)
+            self._proposals.clear()
+            read_indexes = list(self._read_indexes)
+            self._read_indexes.clear()
+            config_changes = list(self._config_changes)
+            self._config_changes.clear()
+            cc_results = list(self._cc_to_apply)
+            self._cc_to_apply.clear()
+            transfers = list(self._leader_transfers)
+            self._leader_transfers.clear()
+            snapshot_reqs = list(self._snapshot_reqs)
+            self._snapshot_reqs.clear()
+            ticks = self._pending_ticks
+            self._pending_ticks = 0
+
+        # config-change application results from the apply loop
+        for cc, accepted in cc_results:
+            if accepted and cc is not None:
+                self.peer.apply_config_change(cc)
+            else:
+                self.peer.reject_config_change()
+
+        # activity-based quiesce exit
+        if self.quiesce.enabled:
+            for m in received:
+                if self.quiesce.record_activity(m.type):
+                    self._poke_peers_out_of_quiesce()
+            if proposals or read_indexes or config_changes or transfers:
+                if self.quiesce.record_activity(MessageType.PROPOSE):
+                    self._poke_peers_out_of_quiesce()
+
+        for m in received:
+            self.peer.handle(m)
+
+        if proposals:
+            self.peer.propose_entries(proposals)
+        for key, cc in config_changes:
+            entry = Entry(
+                type=EntryType.CONFIG_CHANGE, key=key, cmd=pickle.dumps(cc)
+            )
+            self.peer.raft.handle(
+                Message(type=MessageType.PROPOSE, entries=(entry,))
+            )
+        for ctx in read_indexes:
+            self.peer.read_index(ctx)
+        for target in transfers:
+            self.peer.request_leader_transfer(target)
+        for key, overhead in snapshot_reqs:
+            self._save_snapshot_request(key, overhead)
+
+        for _ in range(ticks):
+            self.tick_count += 1
+            if self.quiesce.tick():
+                self.peer.quiesced_tick()
+            else:
+                self.peer.tick()
+            # tick-driven GC of timed-out futures
+            self.pending_proposal.gc(self.tick_count)
+            self.pending_read_index.gc(self.tick_count)
+            self.pending_config_change.gc(self.tick_count)
+            self.pending_snapshot.gc(self.tick_count)
+            self.pending_leader_transfer.gc(self.tick_count)
+
+        self._check_leader_change()
+
+        if not self.peer.has_update():
+            return None
+        u = self.peer.get_update(last_applied=self.sm.last_applied)
+        for e in u.dropped_entries:
+            # route by entry kind: proposal and config-change futures live
+            # in different tables with independent key spaces
+            if e.type == EntryType.CONFIG_CHANGE:
+                self.pending_config_change.applied(e.key, rejected=True)
+            else:
+                self.pending_proposal.dropped(e.key)
+        for ctx in u.dropped_read_indexes:
+            self.pending_read_index.dropped(ctx)
+        return u
+
+    def _sync_registry(self, membership: Membership) -> None:
+        """Every replica (not just the API caller) must be able to resolve
+        every member's address."""
+        if self.registry is None:
+            return
+        for group in (
+            membership.addresses,
+            membership.non_votings,
+            membership.witnesses,
+        ):
+            for pid, addr in group.items():
+                if addr:
+                    self.registry.add(self.shard_id, pid, addr)
+
+    def _poke_peers_out_of_quiesce(self) -> None:
+        if self.peer.is_leader():
+            self.peer.raft.handle(Message(type=MessageType.LEADER_HEARTBEAT))
+
+    def _check_leader_change(self) -> None:
+        lid = self.peer.leader_id()
+        if lid != self.leader_id:
+            self.leader_id = lid
+            if lid != 0:
+                self.pending_leader_transfer.notify_leader(lid)
+            if self.on_leader_updated is not None:
+                self.on_leader_updated(
+                    self.shard_id, self.replica_id, self.peer.term(), lid
+                )
+
+    # ------------------------------------------------------------------
+    # post-save processing (owning step worker; logdb write already done)
+    # ------------------------------------------------------------------
+    def process_update(self, u: Update) -> bool:
+        """reference: node.processRaftUpdate + commitRaftUpdate [U].
+        Returns True if apply work was scheduled."""
+        if not u.snapshot.is_empty():
+            self._install_snapshot(u.snapshot)
+        if u.entries_to_save:
+            self.log_reader.append(u.entries_to_save)
+        for m in u.messages:
+            self.transport.send(m)
+        if u.ready_to_reads:
+            for rtr in u.ready_to_reads:
+                self.pending_read_index.confirmed(rtr.system_ctx, rtr.index)
+            # the read index may already be applied (idle shard): complete now
+            self.pending_read_index.applied(self.sm.last_applied)
+        scheduled = False
+        if u.committed_entries:
+            self.sm.task_queue.add(
+                Task(type=TaskType.ENTRIES, entries=u.committed_entries)
+            )
+            scheduled = True
+        self.peer.commit(u)
+        return scheduled
+
+    def _install_snapshot(self, ss: Snapshot) -> None:
+        """A received snapshot reached the log (InstallSnapshot accepted)."""
+        self.log_reader.apply_snapshot(ss)
+        self.sm.task_queue.add(Task(type=TaskType.SNAPSHOT_RECOVER, snapshot=ss))
+
+    # ------------------------------------------------------------------
+    # apply path (owning apply worker only)
+    # ------------------------------------------------------------------
+    def apply(self) -> None:
+        """Drain the task queue through the RSM (reference:
+        engine applyWorkerMain -> rsm Handle [U])."""
+        for task in self.sm.task_queue.get_all():
+            if task.type == TaskType.ENTRIES:
+                results = self.sm.handle(task)
+                self._complete_applied(results)
+                self._applied_since_snapshot += len(task.entries)
+            elif task.type == TaskType.SNAPSHOT_RECOVER:
+                self._recover_from_snapshot(task.snapshot)
+        self.pending_read_index.applied(self.sm.last_applied)
+        self.peer.notify_raft_last_applied(self.sm.last_applied)
+        if (
+            self.config.snapshot_entries > 0
+            and self._applied_since_snapshot >= self.config.snapshot_entries
+        ):
+            self._applied_since_snapshot = 0
+            with self._qlock:
+                self._snapshot_reqs.append((0, self.config.compaction_overhead))
+
+    def _complete_applied(self, results: List[ApplyResult]) -> None:
+        for r in results:
+            e = r.entry
+            if r.config_change is not None or (
+                e.type == EntryType.CONFIG_CHANGE
+            ):
+                self.enqueue_config_change_result(r.config_change, not r.rejected)
+                if not r.rejected and r.config_change is not None:
+                    cc = r.config_change
+                    if self.registry is not None:
+                        if cc.type == ConfigChangeType.REMOVE_REPLICA:
+                            self.registry.remove(self.shard_id, cc.replica_id)
+                        elif cc.address:
+                            self.registry.add(
+                                self.shard_id, cc.replica_id, cc.address
+                            )
+                if self.notify_work is not None:
+                    self.notify_work()
+                self.pending_config_change.applied(e.key, r.rejected)
+                if self.events is not None and not r.rejected:
+                    from .raftio import NodeInfoEvent
+
+                    self.events.membership_changed(
+                        NodeInfoEvent(self.shard_id, self.replica_id)
+                    )
+            elif e.key:
+                self.pending_proposal.applied(e.key, r.result, r.rejected)
+
+    def _recover_from_snapshot(self, ss: Snapshot) -> None:
+        if ss.dummy or self.config.is_witness:
+            self.sm.last_applied = max(self.sm.last_applied, ss.index)
+            self.sm.members.restore(ss.membership)
+            return
+        payload = self.snapshot_storage.load(ss.filepath)
+        self.sm.recover_from_snapshot_data(payload)
+        self._sync_registry(ss.membership)
+        if self.events is not None:
+            from .raftio import SnapshotInfo
+
+            self.events.snapshot_recovered(
+                SnapshotInfo(self.shard_id, self.replica_id, ss.replica_id, ss.index)
+            )
+
+    # ------------------------------------------------------------------
+    # snapshotting (step-worker context for now; dedicated workers later)
+    # ------------------------------------------------------------------
+    def _save_snapshot_request(self, key: int, overhead: int) -> None:
+        """Save a snapshot of the current applied state and compact the log
+        (reference: rsm.SaveSnapshot + snapshotter [U])."""
+        if self._snapshotting:
+            if key:
+                self.pending_snapshot.done(key, 0, failed=True)
+            return
+        self._snapshotting = True
+        try:
+            payload, index, term = self.sm.save_snapshot_data()
+            if index == 0:
+                if key:
+                    self.pending_snapshot.done(key, 0, failed=True)
+                return
+            prev = self.logdb.get_snapshot(self.shard_id, self.replica_id)
+            if prev.index >= index:
+                if key:
+                    self.pending_snapshot.done(key, 0, failed=True)
+                return
+            filepath = self.snapshot_storage.save(
+                self.shard_id, self.replica_id, index, payload
+            )
+            ss = Snapshot(
+                filepath=filepath,
+                file_size=len(payload),
+                index=index,
+                term=term,
+                membership=self.sm.get_membership(),
+                shard_id=self.shard_id,
+                replica_id=self.replica_id,
+            )
+            u = Update(
+                shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss
+            )
+            self.logdb.save_snapshots([u])
+            # the reader must know the snapshot so the leader can stream it
+            # to followers that fall behind the compaction point
+            self.log_reader.create_snapshot(ss)
+            compact_to = max(0, index - max(overhead, 0))
+            if compact_to > 0:
+                # compact the reader first: it snapshots the boundary term
+                # while the entry is still readable in the logdb
+                self.log_reader.compact(compact_to)
+                self.logdb.remove_entries_to(
+                    self.shard_id, self.replica_id, compact_to
+                )
+            if not prev.is_empty():
+                self.snapshot_storage.remove(prev.filepath)
+            if key:
+                self.pending_snapshot.done(key, index)
+            if self.events is not None:
+                from .raftio import SnapshotInfo, EntryInfo
+
+                self.events.snapshot_created(
+                    SnapshotInfo(self.shard_id, self.replica_id, 0, index)
+                )
+                if compact_to > 0:
+                    self.events.log_compacted(
+                        EntryInfo(self.shard_id, self.replica_id, compact_to)
+                    )
+        finally:
+            self._snapshotting = False
+
+    # ------------------------------------------------------------------
+    def get_membership(self) -> Membership:
+        return self.sm.get_membership()
+
+    def lookup(self, query):
+        return self.sm.lookup(query)
+
+    def stale_read(self, query):
+        return self.sm.lookup(query)
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._stop_event.set()
+        self.pending_proposal.drop_all()
+        self.pending_read_index.drop_all()
+        self.pending_config_change.drop_all()
+        self.pending_snapshot.drop_all()
+        self.pending_leader_transfer.drop_all()
+        self.sm.managed.close()

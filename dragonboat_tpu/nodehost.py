@@ -1,0 +1,443 @@
+"""NodeHost: the process-level host multiplexing many raft shards.
+
+reference: nodehost.go [U].  One NodeHost owns the engine, transport,
+LogDB, registry and ticker; shards are started/stopped dynamically and all
+public request APIs (SyncPropose/SyncRead/membership/snapshot/transfer)
+live here.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .client import Session
+from .config import Config, ConfigError, NodeHostConfig
+from .engine.execengine import ExecEngine
+from .events import EventFanout
+from .logger import get_logger
+from .node import Node
+from .pb import (
+    ConfigChange,
+    ConfigChangeType,
+    Membership,
+    MessageBatch,
+    MessageType,
+)
+from .pb import Message
+from .raftio import LeaderInfo, NodeInfoEvent
+from .request import (
+    RequestError,
+    RequestResultCode,
+    RequestState,
+    ShardNotFound,
+    SystemBusy,
+)
+from .statemachine import Result
+from .storage.logdb import InMemLogDB
+from .storage.snapshotter import InMemSnapshotStorage
+from .transport import InProcTransport, Registry, Transport
+
+_log = get_logger("nodehost")
+
+
+class NodeHostClosed(RequestError):
+    pass
+
+
+class TimeoutError_(RequestError):
+    pass
+
+
+class RequestRejected(RequestError):
+    pass
+
+
+class RequestDropped(RequestError):
+    pass
+
+
+class RequestTerminated(RequestError):
+    pass
+
+
+_CODE_ERRORS = {
+    RequestResultCode.TIMEOUT: TimeoutError_,
+    RequestResultCode.REJECTED: RequestRejected,
+    RequestResultCode.DROPPED: RequestDropped,
+    RequestResultCode.TERMINATED: RequestTerminated,
+    RequestResultCode.ABORTED: RequestTerminated,
+}
+
+
+def _check(code: RequestResultCode, rs: RequestState) -> Result:
+    if code == RequestResultCode.COMPLETED:
+        return rs.result
+    raise _CODE_ERRORS.get(code, RequestError)(code.name)
+
+
+class NodeHost:
+    def __init__(self, config: NodeHostConfig):
+        config.validate()
+        self.config = config
+        self._nodes: Dict[int, Node] = {}  # shard_id -> node (one replica/shard)
+        self._nodes_lock = threading.RLock()
+        self._closed = False
+
+        expert = config.expert
+        self.logdb = (
+            expert.logdb_factory(config) if expert.logdb_factory else InMemLogDB()
+        )
+        self.snapshot_storage = InMemSnapshotStorage()
+        self.registry = Registry()
+        self.events = EventFanout(
+            config.raft_event_listener, config.system_event_listener
+        )
+
+        raw_transport = (
+            expert.transport_factory(config, self._handle_message_batch)
+            if expert.transport_factory
+            else InProcTransport(config.raft_address, self._handle_message_batch)
+        )
+        self.transport = Transport(
+            raw_transport,
+            self.registry.resolve,
+            config.raft_address,
+            config.deployment_id,
+            unreachable_cb=self._report_unreachable,
+        )
+        self.transport.start()
+
+        step_engine = (
+            expert.step_engine_factory(self) if expert.step_engine_factory else None
+        )
+        self.engine = ExecEngine(
+            self.logdb,
+            step_workers=expert.engine.exec_shards,
+            apply_workers=expert.engine.apply_shards,
+            step_engine=step_engine,
+        )
+        self.engine.start()
+
+        self._ticker_stop = threading.Event()
+        self._ticker = threading.Thread(
+            target=self._ticker_main, daemon=True, name="tpu-raft-ticker"
+        )
+        self._ticker.start()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.events.node_host_shutting_down()
+        self._ticker_stop.set()
+        self._ticker.join(timeout=2.0)
+        with self._nodes_lock:
+            nodes = list(self._nodes.values())
+            self._nodes.clear()
+        for n in nodes:
+            self.engine.unregister(n.shard_id)
+            n.stop()
+        self.engine.stop()
+        self.transport.close()
+        self.logdb.close()
+        self.events.close()
+
+    def _ticker_main(self) -> None:
+        period = self.config.rtt_millisecond / 1000.0
+        while not self._ticker_stop.wait(period):
+            with self._nodes_lock:
+                nodes = list(self._nodes.values())
+            for n in nodes:
+                n.add_tick()
+            self.engine.notify_many([n.shard_id for n in nodes])
+
+    # ------------------------------------------------------------------
+    # shard lifecycle
+    # ------------------------------------------------------------------
+    def start_replica(
+        self,
+        initial_members: Dict[int, str],
+        join: bool,
+        sm_factory: Callable,
+        config: Config,
+    ) -> None:
+        """Start this replica of a shard (reference: StartReplica /
+        StartConcurrentReplica / StartOnDiskReplica — the SM tier is
+        detected from the factory's return type) [U]."""
+        if self._closed:
+            raise NodeHostClosed("nodehost closed")
+        config.validate()
+        if not join and not initial_members:
+            raise ConfigError("initial members not given for a non-join start")
+        with self._nodes_lock:
+            if config.shard_id in self._nodes:
+                raise ConfigError(f"shard {config.shard_id} already started")
+            for pid, addr in initial_members.items():
+                self.registry.add(config.shard_id, pid, addr)
+            node = Node(
+                config=config,
+                initial_members=initial_members,
+                join=join,
+                sm_factory=sm_factory,
+                logdb=self.logdb,
+                snapshot_storage=self.snapshot_storage,
+                transport=self.transport,
+                on_leader_updated=self._on_leader_updated,
+                event_listener=self.events,
+                registry=self.registry,
+            )
+            self._nodes[config.shard_id] = node
+            self.engine.register(node)
+        self.events.node_ready(NodeInfoEvent(config.shard_id, config.replica_id))
+
+    def stop_shard(self, shard_id: int) -> None:
+        with self._nodes_lock:
+            node = self._nodes.pop(shard_id, None)
+        if node is None:
+            raise ShardNotFound(f"shard {shard_id}")
+        self.engine.unregister(shard_id)
+        node.stop()
+
+    def stop_replica(self, shard_id: int, replica_id: int) -> None:
+        self.stop_shard(shard_id)
+
+    # ------------------------------------------------------------------
+    # message plumbing
+    # ------------------------------------------------------------------
+    def _handle_message_batch(self, batch: MessageBatch) -> None:
+        if self._closed:
+            return
+        if (
+            self.config.deployment_id
+            and batch.deployment_id
+            and batch.deployment_id != self.config.deployment_id
+        ):
+            _log.warning("dropping batch with wrong deployment id")
+            return
+        touched = set()
+        with self._nodes_lock:
+            for m in batch.messages:
+                node = self._nodes.get(m.shard_id)
+                if node is None or node.replica_id != m.to:
+                    continue
+                node.enqueue_received(m)
+                touched.add(m.shard_id)
+        if touched:
+            self.engine.notify_many(touched)
+
+    def _report_unreachable(self, m) -> None:
+        with self._nodes_lock:
+            node = self._nodes.get(m.shard_id)
+        if node is None:
+            return
+        node.enqueue_received(Message(type=MessageType.UNREACHABLE, from_=m.to))
+        self.engine.notify(m.shard_id)
+
+    def _on_leader_updated(
+        self, shard_id: int, replica_id: int, term: int, leader_id: int
+    ) -> None:
+        self.events.leader_updated(
+            LeaderInfo(
+                shard_id=shard_id,
+                replica_id=replica_id,
+                term=term,
+                leader_id=leader_id,
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # request APIs
+    # ------------------------------------------------------------------
+    def _get_node(self, shard_id: int) -> Node:
+        if self._closed:
+            raise NodeHostClosed("nodehost closed")
+        with self._nodes_lock:
+            node = self._nodes.get(shard_id)
+        if node is None:
+            raise ShardNotFound(f"shard {shard_id} not found")
+        return node
+
+    def _timeout_ticks(self, timeout: float) -> int:
+        return max(1, int(timeout * 1000 / self.config.rtt_millisecond))
+
+    def get_noop_session(self, shard_id: int) -> Session:
+        return Session.noop(shard_id)
+
+    # -- proposals --------------------------------------------------------
+    def propose(self, session: Session, cmd: bytes, timeout: float) -> RequestState:
+        node = self._get_node(session.shard_id)
+        rs = node.propose(session, cmd, self._timeout_ticks(timeout))
+        self.engine.notify(session.shard_id)
+        return rs
+
+    def sync_propose(self, session: Session, cmd: bytes, timeout: float = 5.0) -> Result:
+        rs = self.propose(session, cmd, timeout)
+        return _check(rs.wait(timeout), rs)
+
+    # -- sessions ---------------------------------------------------------
+    def sync_get_session(self, shard_id: int, timeout: float = 5.0) -> Session:
+        s = Session.new_session(shard_id)
+        node = self._get_node(shard_id)
+        rs = node.propose_session_op(s, self._timeout_ticks(timeout))
+        self.engine.notify(shard_id)
+        _check(rs.wait(timeout), rs)
+        s.prepare_for_propose()
+        return s
+
+    def sync_close_session(self, session: Session, timeout: float = 5.0) -> None:
+        session.prepare_for_unregister()
+        node = self._get_node(session.shard_id)
+        rs = node.propose_session_op(session, self._timeout_ticks(timeout))
+        self.engine.notify(session.shard_id)
+        _check(rs.wait(timeout), rs)
+
+    # -- reads ------------------------------------------------------------
+    def read_index(self, shard_id: int, timeout: float) -> RequestState:
+        node = self._get_node(shard_id)
+        rs = node.read_index(self._timeout_ticks(timeout))
+        self.engine.notify(shard_id)
+        return rs
+
+    def sync_read(self, shard_id: int, query, timeout: float = 5.0):
+        rs = self.read_index(shard_id, timeout)
+        _check(rs.wait(timeout), rs)
+        return self._get_node(shard_id).lookup(query)
+
+    def stale_read(self, shard_id: int, query):
+        return self._get_node(shard_id).stale_read(query)
+
+    # -- membership -------------------------------------------------------
+    def _sync_config_change(
+        self,
+        shard_id: int,
+        cc: ConfigChange,
+        timeout: float,
+    ) -> None:
+        node = self._get_node(shard_id)
+        rs = node.request_config_change(cc, self._timeout_ticks(timeout))
+        self.engine.notify(shard_id)
+        _check(rs.wait(timeout), rs)
+        if cc.type in (
+            ConfigChangeType.ADD_REPLICA,
+            ConfigChangeType.ADD_NON_VOTING,
+            ConfigChangeType.ADD_WITNESS,
+        ):
+            self.registry.add(shard_id, cc.replica_id, cc.address)
+        else:
+            self.registry.remove(shard_id, cc.replica_id)
+
+    def sync_request_add_replica(
+        self,
+        shard_id: int,
+        replica_id: int,
+        target: str,
+        config_change_index: int = 0,
+        timeout: float = 5.0,
+    ) -> None:
+        self._sync_config_change(
+            shard_id,
+            ConfigChange(
+                config_change_id=config_change_index,
+                type=ConfigChangeType.ADD_REPLICA,
+                replica_id=replica_id,
+                address=target,
+            ),
+            timeout,
+        )
+
+    def sync_request_add_non_voting(
+        self, shard_id, replica_id, target, config_change_index=0, timeout=5.0
+    ) -> None:
+        self._sync_config_change(
+            shard_id,
+            ConfigChange(
+                config_change_id=config_change_index,
+                type=ConfigChangeType.ADD_NON_VOTING,
+                replica_id=replica_id,
+                address=target,
+            ),
+            timeout,
+        )
+
+    def sync_request_add_witness(
+        self, shard_id, replica_id, target, config_change_index=0, timeout=5.0
+    ) -> None:
+        self._sync_config_change(
+            shard_id,
+            ConfigChange(
+                config_change_id=config_change_index,
+                type=ConfigChangeType.ADD_WITNESS,
+                replica_id=replica_id,
+                address=target,
+            ),
+            timeout,
+        )
+
+    def sync_request_delete_replica(
+        self, shard_id, replica_id, config_change_index=0, timeout=5.0
+    ) -> None:
+        self._sync_config_change(
+            shard_id,
+            ConfigChange(
+                config_change_id=config_change_index,
+                type=ConfigChangeType.REMOVE_REPLICA,
+                replica_id=replica_id,
+            ),
+            timeout,
+        )
+
+    def sync_get_shard_membership(self, shard_id: int, timeout: float = 5.0) -> Membership:
+        rs = self.read_index(shard_id, timeout)
+        _check(rs.wait(timeout), rs)
+        return self._get_node(shard_id).get_membership()
+
+    def get_shard_membership(self, shard_id: int) -> Membership:
+        return self._get_node(shard_id).get_membership()
+
+    # -- snapshots --------------------------------------------------------
+    def sync_request_snapshot(
+        self, shard_id: int, compaction_overhead: int = 0, timeout: float = 5.0
+    ) -> int:
+        node = self._get_node(shard_id)
+        rs = node.request_snapshot(
+            compaction_overhead or node.config.compaction_overhead,
+            self._timeout_ticks(timeout),
+        )
+        self.engine.notify(shard_id)
+        return _check(rs.wait(timeout), rs).value
+
+    # -- leadership -------------------------------------------------------
+    def request_leader_transfer(self, shard_id: int, target_id: int) -> None:
+        node = self._get_node(shard_id)
+        node.request_leader_transfer(target_id, self._timeout_ticks(5.0))
+        self.engine.notify(shard_id)
+
+    def get_leader_id(self, shard_id: int):
+        node = self._get_node(shard_id)
+        lid = node.peer.leader_id()
+        return lid, lid != 0
+
+    # -- info -------------------------------------------------------------
+    def get_nodehost_info(self) -> dict:
+        with self._nodes_lock:
+            return {
+                "raft_address": self.config.raft_address,
+                "shards": [
+                    {
+                        "shard_id": n.shard_id,
+                        "replica_id": n.replica_id,
+                        "leader_id": n.leader_id,
+                        "term": n.peer.term(),
+                        "committed": n.peer.committed(),
+                        "applied": n.sm.last_applied,
+                    }
+                    for n in self._nodes.values()
+                ],
+            }
+
+    def raft_address(self) -> str:
+        return self.config.raft_address

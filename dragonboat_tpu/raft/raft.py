@@ -782,6 +782,11 @@ class Raft:
             return
         paused = rm.is_paused()
         if rm.try_update(m.log_index):
+            if (
+                rm.state == RemoteState.SNAPSHOT
+                and rm.match >= rm.snapshot_index
+            ):
+                rm.become_retry()
             if rm.state == RemoteState.RETRY:
                 rm.become_replicate()
             if self.try_commit():

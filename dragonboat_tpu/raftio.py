@@ -1,0 +1,215 @@
+"""Pluggable storage + transport contracts and listener event types.
+
+reference: raftio/ (logdb.go, transport.go, rpc.go events) [U].
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .pb import Chunk, Entry, MessageBatch, Snapshot, State, Update
+
+
+# ---------------------------------------------------------------------------
+# LogDB (reference: raftio/logdb.go ILogDB [U])
+# ---------------------------------------------------------------------------
+@dataclass
+class RaftState:
+    """What ReadRaftState returns at restart."""
+
+    state: State = field(default_factory=State)
+    first_index: int = 0
+    entry_count: int = 0
+
+
+@dataclass
+class NodeInfo:
+    shard_id: int = 0
+    replica_id: int = 0
+
+
+class ILogDB(abc.ABC):
+    """Persistent log storage contract.  ``save_raft_state`` is atomic for
+    the whole batch of updates (entries + HardState + snapshot refs) and is
+    the single fsync point of the write path."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def list_node_info(self) -> List[NodeInfo]: ...
+
+    @abc.abstractmethod
+    def save_bootstrap_info(
+        self, shard_id: int, replica_id: int, bootstrap
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def get_bootstrap_info(self, shard_id: int, replica_id: int): ...
+
+    @abc.abstractmethod
+    def save_raft_state(self, updates: List[Update], worker_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def read_raft_state(
+        self, shard_id: int, replica_id: int, last_index: int
+    ) -> Optional[RaftState]: ...
+
+    @abc.abstractmethod
+    def iterate_entries(
+        self,
+        shard_id: int,
+        replica_id: int,
+        low: int,
+        high: int,
+        max_size: int,
+    ) -> List[Entry]: ...
+
+    @abc.abstractmethod
+    def term(self, shard_id: int, replica_id: int, index: int) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def remove_entries_to(
+        self, shard_id: int, replica_id: int, index: int
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def compact_entries_to(
+        self, shard_id: int, replica_id: int, index: int
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def save_snapshots(self, updates: List[Update]) -> None: ...
+
+    @abc.abstractmethod
+    def get_snapshot(self, shard_id: int, replica_id: int) -> Snapshot: ...
+
+    @abc.abstractmethod
+    def remove_node_data(self, shard_id: int, replica_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def import_snapshot(self, snapshot: Snapshot, replica_id: int) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Transport (reference: raftio/transport.go ITransport [U])
+# ---------------------------------------------------------------------------
+class IConnection(abc.ABC):
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def send_message_batch(self, batch: MessageBatch) -> None: ...
+
+
+class ISnapshotConnection(abc.ABC):
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def send_chunk(self, chunk: Chunk) -> None: ...
+
+
+MessageHandler = Callable[[MessageBatch], None]
+ChunkHandler = Callable[[Chunk], bool]
+
+
+class ITransport(abc.ABC):
+    """reference: raftio.ITransport (v3 IRaftRPC) [U]."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def start(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_connection(self, target: str) -> IConnection: ...
+
+    @abc.abstractmethod
+    def get_snapshot_connection(self, target: str) -> ISnapshotConnection: ...
+
+
+# ---------------------------------------------------------------------------
+# Event listener payloads (reference: raftio/events.go [U])
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class LeaderInfo:
+    shard_id: int
+    replica_id: int
+    term: int
+    leader_id: int
+
+
+@dataclass(frozen=True)
+class NodeInfoEvent:
+    shard_id: int
+    replica_id: int
+
+
+@dataclass(frozen=True)
+class SnapshotInfo:
+    shard_id: int
+    replica_id: int
+    from_: int
+    index: int
+
+
+@dataclass(frozen=True)
+class EntryInfo:
+    shard_id: int
+    replica_id: int
+    index: int
+
+
+@dataclass(frozen=True)
+class ConnectionInfo:
+    address: str
+    snapshot_connection: bool
+
+
+class IRaftEventListener(abc.ABC):
+    @abc.abstractmethod
+    def leader_updated(self, info: LeaderInfo) -> None: ...
+
+
+class ISystemEventListener:
+    """Optional callbacks; default implementations are no-ops so users
+    override only what they need (reference: ISystemEventListener [U])."""
+
+    def node_host_shutting_down(self) -> None: ...
+
+    def node_ready(self, info: NodeInfoEvent) -> None: ...
+
+    def node_unloaded(self, info: NodeInfoEvent) -> None: ...
+
+    def membership_changed(self, info: NodeInfoEvent) -> None: ...
+
+    def connection_established(self, info: ConnectionInfo) -> None: ...
+
+    def connection_failed(self, info: ConnectionInfo) -> None: ...
+
+    def send_snapshot_started(self, info: SnapshotInfo) -> None: ...
+
+    def send_snapshot_completed(self, info: SnapshotInfo) -> None: ...
+
+    def send_snapshot_aborted(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_received(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_recovered(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_created(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_compacted(self, info: SnapshotInfo) -> None: ...
+
+    def log_compacted(self, info: EntryInfo) -> None: ...
+
+    def log_db_compacted(self, info: EntryInfo) -> None: ...

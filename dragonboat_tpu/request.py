@@ -1,0 +1,248 @@
+"""Pending-operation futures connecting the public API to the step loop.
+
+reference: request.go (RequestState, pendingProposal, pendingReadIndex,
+pendingConfigChange, pendingSnapshot, pendingLeaderTransfer) [U].
+
+Timeouts are logical: deadlines are in ticks, swept by the node's tick
+path, so behavior is reproducible and cheap at high request rates.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .client import Session
+from .pb import Entry, EntryType, SystemCtx
+from .statemachine import Result
+
+
+class RequestError(Exception):
+    pass
+
+
+class ShardNotFound(RequestError):
+    pass
+
+
+class ShardNotReady(RequestError):
+    pass
+
+
+class InvalidTarget(RequestError):
+    pass
+
+
+class SystemBusy(RequestError):
+    pass
+
+
+class RequestResultCode(enum.IntEnum):
+    TIMEOUT = 0
+    COMPLETED = 1
+    TERMINATED = 2
+    REJECTED = 3
+    DROPPED = 4
+    ABORTED = 5
+    COMMITTED = 6  # notify-commit mode: committed but not yet applied
+
+
+class RequestState:
+    """A single pending operation's future (reference: RequestState [U])."""
+
+    __slots__ = ("key", "deadline", "_event", "code", "result", "_committed")
+
+    def __init__(self, key: int, deadline: int):
+        self.key = key
+        self.deadline = deadline
+        self._event = threading.Event()
+        self.code: Optional[RequestResultCode] = None
+        self.result: Result = Result()
+        self._committed = False
+
+    # -- completion (engine side) ---------------------------------------
+    def notify(self, code: RequestResultCode, result: Optional[Result] = None):
+        self.code = code
+        if result is not None:
+            self.result = result
+        self._event.set()
+
+    def notify_committed(self):
+        self._committed = True
+
+    # -- waiting (client side) -------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> RequestResultCode:
+        if not self._event.wait(timeout):
+            return RequestResultCode.TIMEOUT
+        return self.code  # type: ignore[return-value]
+
+    def completed(self) -> bool:
+        return self.code == RequestResultCode.COMPLETED
+
+
+class _PendingBase:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: Dict[int, RequestState] = {}
+        self._next_key = 0
+
+    def _alloc(self, deadline: int) -> RequestState:
+        with self._lock:
+            self._next_key += 1
+            rs = RequestState(self._next_key, deadline)
+            self._pending[self._next_key] = rs
+            return rs
+
+    def pop(self, key: int) -> Optional[RequestState]:
+        with self._lock:
+            return self._pending.pop(key, None)
+
+    def gc(self, now_tick: int) -> None:
+        with self._lock:
+            expired = [
+                k for k, rs in self._pending.items() if rs.deadline <= now_tick
+            ]
+            for k in expired:
+                self._pending.pop(k).notify(RequestResultCode.TIMEOUT)
+
+    def drop_all(self, code: RequestResultCode = RequestResultCode.TERMINATED):
+        with self._lock:
+            for rs in self._pending.values():
+                rs.notify(code)
+            self._pending.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class PendingProposal(_PendingBase):
+    """reference: pendingProposal (sharded by key in the reference; a
+    single dict suffices under the GIL) [U]."""
+
+    def propose(
+        self, session: Session, cmd: bytes, deadline: int
+    ) -> Tuple[Entry, RequestState]:
+        rs = self._alloc(deadline)
+        entry = Entry(
+            type=EntryType.APPLICATION,
+            key=rs.key,
+            client_id=session.client_id,
+            series_id=session.series_id,
+            responded_to=session.responded_to,
+            cmd=cmd,
+        )
+        return entry, rs
+
+    def applied(self, key: int, result: Result, rejected: bool) -> None:
+        rs = self.pop(key)
+        if rs is None:
+            return
+        code = (
+            RequestResultCode.REJECTED if rejected else RequestResultCode.COMPLETED
+        )
+        rs.notify(code, result)
+
+    def committed(self, key: int) -> None:
+        with self._lock:
+            rs = self._pending.get(key)
+        if rs is not None:
+            rs.notify_committed()
+
+    def dropped(self, key: int) -> None:
+        rs = self.pop(key)
+        if rs is not None:
+            rs.notify(RequestResultCode.DROPPED)
+
+
+class PendingReadIndex(_PendingBase):
+    """reference: pendingReadIndex [U].  Two stages: (1) ctx confirmed by
+    quorum -> learn the read index; (2) applied index reaches it ->
+    complete."""
+
+    def __init__(self):
+        super().__init__()
+        self._ctx_map: Dict[Tuple[int, int], int] = {}  # ctx -> key
+        self._waiting: List[Tuple[int, int]] = []  # (read_index, key)
+
+    def read(self, deadline: int) -> Tuple[SystemCtx, RequestState]:
+        rs = self._alloc(deadline)
+        ctx = SystemCtx(low=rs.key, high=rs.key ^ 0x5DEECE66D)
+        with self._lock:
+            self._ctx_map[(ctx.low, ctx.high)] = rs.key
+        return ctx, rs
+
+    def confirmed(self, ctx: SystemCtx, index: int) -> None:
+        with self._lock:
+            key = self._ctx_map.pop((ctx.low, ctx.high), None)
+            if key is None or key not in self._pending:
+                return
+            self._waiting.append((index, key))
+
+    def dropped(self, ctx: SystemCtx) -> None:
+        with self._lock:
+            key = self._ctx_map.pop((ctx.low, ctx.high), None)
+        if key is None:
+            return
+        rs = self.pop(key)
+        if rs is not None:
+            rs.notify(RequestResultCode.DROPPED)
+
+    def applied(self, applied_index: int) -> None:
+        """Called as the apply loop advances; completes reads whose index
+        has been reached."""
+        ready: List[int] = []
+        with self._lock:
+            still = []
+            for index, key in self._waiting:
+                if index <= applied_index:
+                    ready.append(key)
+                else:
+                    still.append((index, key))
+            self._waiting = still
+        for key in ready:
+            rs = self.pop(key)
+            if rs is not None:
+                rs.notify(RequestResultCode.COMPLETED)
+
+
+class PendingConfigChange(_PendingBase):
+    def request(self, cc, deadline: int) -> Tuple[int, RequestState]:
+        rs = self._alloc(deadline)
+        return rs.key, rs
+
+    def applied(self, key: int, rejected: bool) -> None:
+        rs = self.pop(key)
+        if rs is None:
+            return
+        rs.notify(
+            RequestResultCode.REJECTED if rejected else RequestResultCode.COMPLETED
+        )
+
+
+class PendingSnapshot(_PendingBase):
+    def request(self, deadline: int) -> RequestState:
+        return self._alloc(deadline)
+
+    def done(self, key: int, index: int, failed: bool = False) -> None:
+        rs = self.pop(key)
+        if rs is None:
+            return
+        if failed:
+            rs.notify(RequestResultCode.REJECTED)
+        else:
+            rs.notify(RequestResultCode.COMPLETED, Result(value=index))
+
+
+class PendingLeaderTransfer(_PendingBase):
+    def request(self, target: int, deadline: int) -> RequestState:
+        return self._alloc(deadline)
+
+    def notify_leader(self, leader_id: int) -> None:
+        with self._lock:
+            keys = list(self._pending)
+            for k in keys:
+                self._pending.pop(k).notify(
+                    RequestResultCode.COMPLETED, Result(value=leader_id)
+                )

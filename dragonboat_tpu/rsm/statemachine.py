@@ -1,0 +1,276 @@
+"""The ordered apply loop: rsm.StateMachine + TaskQueue.
+
+reference: internal/rsm/statemachine.go [U].  Apply workers drain a
+``TaskQueue`` of committed-entry batches (plus snapshot save/recover
+tasks), route each entry by kind (application / config-change / session
+ops / noop), dedupe through client sessions, and surface
+``ApplyResult``s so the node can complete pending futures.
+"""
+from __future__ import annotations
+
+import enum
+import io
+import pickle
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+from ..client import (
+    NOOP_SERIES_ID,
+    SERIES_ID_REGISTER,
+    SERIES_ID_UNREGISTER,
+)
+from ..logger import get_logger
+from ..pb import ConfigChange, Entry, EntryType, Membership, Snapshot
+from ..statemachine import Result, SMEntry
+from .managed import ManagedStateMachine
+from .membership import MembershipManager
+from .session import SessionManager
+
+_log = get_logger("rsm")
+
+
+class TaskType(enum.IntEnum):
+    ENTRIES = 0
+    SNAPSHOT_SAVE = 1
+    SNAPSHOT_RECOVER = 2
+    SNAPSHOT_STREAM = 3
+    SYNC = 4
+    STOP = 5
+
+
+@dataclass
+class Task:
+    type: TaskType = TaskType.ENTRIES
+    entries: List[Entry] = field(default_factory=list)
+    snapshot: Snapshot = None  # type: ignore[assignment]
+    ctx: object = None  # snapshot request context (export path, sink, ...)
+
+
+class TaskQueue:
+    """MPSC committed-task queue (reference: rsm.TaskQueue [U])."""
+
+    def __init__(self):
+        self._q: Deque[Task] = deque()
+        self._lock = threading.Lock()
+
+    def add(self, t: Task) -> None:
+        with self._lock:
+            self._q.append(t)
+
+    def get_all(self) -> List[Task]:
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+@dataclass
+class ApplyResult:
+    entry: Entry
+    result: Result
+    rejected: bool = False  # config change rejected / session op failed
+    config_change: Optional[ConfigChange] = None
+
+
+class StateMachine:
+    """Per-replica managed SM + sessions + membership (reference:
+    rsm.StateMachine [U])."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        replica_id: int,
+        managed: ManagedStateMachine,
+        ordered_config_change: bool = False,
+        is_witness: bool = False,
+    ):
+        self.shard_id = shard_id
+        self.replica_id = replica_id
+        self.managed = managed
+        self.sessions = SessionManager()
+        self.members = MembershipManager(shard_id, ordered_config_change)
+        self.task_queue = TaskQueue()
+        self.last_applied = 0
+        self.applied_term = 0
+        self.on_disk_init_index = 0
+        self.is_witness = is_witness
+        self._mu = threading.RLock()
+
+    # -- lifecycle --------------------------------------------------------
+    def open(self, stopc) -> int:
+        """On-disk SMs recover themselves and report their applied index."""
+        idx = self.managed.open(stopc)
+        self.on_disk_init_index = idx
+        if idx > self.last_applied:
+            self.last_applied = idx
+        return idx
+
+    def set_initial_membership(self, addresses, non_votings=None, witnesses=None):
+        self.members.set_initial(addresses, non_votings, witnesses)
+
+    def get_membership(self) -> Membership:
+        with self._mu:
+            return self.members.membership.copy()
+
+    # -- apply ------------------------------------------------------------
+    def handle(self, task: Task) -> List[ApplyResult]:
+        """Apply one committed batch in order (reference: rsm.Handle [U])."""
+        if task.type != TaskType.ENTRIES:
+            raise ValueError("handle() only processes entry tasks")
+        results: List[ApplyResult] = []
+        batch: List[Tuple[Entry, SMEntry]] = []
+
+        def flush():
+            if not batch:
+                return
+            sm_entries = [se for _, se in batch]
+            self.managed.batched_update(sm_entries)
+            for (entry, se) in batch:
+                self._record_session_result(entry, se.result)
+                results.append(ApplyResult(entry=entry, result=se.result))
+            batch.clear()
+
+        with self._mu:
+            for e in task.entries:
+                if e.index <= self.last_applied:
+                    continue  # replayed tail below on-disk applied index
+                if e.type == EntryType.CONFIG_CHANGE:
+                    flush()
+                    results.append(self._handle_config_change(e))
+                elif e.type == EntryType.METADATA or e.is_noop():
+                    flush()
+                    self._advance(e)
+                elif e.is_new_session_request():
+                    flush()
+                    results.append(self._handle_register(e))
+                elif e.is_end_session_request():
+                    flush()
+                    results.append(self._handle_unregister(e))
+                else:
+                    dup = self._check_duplicate(e)
+                    if dup is not None:
+                        results.append(dup)
+                    elif self.is_witness:
+                        self._advance(e)  # witnesses never run user code
+                    else:
+                        batch.append((e, SMEntry(index=e.index, cmd=e.cmd)))
+                        self._advance(e)
+            flush()
+        return results
+
+    def _advance(self, e: Entry) -> None:
+        if e.index > self.last_applied:
+            self.last_applied = e.index
+            self.applied_term = e.term
+
+    def _check_duplicate(self, e: Entry) -> Optional[ApplyResult]:
+        if not e.is_session_managed():
+            return None
+        s = self.sessions.get(e.client_id)
+        if s is None:
+            # session expired from LRU (or never registered)
+            self._advance(e)
+            return ApplyResult(entry=e, result=Result(), rejected=True)
+        s.clear_to(e.responded_to)
+        if s.has_responded(e.series_id):
+            self._advance(e)
+            return ApplyResult(entry=e, result=Result(), rejected=True)
+        cached, hit = s.get_response(e.series_id)
+        if hit:
+            self._advance(e)
+            return ApplyResult(entry=e, result=cached)
+        return None
+
+    def _record_session_result(self, e: Entry, result: Result) -> None:
+        if not e.is_session_managed():
+            return
+        s = self.sessions.get(e.client_id)
+        if s is not None:
+            s.add_response(e.series_id, result)
+
+    def _handle_config_change(self, e: Entry) -> ApplyResult:
+        try:
+            cc: ConfigChange = pickle.loads(e.cmd)
+        except Exception:
+            self._advance(e)
+            return ApplyResult(entry=e, result=Result(), rejected=True)
+        accepted = self.members.handle(cc, e.index)
+        self._advance(e)
+        return ApplyResult(
+            entry=e,
+            result=Result(value=1 if accepted else 0),
+            rejected=not accepted,
+            config_change=cc if accepted else None,
+        )
+
+    def _handle_register(self, e: Entry) -> ApplyResult:
+        r = self.sessions.register(e.client_id)
+        self._advance(e)
+        return ApplyResult(entry=e, result=r, rejected=r.value == 0)
+
+    def _handle_unregister(self, e: Entry) -> ApplyResult:
+        r = self.sessions.unregister(e.client_id)
+        self._advance(e)
+        return ApplyResult(entry=e, result=r, rejected=r.value == 0)
+
+    # -- reads ------------------------------------------------------------
+    def lookup(self, query):
+        return self.managed.lookup(query)
+
+    def sync(self) -> None:
+        self.managed.sync()
+
+    # -- snapshot ---------------------------------------------------------
+    def save_snapshot_data(self, files=None, done=None) -> Tuple[bytes, int, int]:
+        """Serialize (header, sessions, SM data); returns (blob, index, term).
+
+        The versioned on-disk container lives in storage/snapshotio.py;
+        this produces the inner payload (reference: rsm.SaveSnapshot [U]).
+        """
+        buf = io.BytesIO()
+        done = done or threading.Event()
+        with self._mu:
+            index, term = self.last_applied, self.applied_term
+            membership = self.members.membership.copy()
+            sessions_blob = self.sessions.serialize()
+            ctx = self.managed.prepare_snapshot()
+            if not self.managed.concurrent_snapshot:
+                # regular SM: serialize inside the apply-exclusive section so
+                # the payload cannot contain entries newer than `index`
+                self.managed.save_snapshot(ctx, buf, files, done)
+        if self.managed.concurrent_snapshot:
+            # concurrent/on-disk SMs captured a consistent view in
+            # prepare_snapshot; the slow serialization runs outside the lock
+            self.managed.save_snapshot(ctx, buf, files, done)
+        payload = pickle.dumps(
+            {
+                "version": 1,
+                "index": index,
+                "term": term,
+                "membership": membership,
+                "sessions": sessions_blob,
+                "sm_data": buf.getvalue(),
+                "on_disk": self.managed.on_disk,
+            }
+        )
+        return payload, index, term
+
+    def recover_from_snapshot_data(self, payload: bytes, done=None) -> int:
+        d = pickle.loads(payload)
+        with self._mu:
+            if d["sm_data"] is not None:
+                r = io.BytesIO(d["sm_data"])
+                self.managed.recover_from_snapshot(
+                    r, [], done or threading.Event()
+                )
+            self.sessions = SessionManager.deserialize(d["sessions"])
+            self.members.restore(d["membership"])
+            self.last_applied = d["index"]
+            self.applied_term = d["term"]
+        return d["index"]

@@ -1,0 +1,292 @@
+"""In-memory LogDB + the LogDB-backed log reader for the raft core.
+
+reference: internal/logdb/ (ShardedDB) + internal/logdb/logreader.go [U].
+
+``InMemLogDB`` implements the full ILogDB contract against process memory;
+it is the storage backend for tests and for BASELINE config 1/2 (the
+durable tan-style WAL lives in storage/tan.py).  A single instance may be
+shared across NodeHost restarts to model "the disk" (as the reference's
+tests do with MemFS).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..pb import Bootstrap, Entry, Snapshot, State, EMPTY_SNAPSHOT, Update
+from ..raft.log import LogCompactedError, LogUnavailableError
+from ..raftio import ILogDB, NodeInfo, RaftState
+
+
+class _NodeStore:
+    """Per-(shard,replica) record set."""
+
+    def __init__(self):
+        self.state = State()
+        self.entries: Dict[int, Entry] = {}
+        self.max_index = 0
+        self.min_index = 1  # entries below were removed/compacted
+        self.snapshot: Snapshot = EMPTY_SNAPSHOT
+        self.bootstrap: Optional[Bootstrap] = None
+
+
+class InMemLogDB(ILogDB):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: Dict[Tuple[int, int], _NodeStore] = {}
+        self.sync_count = 0  # batched-write counter (1 per save_raft_state)
+
+    def _get(self, shard_id: int, replica_id: int) -> _NodeStore:
+        key = (shard_id, replica_id)
+        with self._lock:
+            if key not in self._nodes:
+                self._nodes[key] = _NodeStore()
+            return self._nodes[key]
+
+    # -- ILogDB ----------------------------------------------------------
+    def name(self) -> str:
+        return "inmem"
+
+    def close(self) -> None:
+        pass
+
+    def list_node_info(self) -> List[NodeInfo]:
+        with self._lock:
+            return [
+                NodeInfo(shard_id=s, replica_id=r) for (s, r) in self._nodes
+            ]
+
+    def save_bootstrap_info(self, shard_id, replica_id, bootstrap) -> None:
+        with self._lock:
+            self._get(shard_id, replica_id).bootstrap = bootstrap
+
+    def get_bootstrap_info(self, shard_id, replica_id):
+        with self._lock:
+            return self._get(shard_id, replica_id).bootstrap
+
+    def save_raft_state(self, updates: List[Update], worker_id: int) -> None:
+        """One atomic batched write for all shards in ``updates`` —
+        the reference's single-fsync-per-iteration trick
+        (engine.go step worker -> logdb.SaveRaftState [U])."""
+        with self._lock:
+            for u in updates:
+                ns = self._get(u.shard_id, u.replica_id)
+                if not u.state.is_empty():
+                    ns.state = u.state
+                for e in u.entries_to_save:
+                    ns.entries[e.index] = e
+                    if e.index > ns.max_index:
+                        ns.max_index = e.index
+                if u.entries_to_save:
+                    # overwrite truncates any conflicting suffix
+                    last = u.entries_to_save[-1].index
+                    for i in list(ns.entries):
+                        if i > last:
+                            del ns.entries[i]
+                    ns.max_index = last
+                if not u.snapshot.is_empty():
+                    ns.snapshot = u.snapshot
+                    if ns.max_index < u.snapshot.index:
+                        ns.max_index = u.snapshot.index
+            self.sync_count += 1
+
+    def read_raft_state(self, shard_id, replica_id, last_index) -> Optional[RaftState]:
+        with self._lock:
+            key = (shard_id, replica_id)
+            if key not in self._nodes:
+                return None
+            ns = self._nodes[key]
+            first = max(ns.min_index, ns.snapshot.index + 1)
+            count = 0
+            i = first
+            while i in ns.entries:
+                count += 1
+                i += 1
+            return RaftState(
+                state=ns.state, first_index=first, entry_count=count
+            )
+
+    def iterate_entries(self, shard_id, replica_id, low, high, max_size) -> List[Entry]:
+        with self._lock:
+            ns = self._get(shard_id, replica_id)
+            out: List[Entry] = []
+            size = 0
+            for i in range(low, high):
+                e = ns.entries.get(i)
+                if e is None:
+                    break
+                size += e.size_bytes()
+                if out and size > max_size:
+                    break
+                out.append(e)
+            return out
+
+    def term(self, shard_id, replica_id, index) -> Optional[int]:
+        with self._lock:
+            ns = self._get(shard_id, replica_id)
+            e = ns.entries.get(index)
+            if e is not None:
+                return e.term
+            if ns.snapshot.index == index and index > 0:
+                return ns.snapshot.term
+            return None
+
+    def remove_entries_to(self, shard_id, replica_id, index) -> None:
+        with self._lock:
+            ns = self._get(shard_id, replica_id)
+            for i in list(ns.entries):
+                if i <= index:
+                    del ns.entries[i]
+            ns.min_index = max(ns.min_index, index + 1)
+
+    def compact_entries_to(self, shard_id, replica_id, index) -> None:
+        self.remove_entries_to(shard_id, replica_id, index)
+
+    def save_snapshots(self, updates: List[Update]) -> None:
+        with self._lock:
+            for u in updates:
+                if not u.snapshot.is_empty():
+                    ns = self._get(u.shard_id, u.replica_id)
+                    if u.snapshot.index > ns.snapshot.index:
+                        ns.snapshot = u.snapshot
+
+    def get_snapshot(self, shard_id, replica_id) -> Snapshot:
+        with self._lock:
+            return self._get(shard_id, replica_id).snapshot
+
+    def remove_node_data(self, shard_id, replica_id) -> None:
+        with self._lock:
+            self._nodes.pop((shard_id, replica_id), None)
+
+    def import_snapshot(self, snapshot: Snapshot, replica_id: int) -> None:
+        with self._lock:
+            ns = self._get(snapshot.shard_id, replica_id)
+            ns.snapshot = snapshot
+            ns.state = State(
+                term=snapshot.term, vote=0, commit=snapshot.index
+            )
+            ns.entries.clear()
+            ns.max_index = snapshot.index
+            ns.min_index = snapshot.index + 1
+
+
+class LogDBLogReader:
+    """ILogReader over an ILogDB for one (shard, replica) — keeps the
+    log range in memory, reads entries/terms through the DB.
+
+    reference: internal/logdb/logreader.go [U].  The node must call
+    ``append``/``apply_snapshot``/``compact`` as it persists so the range
+    stays accurate (terms/entries themselves always come from the DB).
+    """
+
+    def __init__(self, shard_id: int, replica_id: int, logdb: ILogDB):
+        self.shard_id = shard_id
+        self.replica_id = replica_id
+        self.logdb = logdb
+        self._snapshot: Snapshot = EMPTY_SNAPSHOT
+        self._marker = 1
+        self._length = 0
+        # term of the entry at marker-1 (the compaction boundary), kept so
+        # prev-log-term checks right at the boundary still resolve — the
+        # etcd-storage "dummy entry" trick (reference: logreader [U])
+        self._marker_term: Optional[int] = None
+
+    @classmethod
+    def from_existing(
+        cls, shard_id: int, replica_id: int, logdb: ILogDB
+    ) -> Tuple["LogDBLogReader", Optional[State]]:
+        """Open at restart: recover range + HardState (reference:
+        nodehost loadState path [U])."""
+        lr = cls(shard_id, replica_id, logdb)
+        ss = logdb.get_snapshot(shard_id, replica_id)
+        if not ss.is_empty():
+            lr._snapshot = ss
+            lr._marker = ss.index + 1
+        rs = logdb.read_raft_state(shard_id, replica_id, 0)
+        if rs is None:
+            return lr, None
+        if rs.entry_count > 0:
+            lr._marker = rs.first_index
+            lr._length = rs.entry_count
+        elif not ss.is_empty():
+            lr._marker = ss.index + 1
+            lr._length = 0
+        return lr, rs.state
+
+    # -- ILogReader ------------------------------------------------------
+    def log_range(self) -> Tuple[int, int]:
+        if self._length > 0:
+            # a locally created snapshot never hides live entries
+            return self._marker, self._marker + self._length - 1
+        first = max(self._marker, self._snapshot.index + 1)
+        return first, first - 1
+
+    def term(self, index: int) -> int:
+        if index == self._snapshot.index and index > 0:
+            return self._snapshot.term
+        first, last = self.log_range()
+        if index < first - 1:
+            raise LogCompactedError(f"index {index} < first {first}")
+        if index > last:
+            raise LogUnavailableError(f"index {index} > last {last}")
+        if index == 0:
+            return 0
+        t = self.logdb.term(self.shard_id, self.replica_id, index)
+        if t is None:
+            if index == self._marker - 1 and self._marker_term is not None:
+                return self._marker_term
+            raise LogUnavailableError(f"term missing at {index}")
+        return t
+
+    def entries(self, low: int, high: int, max_size: int) -> List[Entry]:
+        first, last = self.log_range()
+        if low < first:
+            raise LogCompactedError(f"low {low} < first {first}")
+        if high > last + 1:
+            raise LogUnavailableError(f"high {high} > last+1 {last+1}")
+        return self.logdb.iterate_entries(
+            self.shard_id, self.replica_id, low, high, max_size
+        )
+
+    def snapshot(self) -> Snapshot:
+        return self._snapshot
+
+    # -- mutating half ----------------------------------------------------
+    def append(self, entries: List[Entry]) -> None:
+        if not entries:
+            return
+        first_new = entries[0].index
+        last_cur = self._marker + self._length - 1
+        if first_new > last_cur + 1:
+            raise ValueError(f"log gap: {first_new} after {last_cur}")
+        if first_new < self._marker:
+            self._marker = first_new
+            self._length = len(entries)
+        else:
+            self._length = first_new - self._marker + len(entries)
+
+    def apply_snapshot(self, ss: Snapshot) -> None:
+        """Restore: the log is reset to the snapshot point."""
+        self._snapshot = ss
+        self._marker = ss.index + 1
+        self._length = 0
+        self._marker_term = ss.term
+
+    def create_snapshot(self, ss: Snapshot) -> None:
+        """Record a locally created snapshot WITHOUT resetting the range —
+        the log still holds entries past the snapshot (reference:
+        logReader.CreateSnapshot vs ApplySnapshot [U])."""
+        if ss.index > self._snapshot.index:
+            self._snapshot = ss
+
+    def compact(self, to_index: int) -> None:
+        first, last = self.log_range()
+        if to_index < self._marker:
+            return
+        keep_from = min(to_index + 1, last + 1)
+        try:
+            self._marker_term = self.term(keep_from - 1)
+        except (LogCompactedError, LogUnavailableError):
+            pass
+        self._length -= keep_from - self._marker
+        self._marker = keep_from

@@ -1,0 +1,105 @@
+"""Snapshot payload storage + the node<->rsm<->logdb snapshot bridge.
+
+reference: snapshotter.go + internal/fileutil atomic dir finalize [U].
+
+Two backends:
+  * ``InMemSnapshotStorage`` — process-global table (the in-proc analogue
+    of finalized snapshot dirs); used by tests and BASELINE configs 1-2.
+  * ``FileSnapshotStorage`` — atomic temp-file + fsync + rename layout
+    (reference: fileutil.CreateFlagFile / SyncDir [U]).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from typing import Dict, Optional, Tuple
+
+_global_lock = threading.Lock()
+_global_store: Dict[str, bytes] = {}
+
+
+def _checksum(data: bytes) -> bytes:
+    return zlib.crc32(data).to_bytes(4, "little")
+
+
+class InMemSnapshotStorage:
+    """Keys are synthetic 'paths' so pb.Snapshot.filepath stays meaningful."""
+
+    def save(self, shard_id: int, replica_id: int, index: int, payload: bytes) -> str:
+        path = f"mem://snapshot-{shard_id}-{replica_id}-{index:020d}"
+        with _global_lock:
+            _global_store[path] = payload
+        return path
+
+    def load(self, filepath: str) -> bytes:
+        with _global_lock:
+            data = _global_store.get(filepath)
+        if data is None:
+            raise FileNotFoundError(filepath)
+        return data
+
+    def remove(self, filepath: str) -> None:
+        with _global_lock:
+            _global_store.pop(filepath, None)
+
+    @staticmethod
+    def reset() -> None:
+        with _global_lock:
+            _global_store.clear()
+
+
+class FileSnapshotStorage:
+    """Durable snapshot files with atomic finalize.
+
+    Layout: <root>/snapshot-<shard>-<replica>-<index>/snapshot.bin
+    written to a .generating temp dir, fsynced, then renamed — the rename
+    is the commit point (reference: internal/fileutil [U]).
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, shard_id: int, replica_id: int, index: int) -> str:
+        return os.path.join(
+            self.root, f"snapshot-{shard_id}-{replica_id}-{index:020d}"
+        )
+
+    def save(self, shard_id: int, replica_id: int, index: int, payload: bytes) -> str:
+        final = self._dir(shard_id, replica_id, index)
+        tmp = final + ".generating"
+        if os.path.exists(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        fpath = os.path.join(tmp, "snapshot.bin")
+        with open(fpath, "wb") as f:
+            f.write(_checksum(payload))
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, final)
+        # fsync the parent so the rename itself is durable
+        dfd = os.open(self.root, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return os.path.join(final, "snapshot.bin")
+
+    def load(self, filepath: str) -> bytes:
+        with open(filepath, "rb") as f:
+            crc = f.read(4)
+            payload = f.read()
+        if _checksum(payload) != crc:
+            raise IOError(f"snapshot checksum mismatch: {filepath}")
+        return payload
+
+    def remove(self, filepath: str) -> None:
+        import shutil
+
+        d = os.path.dirname(filepath)
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)

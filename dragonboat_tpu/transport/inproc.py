@@ -1,0 +1,109 @@
+"""In-process loopback transport.
+
+reference: the chan-based test transports in internal/transport [U].
+Multiple NodeHosts in one process register by address in a module-level
+network table; delivery is a direct call into the receiver's handler
+(which only enqueues — cheap and deadlock-free).  Supports fault
+injection (drop/partition hooks) for chaos tests.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ..pb import Chunk, MessageBatch
+from ..raftio import (
+    ChunkHandler,
+    IConnection,
+    ISnapshotConnection,
+    ITransport,
+    MessageHandler,
+)
+
+_network_lock = threading.Lock()
+_network: Dict[str, "InProcTransport"] = {}
+
+
+def reset_inproc_network() -> None:
+    with _network_lock:
+        _network.clear()
+
+
+class _InProcConnection(IConnection):
+    def __init__(self, owner: "InProcTransport", target: str):
+        self.owner = owner
+        self.target = target
+
+    def close(self) -> None:
+        pass
+
+    def send_message_batch(self, batch: MessageBatch) -> None:
+        with _network_lock:
+            peer = _network.get(self.target)
+        if peer is None or peer._closed:
+            raise ConnectionError(f"no transport at {self.target}")
+        if self.owner.drop_hook and self.owner.drop_hook(self.target, batch):
+            return  # chaos: silently dropped
+        peer.deliver(batch)
+
+
+class _InProcSnapshotConnection(ISnapshotConnection):
+    def __init__(self, owner: "InProcTransport", target: str):
+        self.owner = owner
+        self.target = target
+
+    def close(self) -> None:
+        pass
+
+    def send_chunk(self, chunk: Chunk) -> None:
+        with _network_lock:
+            peer = _network.get(self.target)
+        if peer is None or peer._closed:
+            raise ConnectionError(f"no transport at {self.target}")
+        if self.owner.drop_hook and self.owner.drop_hook(self.target, chunk):
+            return
+        if not peer.deliver_chunk(chunk):
+            raise ConnectionError(f"chunk rejected by {self.target}")
+
+
+class InProcTransport(ITransport):
+    def __init__(
+        self,
+        address: str,
+        message_handler: MessageHandler,
+        chunk_handler: Optional[ChunkHandler] = None,
+    ):
+        self.address = address
+        self.message_handler = message_handler
+        self.chunk_handler = chunk_handler
+        self._closed = False
+        # chaos-injection hook: (target, batch_or_chunk) -> drop?
+        self.drop_hook: Optional[Callable] = None
+
+    def name(self) -> str:
+        return "inproc"
+
+    def start(self) -> None:
+        with _network_lock:
+            _network[self.address] = self
+
+    def close(self) -> None:
+        self._closed = True
+        with _network_lock:
+            if _network.get(self.address) is self:
+                del _network[self.address]
+
+    def get_connection(self, target: str) -> IConnection:
+        return _InProcConnection(self, target)
+
+    def get_snapshot_connection(self, target: str) -> ISnapshotConnection:
+        return _InProcSnapshotConnection(self, target)
+
+    def deliver(self, batch: MessageBatch) -> None:
+        if not self._closed:
+            self.message_handler(batch)
+
+    def deliver_chunk(self, chunk: Chunk) -> bool:
+        if self._closed or self.chunk_handler is None:
+            return False
+        return self.chunk_handler(chunk)

@@ -1,0 +1,174 @@
+"""Transport core: per-target async send queues, batch coalescing and a
+circuit breaker, over any pluggable ITransport.
+
+reference: internal/transport/transport.go (+ job.go) [U].  The raft step
+path calls ``send(msg)`` which never blocks: messages go to a bounded
+per-target queue drained by a sender thread that coalesces them into one
+``MessageBatch`` per wakeup.  Send failures trip a per-target breaker and
+surface as ReportUnreachableNode so leaders back off (reference: circuit
+breaker util [U]).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from .. import settings
+from ..logger import get_logger
+from ..pb import Message, MessageBatch, MessageType
+from ..raftio import ITransport
+
+_log = get_logger("transport")
+
+
+class _Breaker:
+    """Minimal circuit breaker: open after N consecutive failures, half-open
+    after a cooldown."""
+
+    def __init__(self, threshold: int = 3, cooldown: float = 1.0):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.failures = 0
+        self.opened_at = 0.0
+
+    def ready(self) -> bool:
+        if self.failures < self.threshold:
+            return True
+        return (time.monotonic() - self.opened_at) >= self.cooldown
+
+    def success(self) -> None:
+        self.failures = 0
+
+    def failure(self) -> None:
+        self.failures += 1
+        if self.failures >= self.threshold:
+            self.opened_at = time.monotonic()
+
+
+class _SendQueue:
+    def __init__(self, maxlen: int):
+        self.q: deque = deque()
+        self.maxlen = maxlen
+        self.cond = threading.Condition()
+        self.closed = False
+
+
+class Transport:
+    """The messaging service shared by all shards of a NodeHost."""
+
+    def __init__(
+        self,
+        raw: ITransport,
+        resolver: Callable[[int, int], Optional[str]],
+        source_address: str,
+        deployment_id: int = 0,
+        unreachable_cb: Optional[Callable[[Message], None]] = None,
+    ):
+        self.raw = raw
+        self.resolver = resolver
+        self.source_address = source_address
+        self.deployment_id = deployment_id
+        self.unreachable_cb = unreachable_cb
+        self._queues: Dict[str, _SendQueue] = {}
+        self._breakers: Dict[str, _Breaker] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.metrics = {"sent": 0, "dropped": 0, "failed": 0}
+
+    def start(self) -> None:
+        self.raw.start()
+
+    def close(self) -> None:
+        self._stopped = True
+        with self._lock:
+            queues = list(self._queues.values())
+        for sq in queues:
+            with sq.cond:
+                sq.closed = True
+                sq.cond.notify_all()
+        for t in list(self._threads.values()):
+            t.join(timeout=2.0)
+        self.raw.close()
+
+    # -- send path --------------------------------------------------------
+    def send(self, m: Message) -> bool:
+        """Non-blocking enqueue; False if the message was dropped."""
+        if self._stopped:
+            return False
+        target = self.resolver(m.shard_id, m.to)
+        if target is None:
+            self.metrics["dropped"] += 1
+            return False
+        sq = self._get_queue(target)
+        with sq.cond:
+            if sq.closed or len(sq.q) >= sq.maxlen:
+                self.metrics["dropped"] += 1
+                return False
+            sq.q.append(m)
+            sq.cond.notify()
+        return True
+
+    def _get_queue(self, target: str) -> _SendQueue:
+        with self._lock:
+            sq = self._queues.get(target)
+            if sq is None:
+                sq = _SendQueue(settings.Soft.send_queue_length)
+                self._queues[target] = sq
+                self._breakers[target] = _Breaker()
+                t = threading.Thread(
+                    target=self._sender_main,
+                    args=(target, sq),
+                    daemon=True,
+                    name=f"tpu-raft-send-{target}",
+                )
+                self._threads[target] = t
+                t.start()
+            return sq
+
+    def _sender_main(self, target: str, sq: _SendQueue) -> None:
+        breaker = self._breakers[target]
+        conn = None
+        while True:
+            with sq.cond:
+                while not sq.q and not sq.closed:
+                    sq.cond.wait(timeout=1.0)
+                    if self._stopped:
+                        return
+                if sq.closed and not sq.q:
+                    return
+                msgs = list(sq.q)
+                sq.q.clear()
+            if not breaker.ready():
+                self.metrics["dropped"] += len(msgs)
+                self._notify_unreachable(msgs)
+                continue
+            batch = MessageBatch(
+                messages=tuple(msgs),
+                source_address=self.source_address,
+                deployment_id=self.deployment_id,
+            )
+            try:
+                if conn is None:
+                    conn = self.raw.get_connection(target)
+                conn.send_message_batch(batch)
+                breaker.success()
+                self.metrics["sent"] += len(msgs)
+            except Exception as e:  # noqa: BLE001 — any transport error
+                _log.debug("send to %s failed: %s", target, e)
+                breaker.failure()
+                self.metrics["failed"] += len(msgs)
+                conn = None
+                self._notify_unreachable(msgs)
+
+    def _notify_unreachable(self, msgs) -> None:
+        if self.unreachable_cb is None:
+            return
+        seen = set()
+        for m in msgs:
+            key = (m.shard_id, m.to)
+            if key not in seen:
+                seen.add(key)
+                self.unreachable_cb(m)
