@@ -17,8 +17,11 @@ from dragonboat_tpu import (
     IStateMachine,
     NodeHost,
     NodeHostConfig,
+    RequestDropped,
     RequestRejected,
     Result,
+    SystemBusy,
+    TimeoutError_,
 )
 from dragonboat_tpu.transport.inproc import reset_inproc_network
 from dragonboat_tpu.storage.snapshotter import InMemSnapshotStorage
@@ -92,6 +95,23 @@ def cluster():
     yield nhs
     for nh in nhs.values():
         nh.close()
+
+
+def propose_r(nh, session, cmd, deadline=10.0):
+    """sync_propose with retry on drop/timeout.
+
+    Mirrors the reference's nodehost_test.go pattern [U]: during election
+    churn a proposal may be legitimately dropped (no known leader) or time
+    out (forwarded to a dead leader); clients retry.
+    """
+    end = time.time() + deadline
+    while True:
+        try:
+            return nh.sync_propose(session, cmd, timeout=1.0)
+        except (TimeoutError_, RequestDropped, SystemBusy):
+            if time.time() >= end:
+                raise
+            time.sleep(0.02)
 
 
 def wait_for_leader(nhs, shard_id=1, timeout=5.0):
@@ -246,8 +266,9 @@ class TestSnapshotAndRestart:
         # crash replica 3's nodehost, keep its "disk" (logdb instance)
         logdb3 = cluster[3].logdb
         cluster[3].close()
-        # cluster continues with quorum 2
-        nh.sync_propose(s, set_cmd("while-down", b"v"))
+        # cluster continues with quorum 2 (retry: the dead replica may have
+        # been the leader, so the first attempts can land on a dead forward)
+        propose_r(nh, s, set_cmd("while-down", b"v"))
         # restart replica 3 on the same logdb
         cfg = NodeHostConfig(
             nodehost_dir="/tmp/nh-3",
@@ -315,11 +336,11 @@ class TestSnapshotCatchUp:
         fid = 1 + (lid % 3)
         cluster[fid].close()
         for i in range(30):
-            nh.sync_propose(s, set_cmd(f"cp{i}", b"v"))
+            propose_r(nh, s, set_cmd(f"cp{i}", b"v"))
         # snapshot + aggressive compaction while the follower is down
         nh.sync_request_snapshot(1, compaction_overhead=1)
         for i in range(5):
-            nh.sync_propose(s, set_cmd(f"post{i}", b"v"))
+            propose_r(nh, s, set_cmd(f"post{i}", b"v"))
         # restart the follower on a FRESH logdb: it must need the snapshot
         cfg = NodeHostConfig(
             nodehost_dir=f"/tmp/nh-{fid}",
@@ -362,12 +383,13 @@ class TestMultiShard:
     def test_two_shards_one_nodehost(self, cluster):
         for rid, nh in cluster.items():
             nh.start_replica(ADDRS, False, KVStore, shard_config(rid, shard_id=2))
+        wait_for_leader(cluster, shard_id=1)
         wait_for_leader(cluster, shard_id=2)
         nh = cluster[2]
         s1 = nh.get_noop_session(1)
         s2 = nh.get_noop_session(2)
-        nh.sync_propose(s1, set_cmd("in-shard-1", b"a"))
-        nh.sync_propose(s2, set_cmd("in-shard-2", b"b"))
+        propose_r(nh, s1, set_cmd("in-shard-1", b"a"))
+        propose_r(nh, s2, set_cmd("in-shard-2", b"b"))
         assert nh.sync_read(1, "in-shard-1") == b"a"
         assert nh.sync_read(2, "in-shard-2") == b"b"
         assert nh.sync_read(2, "in-shard-1") is None
