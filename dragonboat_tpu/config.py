@@ -89,6 +89,7 @@ class ExpertConfig:
     logdb_factory: Optional[Callable] = None
     transport_factory: Optional[Callable] = None
     step_engine_factory: Optional[Callable] = None
+    snapshot_storage_factory: Optional[Callable] = None
     fs: Optional[object] = None              # vfs injection for tests
     test_node_host_id: int = 0
     test_gossip_probe_interval_ms: int = 0

@@ -153,10 +153,12 @@ class ExecEngine:
 
     # -- registration -----------------------------------------------------
     def register(self, node: "Node") -> None:
-        with self._nodes_lock:
-            self._nodes[node.shard_id] = node
+        # callbacks must be in place before the node is visible to workers:
+        # a stale workReady entry for this shard id can step it immediately
         node.notify_work = lambda s=node.shard_id: self.step_ready.notify(s)
         node.engine_apply_ready = lambda s: self.apply_ready.notify(s)
+        with self._nodes_lock:
+            self._nodes[node.shard_id] = node
         self.step_ready.notify(node.shard_id)
 
     def unregister(self, shard_id: int) -> None:

@@ -99,6 +99,15 @@ class Node:
         self.stopped = False
         self._snapshotting = False
         self._applied_since_snapshot = 0
+        # superseded snapshot files are kept for one extra generation: an
+        # InstallSnapshot message produced earlier in the SAME step() can
+        # still reference the previous file at transport-send time (the
+        # payload is read synchronously on this worker; see
+        # Transport.send_snapshot)
+        self._retired_snapshots: List[str] = []
+        # serializes apply() against stop() so the user SM is never closed
+        # mid-update
+        self._apply_lock = threading.Lock()
         # set by the engine at registration; wakes the owning step worker
         self.notify_work: Optional[Callable[[], None]] = None
 
@@ -290,12 +299,7 @@ class Node:
         if proposals:
             self.peer.propose_entries(proposals)
         for key, cc in config_changes:
-            entry = Entry(
-                type=EntryType.CONFIG_CHANGE, key=key, cmd=pickle.dumps(cc)
-            )
-            self.peer.raft.handle(
-                Message(type=MessageType.PROPOSE, entries=(entry,))
-            )
+            self.peer.propose_config_change(cc, key)
         for ctx in read_indexes:
             self.peer.read_index(ctx)
         for target in transfers:
@@ -325,7 +329,9 @@ class Node:
             # route by entry kind: proposal and config-change futures live
             # in different tables with independent key spaces
             if e.type == EntryType.CONFIG_CHANGE:
-                self.pending_config_change.applied(e.key, rejected=True)
+                # transient (no leader), not a membership-validation reject:
+                # clients should retry
+                self.pending_config_change.dropped(e.key)
             else:
                 self.pending_proposal.dropped(e.key)
         for ctx in u.dropped_read_indexes:
@@ -398,6 +404,12 @@ class Node:
     def apply(self) -> None:
         """Drain the task queue through the RSM (reference:
         engine applyWorkerMain -> rsm Handle [U])."""
+        with self._apply_lock:
+            if self.stopped:
+                return
+            self._apply_locked()
+
+    def _apply_locked(self) -> None:
         for task in self.sm.task_queue.get_all():
             if task.type == TaskType.ENTRIES:
                 results = self.sm.handle(task)
@@ -448,7 +460,22 @@ class Node:
             self.sm.last_applied = max(self.sm.last_applied, ss.index)
             self.sm.members.restore(ss.membership)
             return
-        payload = self.snapshot_storage.load(ss.filepath)
+        try:
+            payload = self.snapshot_storage.load(ss.filepath)
+        except (FileNotFoundError, IOError) as e:
+            # the raft log was already reset to ss.index; applying anything
+            # past it without this state would silently diverge — halt the
+            # replica loudly instead (reference: dragonboat panics on
+            # snapshot recovery failure [U])
+            _log.critical(
+                "[%d:%d] FATAL: snapshot %d unrecoverable (%s); halting replica",
+                self.shard_id,
+                self.replica_id,
+                ss.index,
+                e,
+            )
+            self.stopped = True
+            raise
         self.sm.recover_from_snapshot_data(payload)
         self._sync_registry(ss.membership)
         if self.events is not None:
@@ -470,7 +497,14 @@ class Node:
             return
         self._snapshotting = True
         try:
-            payload, index, term = self.sm.save_snapshot_data()
+            # _apply_lock serializes against stop(): the user SM must not be
+            # closed mid-save (stop_shard can race a step worker)
+            with self._apply_lock:
+                if self.stopped:
+                    if key:
+                        self.pending_snapshot.done(key, 0, failed=True)
+                    return
+                payload, index, term = self.sm.save_snapshot_data()
             if index == 0:
                 if key:
                     self.pending_snapshot.done(key, 0, failed=True)
@@ -508,7 +542,8 @@ class Node:
                     self.shard_id, self.replica_id, compact_to
                 )
             if not prev.is_empty():
-                self.snapshot_storage.remove(prev.filepath)
+                self._retired_snapshots.append(prev.filepath)
+                self._gc_retired_snapshots()
             if key:
                 self.pending_snapshot.done(key, index)
             if self.events is not None:
@@ -523,6 +558,13 @@ class Node:
                     )
         finally:
             self._snapshotting = False
+
+    def _gc_retired_snapshots(self) -> None:
+        """Delete superseded snapshot files, keeping the newest retiree one
+        generation longer (see the field comment)."""
+        for p in self._retired_snapshots[:-1]:
+            self.snapshot_storage.remove(p)
+        del self._retired_snapshots[:-1]
 
     # ------------------------------------------------------------------
     def get_membership(self) -> Membership:
@@ -542,4 +584,12 @@ class Node:
         self.pending_config_change.drop_all()
         self.pending_snapshot.drop_all()
         self.pending_leader_transfer.drop_all()
-        self.sm.managed.close()
+        # retired files can't be referenced once this replica is down
+        # (receivers own their streamed copies); reclaim them so restarts
+        # don't orphan files
+        for p in self._retired_snapshots:
+            self.snapshot_storage.remove(p)
+        self._retired_snapshots = []
+        # wait for any in-flight apply before closing the user SM
+        with self._apply_lock:
+            self.sm.managed.close()

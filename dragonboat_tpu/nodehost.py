@@ -7,6 +7,7 @@ live here.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -35,8 +36,9 @@ from .request import (
 )
 from .statemachine import Result
 from .storage.logdb import InMemLogDB
-from .storage.snapshotter import InMemSnapshotStorage
+from .storage.snapshotter import FileSnapshotStorage, InMemSnapshotStorage
 from .transport import InProcTransport, Registry, Transport
+from .transport.chunk import ChunkSink
 
 _log = get_logger("nodehost")
 
@@ -88,16 +90,41 @@ class NodeHost:
         self.logdb = (
             expert.logdb_factory(config) if expert.logdb_factory else InMemLogDB()
         )
-        self.snapshot_storage = InMemSnapshotStorage()
+        if expert.snapshot_storage_factory:
+            self.snapshot_storage = expert.snapshot_storage_factory(config)
+        else:
+            # snapshots are durable by default, rooted in the nodehost dir
+            # (reference: snapshot dirs under NodeHostDir [U])
+            import os
+
+            self.snapshot_storage = FileSnapshotStorage(
+                os.path.join(config.nodehost_dir, "snapshots")
+            )
         self.registry = Registry()
         self.events = EventFanout(
             config.raft_event_listener, config.system_event_listener
         )
 
+        # received snapshots get a unique suffix: re-streams of the same
+        # index must never clobber a file a queued recover task still wants
+        self._rx_snapshot_seq = itertools.count(1)
+        self._chunk_sink = ChunkSink(
+            save_fn=lambda s, r, i, p: self.snapshot_storage.save(
+                s, r, i, p, suffix=f"rx{next(self._rx_snapshot_seq)}"
+            ),
+            deliver_fn=self._deliver_received_snapshot,
+            confirm_fn=self._confirm_received_snapshot,
+        )
         raw_transport = (
-            expert.transport_factory(config, self._handle_message_batch)
+            expert.transport_factory(
+                config, self._handle_message_batch, self._chunk_sink.add
+            )
             if expert.transport_factory
-            else InProcTransport(config.raft_address, self._handle_message_batch)
+            else InProcTransport(
+                config.raft_address,
+                self._handle_message_batch,
+                self._chunk_sink.add,
+            )
         )
         self.transport = Transport(
             raw_transport,
@@ -105,6 +132,8 @@ class NodeHost:
             config.raft_address,
             config.deployment_id,
             unreachable_cb=self._report_unreachable,
+            snapshot_payload_loader=self._load_snapshot_payload,
+            snapshot_status_cb=self._report_snapshot_status,
         )
         self.transport.start()
 
@@ -140,8 +169,11 @@ class NodeHost:
             self._nodes.clear()
         for n in nodes:
             self.engine.unregister(n.shard_id)
-            n.stop()
+        # join worker threads before closing the user SMs: an apply worker
+        # may still be inside sm.handle
         self.engine.stop()
+        for n in nodes:
+            n.stop()
         self.transport.close()
         self.logdb.close()
         self.events.close()
@@ -228,6 +260,49 @@ class NodeHost:
                 touched.add(m.shard_id)
         if touched:
             self.engine.notify_many(touched)
+
+    # -- snapshot streaming plumbing -----------------------------------
+    def _load_snapshot_payload(self, ss) -> bytes:
+        return self.snapshot_storage.load(ss.filepath)
+
+    def _deliver_received_snapshot(self, m: Message) -> None:
+        """A fully-reassembled snapshot enters the raft path like any other
+        received message."""
+        self._handle_message_batch(MessageBatch(messages=(m,)))
+
+    def _confirm_received_snapshot(
+        self, shard_id: int, from_replica: int, to_replica: int
+    ) -> None:
+        """Tell the sender its stream arrived (reference: the receiving
+        side's SnapshotReceived message [U])."""
+        self.transport.send(
+            Message(
+                type=MessageType.SNAPSHOT_RECEIVED,
+                shard_id=shard_id,
+                from_=to_replica,
+                to=from_replica,
+            )
+        )
+
+    def _report_snapshot_status(
+        self, shard_id: int, to_replica: int, failed: bool
+    ) -> None:
+        """A stream job finished/failed: tell the local sending peer
+        (reference: ReportSnapshotStatus [U])."""
+        with self._nodes_lock:
+            node = self._nodes.get(shard_id)
+        if node is None:
+            return
+        node.enqueue_received(
+            Message(
+                type=MessageType.SNAPSHOT_STATUS,
+                shard_id=shard_id,
+                from_=to_replica,
+                to=node.replica_id,
+                reject=failed,
+            )
+        )
+        self.engine.notify(shard_id)
 
     def _report_unreachable(self, m) -> None:
         with self._nodes_lock:
@@ -321,14 +396,8 @@ class NodeHost:
         rs = node.request_config_change(cc, self._timeout_ticks(timeout))
         self.engine.notify(shard_id)
         _check(rs.wait(timeout), rs)
-        if cc.type in (
-            ConfigChangeType.ADD_REPLICA,
-            ConfigChangeType.ADD_NON_VOTING,
-            ConfigChangeType.ADD_WITNESS,
-        ):
-            self.registry.add(shard_id, cc.replica_id, cc.address)
-        else:
-            self.registry.remove(shard_id, cc.replica_id)
+        # registry sync happens in Node._complete_applied on every replica
+        # when the config-change entry applies; nothing extra to do here
 
     def sync_request_add_replica(
         self,

@@ -380,6 +380,9 @@ class Chunk:
     chunk_count: int = 0
     index: int = 0
     term: int = 0
+    # the carrying InstallSnapshot message's term (the raft term gate on the
+    # receiver needs it; chunk.term above is the snapshot's log term)
+    message_term: int = 0
     data: bytes = b""
     membership: Membership = field(default_factory=Membership)
     filepath: str = ""

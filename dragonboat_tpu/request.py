@@ -98,6 +98,11 @@ class _PendingBase:
         with self._lock:
             return self._pending.pop(key, None)
 
+    def dropped(self, key: int) -> None:
+        rs = self.pop(key)
+        if rs is not None:
+            rs.notify(RequestResultCode.DROPPED)
+
     def gc(self, now_tick: int) -> None:
         with self._lock:
             expired = [
@@ -105,6 +110,12 @@ class _PendingBase:
             ]
             for k in expired:
                 self._pending.pop(k).notify(RequestResultCode.TIMEOUT)
+            if expired:
+                self._gc_extra(set(expired))
+
+    def _gc_extra(self, expired_keys) -> None:
+        """Subclass hook, called under self._lock, to drop side-table state
+        for expired keys."""
 
     def drop_all(self, code: RequestResultCode = RequestResultCode.TERMINATED):
         with self._lock:
@@ -150,11 +161,6 @@ class PendingProposal(_PendingBase):
         if rs is not None:
             rs.notify_committed()
 
-    def dropped(self, key: int) -> None:
-        rs = self.pop(key)
-        if rs is not None:
-            rs.notify(RequestResultCode.DROPPED)
-
 
 class PendingReadIndex(_PendingBase):
     """reference: pendingReadIndex [U].  Two stages: (1) ctx confirmed by
@@ -165,6 +171,14 @@ class PendingReadIndex(_PendingBase):
         super().__init__()
         self._ctx_map: Dict[Tuple[int, int], int] = {}  # ctx -> key
         self._waiting: List[Tuple[int, int]] = []  # (read_index, key)
+
+    def _gc_extra(self, expired_keys) -> None:
+        self._ctx_map = {
+            c: k for c, k in self._ctx_map.items() if k not in expired_keys
+        }
+        self._waiting = [
+            (i, k) for i, k in self._waiting if k not in expired_keys
+        ]
 
     def read(self, deadline: int) -> Tuple[SystemCtx, RequestState]:
         rs = self._alloc(deadline)

@@ -125,6 +125,10 @@ class StateMachine:
             raise ValueError("handle() only processes entry tasks")
         results: List[ApplyResult] = []
         batch: List[Tuple[Entry, SMEntry]] = []
+        # session keys already queued in `batch` but not yet recorded in the
+        # session store: a retried proposal can commit twice in one batch,
+        # and dedupe must catch the second copy even before flush()
+        batch_keys: set = set()
 
         def flush():
             if not batch:
@@ -135,6 +139,7 @@ class StateMachine:
                 self._record_session_result(entry, se.result)
                 results.append(ApplyResult(entry=entry, result=se.result))
             batch.clear()
+            batch_keys.clear()
 
         with self._mu:
             for e in task.entries:
@@ -153,6 +158,14 @@ class StateMachine:
                     flush()
                     results.append(self._handle_unregister(e))
                 else:
+                    if (
+                        e.is_session_managed()
+                        and (e.client_id, e.series_id) in batch_keys
+                    ):
+                        # duplicate of an entry queued in this same batch:
+                        # apply the queued copy first so the session store
+                        # has its result, then dedupe normally
+                        flush()
                     dup = self._check_duplicate(e)
                     if dup is not None:
                         results.append(dup)
@@ -160,6 +173,8 @@ class StateMachine:
                         self._advance(e)  # witnesses never run user code
                     else:
                         batch.append((e, SMEntry(index=e.index, cmd=e.cmd)))
+                        if e.is_session_managed():
+                            batch_keys.add((e.client_id, e.series_id))
                         self._advance(e)
             flush()
         return results

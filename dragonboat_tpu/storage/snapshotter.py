@@ -13,40 +13,48 @@ from __future__ import annotations
 import os
 import threading
 import zlib
-from typing import Dict, Optional, Tuple
-
-_global_lock = threading.Lock()
-_global_store: Dict[str, bytes] = {}
-
+from typing import Dict
 
 def _checksum(data: bytes) -> bytes:
     return zlib.crc32(data).to_bytes(4, "little")
 
 
 class InMemSnapshotStorage:
-    """Keys are synthetic 'paths' so pb.Snapshot.filepath stays meaningful."""
+    """Per-NodeHost in-memory store; keys are synthetic 'paths' so
+    pb.Snapshot.filepath stays meaningful.  Deliberately NOT shared between
+    hosts: snapshots cross hosts only via the transport chunk lane, exactly
+    as in the reference."""
 
-    def save(self, shard_id: int, replica_id: int, index: int, payload: bytes) -> str:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, bytes] = {}
+
+    def save(
+        self,
+        shard_id: int,
+        replica_id: int,
+        index: int,
+        payload: bytes,
+        suffix: str = "",
+    ) -> str:
         path = f"mem://snapshot-{shard_id}-{replica_id}-{index:020d}"
-        with _global_lock:
-            _global_store[path] = payload
+        if suffix:
+            path += f"-{suffix}"
+        with self._lock:
+            self._store[path] = payload
         return path
 
     def load(self, filepath: str) -> bytes:
-        with _global_lock:
-            data = _global_store.get(filepath)
+        with self._lock:
+            data = self._store.get(filepath)
         if data is None:
             raise FileNotFoundError(filepath)
         return data
 
     def remove(self, filepath: str) -> None:
-        with _global_lock:
-            _global_store.pop(filepath, None)
+        with self._lock:
+            self._store.pop(filepath, None)
 
-    @staticmethod
-    def reset() -> None:
-        with _global_lock:
-            _global_store.clear()
 
 
 class FileSnapshotStorage:
@@ -61,18 +69,32 @@ class FileSnapshotStorage:
         self.root = root
         os.makedirs(root, exist_ok=True)
 
-    def _dir(self, shard_id: int, replica_id: int, index: int) -> str:
-        return os.path.join(
-            self.root, f"snapshot-{shard_id}-{replica_id}-{index:020d}"
-        )
+    def _dir(
+        self, shard_id: int, replica_id: int, index: int, suffix: str = ""
+    ) -> str:
+        name = f"snapshot-{shard_id}-{replica_id}-{index:020d}"
+        if suffix:
+            name += f"-{suffix}"
+        return os.path.join(self.root, name)
 
-    def save(self, shard_id: int, replica_id: int, index: int, payload: bytes) -> str:
-        final = self._dir(shard_id, replica_id, index)
+    def save(
+        self,
+        shard_id: int,
+        replica_id: int,
+        index: int,
+        payload: bytes,
+        suffix: str = "",
+    ) -> str:
+        import shutil
+
+        final = self._dir(shard_id, replica_id, index, suffix)
         tmp = final + ".generating"
         if os.path.exists(tmp):
-            import shutil
-
             shutil.rmtree(tmp)
+        if os.path.exists(final):
+            # leftover from an earlier incarnation of this replica id (the
+            # rename below cannot clobber a non-empty dir)
+            shutil.rmtree(final)
         os.makedirs(tmp)
         fpath = os.path.join(tmp, "snapshot.bin")
         with open(fpath, "wb") as f:

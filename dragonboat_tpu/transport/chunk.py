@@ -1,0 +1,148 @@
+"""Snapshot chunking: split on the sender, reassemble on the receiver.
+
+reference: internal/transport/chunk.go (splitSnapshotMessage, Chunk.Add)
+[U].  A snapshot never travels as one message: the sender reads the
+snapshot payload ONCE (synchronously, while the file is guaranteed live)
+and streams fixed-size chunks over the snapshot lane; the receiver
+reassembles them into its OWN local snapshot storage and only then
+injects the InstallSnapshot message into the raft path.  Replicas never
+share snapshot files by path — each host owns its copy, exactly as the
+reference's chunk protocol guarantees.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import settings
+from ..logger import get_logger
+from ..pb import Chunk, Message, MessageType, Snapshot
+
+_log = get_logger("transport")
+
+
+def split_snapshot_message(
+    m: Message, payload: bytes, chunk_size: Optional[int] = None
+) -> List[Chunk]:
+    """Split an InstallSnapshot message + its payload into wire chunks
+    (reference: splitSnapshotMessage [U])."""
+    ss = m.snapshot
+    size = chunk_size or settings.Soft.snapshot_chunk_size
+    if ss.dummy or not payload:
+        pieces = [b""]
+    else:
+        pieces = [payload[i : i + size] for i in range(0, len(payload), size)]
+    count = len(pieces)
+    return [
+        Chunk(
+            shard_id=m.shard_id,
+            replica_id=m.to,
+            from_=m.from_,
+            chunk_id=i,
+            chunk_size=len(piece),
+            chunk_count=count,
+            index=ss.index,
+            term=ss.term,
+            message_term=m.term,
+            data=piece,
+            membership=ss.membership,
+            filepath=ss.filepath,
+            file_size=len(payload),
+            witness=ss.witness,
+            dummy=ss.dummy,
+            on_disk_index=ss.on_disk_index,
+        )
+        for i, piece in enumerate(pieces)
+    ]
+
+
+class _InFlight:
+    __slots__ = ("pieces", "next_chunk", "count")
+
+    def __init__(self, count: int):
+        self.pieces: List[bytes] = []
+        self.next_chunk = 0
+        self.count = count
+
+
+class ChunkSink:
+    """Receiver-side reassembly, one in-flight snapshot per (shard, sender)
+    (reference: transport.Chunk tracking in-flight state per key [U]).
+
+    ``save_fn(shard_id, replica_id, index, payload) -> filepath`` persists
+    into the receiver's local snapshot storage; ``deliver_fn(message)``
+    hands the reconstituted InstallSnapshot to the raft path;
+    ``confirm_fn(shard_id, from_replica, to_replica)`` sends
+    SnapshotReceived back to the sender.
+    """
+
+    def __init__(
+        self,
+        save_fn: Callable[[int, int, int, bytes], str],
+        deliver_fn: Callable[[Message], None],
+        confirm_fn: Optional[Callable[[int, int, int], None]] = None,
+    ):
+        self.save_fn = save_fn
+        self.deliver_fn = deliver_fn
+        self.confirm_fn = confirm_fn
+        self._lock = threading.Lock()
+        self._inflight: Dict[Tuple[int, int], _InFlight] = {}
+
+    def add(self, c: Chunk) -> bool:
+        """Accept one chunk; returns False to make the sender abort the
+        stream (out-of-order / mismatched chunk)."""
+        key = (c.shard_id, c.from_)
+        with self._lock:
+            fl = self._inflight.get(key)
+            if c.chunk_id == 0:
+                fl = _InFlight(c.chunk_count)
+                self._inflight[key] = fl
+            elif fl is None or c.chunk_id != fl.next_chunk:
+                _log.warning(
+                    "out-of-order chunk %d for shard %d from %d",
+                    c.chunk_id,
+                    c.shard_id,
+                    c.from_,
+                )
+                self._inflight.pop(key, None)
+                return False
+            fl.pieces.append(c.data)
+            fl.next_chunk = c.chunk_id + 1
+            done = fl.next_chunk == fl.count
+            if done:
+                self._inflight.pop(key, None)
+        if done:
+            self._complete(c, b"".join(fl.pieces))
+        return True
+
+    def _complete(self, last: Chunk, payload: bytes) -> None:
+        if last.dummy:
+            filepath = ""
+        else:
+            filepath = self.save_fn(
+                last.shard_id, last.replica_id, last.index, payload
+            )
+        ss = Snapshot(
+            filepath=filepath,
+            file_size=last.file_size,
+            index=last.index,
+            term=last.term,
+            membership=last.membership,
+            dummy=last.dummy,
+            witness=last.witness,
+            shard_id=last.shard_id,
+            replica_id=last.replica_id,
+            on_disk_index=last.on_disk_index,
+        )
+        self.deliver_fn(
+            Message(
+                type=MessageType.INSTALL_SNAPSHOT,
+                shard_id=last.shard_id,
+                from_=last.from_,
+                to=last.replica_id,
+                term=last.message_term,
+                snapshot=ss,
+            )
+        )
+        if self.confirm_fn is not None:
+            self.confirm_fn(last.shard_id, last.from_, last.replica_id)

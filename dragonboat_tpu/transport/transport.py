@@ -65,12 +65,21 @@ class Transport:
         source_address: str,
         deployment_id: int = 0,
         unreachable_cb: Optional[Callable[[Message], None]] = None,
+        snapshot_payload_loader: Optional[Callable[[object], bytes]] = None,
+        snapshot_status_cb: Optional[Callable[[int, int, bool], None]] = None,
     ):
         self.raw = raw
         self.resolver = resolver
         self.source_address = source_address
         self.deployment_id = deployment_id
         self.unreachable_cb = unreachable_cb
+        # reads the snapshot payload at send time, while the caller (the
+        # shard's step worker) still guarantees the file exists
+        self.snapshot_payload_loader = snapshot_payload_loader
+        # (shard_id, to_replica, failed) -> report to the sending raft peer
+        self.snapshot_status_cb = snapshot_status_cb
+        self._stream_jobs = 0
+        self._stream_lock = threading.Lock()
         self._queues: Dict[str, _SendQueue] = {}
         self._breakers: Dict[str, _Breaker] = {}
         self._threads: Dict[str, threading.Thread] = {}
@@ -98,6 +107,8 @@ class Transport:
         """Non-blocking enqueue; False if the message was dropped."""
         if self._stopped:
             return False
+        if m.type == MessageType.INSTALL_SNAPSHOT:
+            return self.send_snapshot(m)
         target = self.resolver(m.shard_id, m.to)
         if target is None:
             self.metrics["dropped"] += 1
@@ -162,6 +173,78 @@ class Transport:
                 self.metrics["failed"] += len(msgs)
                 conn = None
                 self._notify_unreachable(msgs)
+
+    # -- snapshot lane ----------------------------------------------------
+    def send_snapshot(self, m: Message) -> bool:
+        """Stream a snapshot to the target over the chunk lane
+        (reference: Transport.SendSnapshot -> stream job [U]).
+
+        The payload is read synchronously — the calling step worker is the
+        only thread that garbage-collects this shard's snapshot files, so
+        the file cannot disappear underneath us; chunking + delivery then
+        run on a dedicated job thread like the reference's stream jobs.
+
+        TODO(perf): for very large snapshots this blocks the step worker
+        for the duration of one file read; move to incremental reads inside
+        the job under a file lease once on-disk SM streaming lands.
+        """
+        from .chunk import split_snapshot_message
+
+        if self._stopped:
+            return False
+        target = self.resolver(m.shard_id, m.to)
+        if target is None:
+            self._snapshot_failed(m)
+            return False
+        with self._stream_lock:
+            if self._stream_jobs >= settings.Soft.max_concurrent_streaming_snapshots:
+                self._snapshot_failed(m)
+                return False
+            self._stream_jobs += 1
+        try:
+            if m.snapshot.dummy or self.snapshot_payload_loader is None:
+                payload = b""
+            else:
+                payload = self.snapshot_payload_loader(m.snapshot)
+        except Exception as e:  # noqa: BLE001 — missing/corrupt local file
+            _log.warning("snapshot payload read failed: %s", e)
+            with self._stream_lock:
+                self._stream_jobs -= 1
+            self._snapshot_failed(m)
+            return False
+        chunks = split_snapshot_message(m, payload)
+        t = threading.Thread(
+            target=self._stream_job,
+            args=(m, target, chunks),
+            daemon=True,
+            name=f"tpu-raft-snapshot-{target}",
+        )
+        t.start()
+        return True
+
+    def _stream_job(self, m: Message, target: str, chunks) -> None:
+        try:
+            conn = self.raw.get_snapshot_connection(target)
+            try:
+                for c in chunks:
+                    if self._stopped:
+                        raise ConnectionError("transport stopped")
+                    conn.send_chunk(c)
+            finally:
+                conn.close()
+            self.metrics["snapshots_sent"] = self.metrics.get("snapshots_sent", 0) + 1
+        except Exception as e:  # noqa: BLE001 — any transport error
+            _log.warning("snapshot stream to %s failed: %s", target, e)
+            self._snapshot_failed(m)
+            if self.unreachable_cb is not None:
+                self.unreachable_cb(m)
+        finally:
+            with self._stream_lock:
+                self._stream_jobs -= 1
+
+    def _snapshot_failed(self, m: Message) -> None:
+        if self.snapshot_status_cb is not None:
+            self.snapshot_status_cb(m.shard_id, m.to, True)
 
     def _notify_unreachable(self, msgs) -> None:
         if self.unreachable_cb is None:

@@ -24,7 +24,6 @@ from dragonboat_tpu import (
     TimeoutError_,
 )
 from dragonboat_tpu.transport.inproc import reset_inproc_network
-from dragonboat_tpu.storage.snapshotter import InMemSnapshotStorage
 
 
 class KVStore(IStateMachine):
@@ -88,7 +87,11 @@ def shard_config(replica_id, shard_id=1, **kw):
 @pytest.fixture
 def cluster():
     reset_inproc_network()
-    InMemSnapshotStorage.reset()
+    # fresh durable dirs per test: snapshot files are real files now
+    import shutil
+
+    for rid in ADDRS:
+        shutil.rmtree(f"/tmp/nh-{rid}", ignore_errors=True)
     nhs = {rid: make_nodehost(rid) for rid in ADDRS}
     for rid, nh in nhs.items():
         nh.start_replica(ADDRS, False, KVStore, shard_config(rid))

@@ -1,0 +1,111 @@
+"""RSM apply-loop unit tests (reference: internal/rsm/*_test.go [U]):
+session dedupe, batching, membership bookkeeping — no raft, no I/O.
+"""
+from dragonboat_tpu.client import SERIES_ID_REGISTER
+from dragonboat_tpu.pb import Entry, EntryType
+from dragonboat_tpu.rsm.managed import ManagedStateMachine, SMType
+from dragonboat_tpu.rsm.statemachine import StateMachine, Task, TaskType
+from dragonboat_tpu.statemachine import IStateMachine, Result
+
+
+class CountingSM(IStateMachine):
+    def __init__(self):
+        self.applied = []
+
+    def update(self, entry):
+        self.applied.append(entry.cmd)
+        return Result(value=len(self.applied))
+
+    def lookup(self, query):
+        return self.applied
+
+    def save_snapshot(self, w, files, done):
+        pass
+
+    def recover_from_snapshot(self, r, files, done):
+        pass
+
+
+def make_sm():
+    inner = CountingSM()
+    sm = StateMachine(1, 1, ManagedStateMachine(inner, SMType.REGULAR))
+    return sm, inner
+
+
+def register_session(sm, client_id, index):
+    e = Entry(
+        type=EntryType.APPLICATION,
+        index=index,
+        term=1,
+        client_id=client_id,
+        series_id=SERIES_ID_REGISTER,
+    )
+    sm.handle(Task(type=TaskType.ENTRIES, entries=[e]))
+
+
+def app_entry(index, client_id, series_id, cmd=b"x", responded_to=0):
+    return Entry(
+        type=EntryType.APPLICATION,
+        index=index,
+        term=1,
+        client_id=client_id,
+        series_id=series_id,
+        responded_to=responded_to,
+        cmd=cmd,
+    )
+
+
+class TestSessionDedupe:
+    def test_duplicate_in_separate_batches(self):
+        sm, inner = make_sm()
+        register_session(sm, 7, 1)
+        r1 = sm.handle(Task(entries=[app_entry(2, 7, 1)]))
+        r2 = sm.handle(Task(entries=[app_entry(3, 7, 1)]))
+        assert len(inner.applied) == 1
+        assert r1[0].result.value == r2[0].result.value == 1
+
+    def test_duplicate_within_one_batch(self):
+        """A client retry can commit twice and land in the SAME applied
+        batch (e.g. a follower catching up); the second copy must be
+        deduped, not double-applied."""
+        sm, inner = make_sm()
+        register_session(sm, 7, 1)
+        results = sm.handle(
+            Task(entries=[app_entry(2, 7, 1), app_entry(3, 7, 1)])
+        )
+        assert len(inner.applied) == 1
+        assert len(results) == 2
+        # both futures observe the same (cached) result
+        assert results[0].result.value == results[1].result.value == 1
+        assert sm.last_applied == 3
+
+    def test_triplicate_within_one_batch(self):
+        sm, inner = make_sm()
+        register_session(sm, 9, 1)
+        results = sm.handle(
+            Task(
+                entries=[
+                    app_entry(2, 9, 1),
+                    app_entry(3, 9, 1),
+                    app_entry(4, 9, 1),
+                ]
+            )
+        )
+        assert len(inner.applied) == 1
+        assert [r.result.value for r in results] == [1, 1, 1]
+
+    def test_distinct_series_both_apply(self):
+        sm, inner = make_sm()
+        register_session(sm, 7, 1)
+        sm.handle(Task(entries=[app_entry(2, 7, 1), app_entry(3, 7, 2)]))
+        assert len(inner.applied) == 2
+
+    def test_responded_to_clears_history(self):
+        sm, inner = make_sm()
+        register_session(sm, 7, 1)
+        sm.handle(Task(entries=[app_entry(2, 7, 1)]))
+        # client acked series 1 -> history cleared -> replayed series 1 is
+        # treated as already-responded (rejected), never re-applied
+        r = sm.handle(Task(entries=[app_entry(3, 7, 1, responded_to=1)]))
+        assert len(inner.applied) == 1
+        assert r[0].rejected

@@ -1,0 +1,263 @@
+"""Transport-layer unit tests (reference: internal/transport/*_test.go
+[U]): chunk split/reassembly, snapshot lane term propagation, batching,
+circuit breaker.
+"""
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu.pb import Membership, Message, MessageType, Snapshot
+from dragonboat_tpu.raftio import IConnection, ISnapshotConnection, ITransport
+from dragonboat_tpu.storage.snapshotter import InMemSnapshotStorage
+from dragonboat_tpu.transport.chunk import ChunkSink, split_snapshot_message
+from dragonboat_tpu.transport.transport import Transport
+
+
+def make_install_msg(payload_size=0, term=5, dummy=False):
+    ss = Snapshot(
+        filepath="mem://src" if not dummy else "",
+        file_size=payload_size,
+        index=100,
+        term=3,
+        membership=Membership(addresses={1: "a", 2: "b"}),
+        dummy=dummy,
+        shard_id=7,
+        replica_id=2,
+    )
+    return Message(
+        type=MessageType.INSTALL_SNAPSHOT,
+        shard_id=7,
+        from_=1,
+        to=2,
+        term=term,
+        snapshot=ss,
+    )
+
+
+class TestSplit:
+    def test_split_sizes(self):
+        payload = bytes(range(256)) * 40  # 10240 bytes
+        chunks = split_snapshot_message(make_install_msg(), payload, 4096)
+        assert len(chunks) == 3
+        assert [c.chunk_id for c in chunks] == [0, 1, 2]
+        assert all(c.chunk_count == 3 for c in chunks)
+        assert b"".join(c.data for c in chunks) == payload
+
+    def test_split_carries_message_term_and_snapshot_term(self):
+        chunks = split_snapshot_message(make_install_msg(term=9), b"xy", 1)
+        assert all(c.message_term == 9 for c in chunks)
+        assert all(c.term == 3 for c in chunks)  # snapshot log term
+
+    def test_dummy_single_chunk(self):
+        chunks = split_snapshot_message(make_install_msg(dummy=True), b"", 4096)
+        assert len(chunks) == 1
+        assert chunks[0].dummy
+        assert chunks[0].data == b""
+
+
+class TestChunkSink:
+    def _sink(self):
+        storage = InMemSnapshotStorage()
+        delivered = []
+        confirmed = []
+        sink = ChunkSink(
+            save_fn=lambda s, r, i, p: storage.save(s, r, i, p, suffix="rx1"),
+            deliver_fn=delivered.append,
+            confirm_fn=lambda s, f, t: confirmed.append((s, f, t)),
+        )
+        return sink, storage, delivered, confirmed
+
+    def test_reassembly(self):
+        sink, storage, delivered, confirmed = self._sink()
+        payload = b"hello world " * 1000
+        for c in split_snapshot_message(make_install_msg(term=5), payload, 100):
+            assert sink.add(c)
+        assert len(delivered) == 1
+        m = delivered[0]
+        assert m.type == MessageType.INSTALL_SNAPSHOT
+        # the raft term gate must see the original message term (a stale
+        # stream from a deposed leader must be droppable)
+        assert m.term == 5
+        assert m.snapshot.index == 100
+        # receiver owns a LOCAL copy
+        assert storage.load(m.snapshot.filepath) == payload
+        assert confirmed == [(7, 1, 2)]
+
+    def test_out_of_order_rejected(self):
+        sink, _, delivered, _ = self._sink()
+        chunks = split_snapshot_message(make_install_msg(), b"x" * 300, 100)
+        assert sink.add(chunks[0])
+        assert not sink.add(chunks[2])  # skipped chunk 1
+        assert not delivered
+        # after an abort, restart from chunk 0 works
+        for c in chunks:
+            assert sink.add(c)
+        assert len(delivered) == 1
+
+    def test_interleaved_senders(self):
+        """Streams from different (shard, sender) keys don't interfere."""
+        sink, _, delivered, _ = self._sink()
+        m1 = make_install_msg()
+        m2 = Message(
+            type=MessageType.INSTALL_SNAPSHOT,
+            shard_id=8,
+            from_=3,
+            to=2,
+            term=4,
+            snapshot=Snapshot(index=50, term=2, shard_id=8, replica_id=2),
+        )
+        c1 = split_snapshot_message(m1, b"a" * 150, 100)
+        c2 = split_snapshot_message(m2, b"b" * 150, 100)
+        assert sink.add(c1[0])
+        assert sink.add(c2[0])
+        assert sink.add(c1[1])
+        assert sink.add(c2[1])
+        assert len(delivered) == 2
+
+
+class _ChanTransport(ITransport):
+    """Records batches/chunks; optionally fails sends."""
+
+    def __init__(self):
+        self.batches = []
+        self.chunks = []
+        self.fail = False
+        self.lock = threading.Lock()
+
+    def name(self):
+        return "chan"
+
+    def start(self):
+        pass
+
+    def close(self):
+        pass
+
+    def get_connection(self, target):
+        outer = self
+
+        class C(IConnection):
+            def close(self):
+                pass
+
+            def send_message_batch(self, batch):
+                if outer.fail:
+                    raise ConnectionError("injected")
+                with outer.lock:
+                    outer.batches.append(batch)
+
+        return C()
+
+    def get_snapshot_connection(self, target):
+        outer = self
+
+        class S(ISnapshotConnection):
+            def close(self):
+                pass
+
+            def send_chunk(self, chunk):
+                if outer.fail:
+                    raise ConnectionError("injected")
+                with outer.lock:
+                    outer.chunks.append(chunk)
+
+        return S()
+
+
+def wait_until(fn, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+class TestTransportCore:
+    def test_batch_coalescing(self):
+        raw = _ChanTransport()
+        tr = Transport(raw, lambda s, r: "t1", "src")
+        try:
+            for i in range(10):
+                assert tr.send(Message(type=MessageType.HEARTBEAT, shard_id=1, to=2))
+            assert wait_until(
+                lambda: sum(len(b.messages) for b in raw.batches) == 10
+            )
+            # fewer batches than messages (coalesced)
+            assert len(raw.batches) <= 10
+            assert raw.batches[0].source_address == "src"
+        finally:
+            tr.close()
+
+    def test_unresolvable_target_dropped(self):
+        raw = _ChanTransport()
+        tr = Transport(raw, lambda s, r: None, "src")
+        try:
+            assert not tr.send(Message(type=MessageType.HEARTBEAT, shard_id=1, to=2))
+            assert tr.metrics["dropped"] == 1
+        finally:
+            tr.close()
+
+    def test_unreachable_callback_on_failure(self):
+        raw = _ChanTransport()
+        raw.fail = True
+        unreachable = []
+        tr = Transport(
+            raw, lambda s, r: "t1", "src", unreachable_cb=unreachable.append
+        )
+        try:
+            tr.send(Message(type=MessageType.HEARTBEAT, shard_id=1, to=2))
+            assert wait_until(lambda: len(unreachable) >= 1)
+        finally:
+            tr.close()
+
+    def test_snapshot_stream_success_and_failure(self):
+        raw = _ChanTransport()
+        storage = InMemSnapshotStorage()
+        path = storage.save(7, 1, 100, b"p" * 5000)
+        statuses = []
+        tr = Transport(
+            raw,
+            lambda s, r: "t1",
+            "src",
+            snapshot_payload_loader=lambda ss: storage.load(ss.filepath),
+            snapshot_status_cb=lambda s, to, failed: statuses.append(failed),
+        )
+        try:
+            m = make_install_msg()
+            m = Message(
+                type=m.type, shard_id=m.shard_id, from_=m.from_, to=m.to,
+                term=m.term,
+                snapshot=Snapshot(
+                    filepath=path, index=100, term=3, shard_id=7, replica_id=2
+                ),
+            )
+            assert tr.send(m)  # routed to the snapshot lane
+            assert wait_until(lambda: len(raw.chunks) >= 1)
+            assert b"".join(c.data for c in raw.chunks) == b"p" * 5000
+            assert statuses == []
+            # now a failing stream must report a rejected status
+            raw.fail = True
+            tr.send(m)
+            assert wait_until(lambda: statuses == [True])
+        finally:
+            tr.close()
+
+    def test_missing_snapshot_file_reports_failure(self):
+        raw = _ChanTransport()
+        statuses = []
+        tr = Transport(
+            raw,
+            lambda s, r: "t1",
+            "src",
+            snapshot_payload_loader=lambda ss: (_ for _ in ()).throw(
+                FileNotFoundError(ss.filepath)
+            ),
+            snapshot_status_cb=lambda s, to, failed: statuses.append(failed),
+        )
+        try:
+            assert not tr.send(make_install_msg())
+            assert statuses == [True]
+        finally:
+            tr.close()
