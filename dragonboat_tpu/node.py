@@ -293,6 +293,15 @@ class Node:
                 if self.quiesce.record_activity(MessageType.PROPOSE):
                     self._poke_peers_out_of_quiesce()
 
+        # received-snapshot files are saved by the chunk sink before raft
+        # decides; any install this step that raft does NOT accept must be
+        # deleted or its rx file leaks forever (code-review finding)
+        rx_candidates = [
+            m.snapshot.filepath
+            for m in received
+            if m.type == MessageType.INSTALL_SNAPSHOT and m.snapshot.filepath
+        ]
+
         for m in received:
             self.peer.handle(m)
 
@@ -323,8 +332,14 @@ class Node:
         self._check_leader_change()
 
         if not self.peer.has_update():
+            for path in rx_candidates:  # every install was rejected
+                self.snapshot_storage.remove(path)
             return None
         u = self.peer.get_update(last_applied=self.sm.last_applied)
+        accepted_path = u.snapshot.filepath if not u.snapshot.is_empty() else None
+        for path in rx_candidates:
+            if path != accepted_path:
+                self.snapshot_storage.remove(path)
         for e in u.dropped_entries:
             # route by entry kind: proposal and config-change futures live
             # in different tables with independent key spaces

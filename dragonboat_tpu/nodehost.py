@@ -36,7 +36,7 @@ from .request import (
 )
 from .statemachine import Result
 from .storage.logdb import InMemLogDB
-from .storage.snapshotter import FileSnapshotStorage, InMemSnapshotStorage
+from .storage.snapshotter import FileSnapshotStorage
 from .transport import InProcTransport, Registry, Transport
 from .transport.chunk import ChunkSink
 

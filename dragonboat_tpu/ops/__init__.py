@@ -1,0 +1,19 @@
+"""Vectorized device ops: the TPU raft step kernel and its host glue.
+
+Layout (SURVEY.md §7 build step 4):
+  types.py  — SoA DeviceState / Inbox / DeviceOut tensor layouts
+  kernel.py — the jit/vmap step function (the "raft.Step as MXU work" core)
+  sync.py   — oracle<->row conversion, message staging, parity helpers
+"""
+from .types import DeviceOut, DeviceState, Inbox, make_inbox, make_out, make_state
+from .kernel import step
+
+__all__ = [
+    "DeviceOut",
+    "DeviceState",
+    "Inbox",
+    "make_inbox",
+    "make_out",
+    "make_state",
+    "step",
+]

@@ -1,0 +1,1233 @@
+"""The vectorized raft step kernel.
+
+``step(state, inbox) -> (state', DeviceOut)`` advances **every row at
+once** through an ordered inbox of M message slots.  Slot i is processed
+for all G rows in parallel (one masked pass over the whole batch), and
+slots are processed sequentially — exactly the order the scalar oracle
+(`dragonboat_tpu.raft.raft.Raft.handle`) would process the same messages,
+which is what makes bit-exact differential testing possible.
+
+The semantics mirror the oracle function-for-function (which itself
+mirrors reference internal/raft/raft.go [U]); each helper cites its
+oracle counterpart.  Everything here is pure int32 math — no host
+callbacks, no dynamic shapes, no data-dependent Python control flow —
+so XLA compiles it to a single fused program that scales to 100k+ rows
+(BASELINE north star).
+
+Escalation contract: if a row needs anything the device cannot resolve
+(log term outside the W-ring, outbox overflow, a cold message type) its
+ESC bit is set in ``out.escalate``; the host replays that row's inbox on
+the scalar oracle from the pre-step snapshot and discards every
+device-side effect for the row (state column, outbox rows, aux outputs).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .types import (
+    DeviceOut,
+    DeviceState,
+    ESC_COLD,
+    ESC_INVARIANT,
+    ESC_OVERFLOW,
+    ESC_WINDOW,
+    F_COMMIT,
+    F_HINT,
+    F_HINT_HIGH,
+    F_LOG_INDEX,
+    F_LOG_TERM,
+    F_MTYPE,
+    F_N_ENTRIES,
+    F_REJECT,
+    F_SRC_SLOT,
+    F_TERM,
+    F_TO,
+    HOT_TYPES,
+    I32,
+    Inbox,
+    KIND_NON_VOTING,
+    KIND_VOTER,
+    KIND_WITNESS,
+    MT_CHECK_QUORUM,
+    MT_ELECTION,
+    MT_HEARTBEAT,
+    MT_HEARTBEAT_RESP,
+    MT_INSTALL_SNAPSHOT,
+    MT_PROPOSE,
+    MT_READ_INDEX_RESP,
+    MT_REPLICATE,
+    MT_REPLICATE_RESP,
+    MT_REQUEST_PREVOTE,
+    MT_REQUEST_PREVOTE_RESP,
+    MT_REQUEST_VOTE,
+    MT_REQUEST_VOTE_RESP,
+    MT_SNAPSHOT_RECEIVED,
+    MT_SNAPSHOT_STATUS,
+    MT_TICK,
+    MT_TIMEOUT_NOW,
+    MT_UNREACHABLE,
+    N_FIELDS,
+    ROLE_CANDIDATE,
+    ROLE_FOLLOWER,
+    ROLE_LEADER,
+    ROLE_NON_VOTING,
+    ROLE_PRE_CANDIDATE,
+    ROLE_WITNESS,
+    RS_REPLICATE,
+    RS_RETRY,
+    RS_SNAPSHOT,
+    RS_WAIT,
+    SLOT_DROPPED,
+    SLOT_FORWARDED,
+    make_out,
+)
+
+
+def _w(mask, new, old):
+    """Masked field update; mask is [G], fields are [G] or [G, ...]."""
+    if old.ndim > 1:
+        mask = mask.reshape(mask.shape + (1,) * (old.ndim - 1))
+    return jnp.where(mask, new, old)
+
+
+def _wp(mask_gp, new, old):
+    """Masked per-(row, peer) update; mask is [G, P]."""
+    return jnp.where(mask_gp, new, old)
+
+
+# ---------------------------------------------------------------------------
+# deterministic election jitter (mirrors raft.splitmix32 / election_jitter)
+# ---------------------------------------------------------------------------
+def _splitmix32(x):
+    x = (x.astype(jnp.uint32) + jnp.uint32(0x9E3779B9))
+    z = x
+    z = z ^ (z >> 16)
+    z = z * jnp.uint32(0x85EBCA6B)
+    z = z ^ (z >> 13)
+    z = z * jnp.uint32(0xC2B2AE35)
+    z = z ^ (z >> 16)
+    return z
+
+
+def _jitter(shard_id, replica_id, seq, span):
+    h = _splitmix32(
+        (shard_id.astype(jnp.uint32) << 24)
+        ^ (replica_id.astype(jnp.uint32) << 8)
+        ^ seq.astype(jnp.uint32)
+    )
+    return (h % span.astype(jnp.uint32)).astype(I32)
+
+
+def reset_timeout(st: DeviceState, mask) -> DeviceState:
+    """oracle: Raft._reset_randomized_timeout."""
+    seq = st.timeout_seq + 1
+    rt = st.election_timeout + _jitter(
+        st.shard_id, st.replica_id, seq, st.election_timeout
+    )
+    return st._replace(
+        timeout_seq=_w(mask, seq, st.timeout_seq),
+        rand_timeout=_w(mask, rt, st.rand_timeout),
+    )
+
+
+# ---------------------------------------------------------------------------
+# peer-slot helpers
+# ---------------------------------------------------------------------------
+def _valid(st):
+    return st.peer_id != 0
+
+
+def _voters(st):
+    """Voting members = voters + witnesses (oracle: voting_members)."""
+    return _valid(st) & (
+        (st.peer_kind == KIND_VOTER) | (st.peer_kind == KIND_WITNESS)
+    )
+
+
+def _num_voters(st):
+    return jnp.sum(_voters(st), axis=1).astype(I32)
+
+
+def _quorum(st):
+    return _num_voters(st) // 2 + 1
+
+
+def _self_kind(st):
+    g = jnp.arange(st.G)
+    return st.peer_kind[g, st.self_slot]
+
+
+def _self_is_voter(st):
+    """True when this replica currently appears as a voter slot."""
+    g = jnp.arange(st.G)
+    return (st.peer_id[g, st.self_slot] == st.replica_id) & (
+        _self_kind(st) == KIND_VOTER
+    )
+
+
+def _slot_of(st, pid):
+    """Peer-axis slot holding replica ``pid`` [G] -> (slot [G], found [G])."""
+    hit = (st.peer_id == pid[:, None]) & _valid(st) & (pid[:, None] != 0)
+    found = jnp.any(hit, axis=1)
+    slot = jnp.argmax(hit, axis=1).astype(I32)
+    return slot, found
+
+
+def _col(arr, slot):
+    """arr[g, slot[g]] for [G, P] arr."""
+    return jnp.take_along_axis(arr, slot[:, None], axis=1)[:, 0]
+
+
+def _set_col(arr, slot, mask, val):
+    g = jnp.arange(arr.shape[0])
+    cur = arr[g, slot]
+    return arr.at[g, slot].set(jnp.where(mask, val, cur))
+
+
+# ---------------------------------------------------------------------------
+# log-term ring
+# ---------------------------------------------------------------------------
+def _win_lo(st):
+    return jnp.maximum(st.first_index, st.last_index - (st.W - 1))
+
+
+def _ring_at(st, idx):
+    wm = st.W - 1
+    g = jnp.arange(st.G)
+    safe = jnp.clip(idx, 0, None)
+    return st.ring_term[g, safe & wm], st.ring_cc[g, safe & wm]
+
+
+def _log_term(st, idx):
+    """term(idx) -> (term, known, needs_escalation).
+
+    oracle: EntryLog.term.  known=False + esc=False means "definitely
+    unavailable" (idx beyond last, a legitimate mismatch); esc=True means
+    the ring cannot answer (compacted / outside the W window).
+    """
+    rt, _ = _ring_at(st, idx)
+    zero = idx == 0
+    boundary = idx == st.first_index - 1
+    in_win = (idx >= _win_lo(st)) & (idx <= st.last_index)
+    beyond = idx > st.last_index
+    term = jnp.where(zero, 0, jnp.where(boundary, st.base_term, rt))
+    known = zero | boundary | in_win
+    esc = ~known & ~beyond
+    return term, known, esc
+
+
+def _match_term(st, idx, term):
+    """oracle: EntryLog.match_term (False on compacted/unavailable)."""
+    t, known, esc = _log_term(st, idx)
+    return known & (t == term), esc
+
+
+def _last_term(st):
+    t, _, esc = _log_term(st, st.last_index)
+    return t, esc
+
+
+def _ring_append_one(st, mask, idx, term, cc):
+    """Write (term, cc) for log position idx where mask."""
+    wm = st.W - 1
+    g = jnp.arange(st.G)
+    pos = jnp.clip(idx, 0, None) & wm
+    rt = st.ring_term.at[g, pos].set(
+        jnp.where(mask, term, st.ring_term[g, pos])
+    )
+    rc = st.ring_cc.at[g, pos].set(jnp.where(mask, cc, st.ring_cc[g, pos]))
+    return st._replace(ring_term=rt, ring_cc=rc)
+
+
+def _pending_cc_scan(st, mask):
+    """Any config-change bit in (committed, last_index]?  Used by
+    become_leader (oracle: _compute_pending_config_change).  Escalates if
+    the uncommitted tail extends below the ring window."""
+    W = st.W
+    idxs = jnp.arange(W)[None, :]  # ring positions
+    # log index currently stored at ring position j:
+    # the ring holds indexes in [win_lo, last]; position j holds the unique
+    # index in that range congruent to j mod W.
+    lo = _win_lo(st)[:, None]
+    last = st.last_index[:, None]
+    cand = lo + ((idxs - lo) & (W - 1))
+    in_tail = (cand > st.committed[:, None]) & (cand <= last)
+    any_cc = jnp.any(in_tail & (st.ring_cc == 1), axis=1)
+    esc = mask & (st.committed + 1 < _win_lo(st)) & (st.committed < st.last_index)
+    return any_cc, esc
+
+
+# ---------------------------------------------------------------------------
+# outbox emission
+# ---------------------------------------------------------------------------
+def _emit(
+    out: DeviceOut,
+    mask,
+    *,
+    mtype,
+    to,
+    term,
+    log_term=0,
+    log_index=0,
+    commit=0,
+    reject=0,
+    hint=0,
+    hint_high=0,
+    n_entries=0,
+    src_slot=-1,
+) -> DeviceOut:
+    """Append one message per masked row (oracle: Raft._send)."""
+    G, O = out.buf.shape[0], out.buf.shape[1]
+
+    def bc(v):
+        return jnp.broadcast_to(jnp.asarray(v, I32), (G,))
+
+    row = jnp.stack(
+        [
+            bc(mtype),
+            bc(to),
+            bc(term),
+            bc(log_term),
+            bc(log_index),
+            bc(commit),
+            bc(reject),
+            bc(hint),
+            bc(hint_high),
+            bc(n_entries),
+            bc(src_slot),
+        ],
+        axis=1,
+    )  # [G, N_FIELDS]
+    idx = out.count
+    can = mask & (idx < O)
+    overflow = mask & (idx >= O)
+    g = jnp.arange(G)
+    pos = jnp.clip(idx, 0, O - 1)
+    buf = out.buf.at[g, pos].set(
+        jnp.where(can[:, None], row, out.buf[g, pos])
+    )
+    return out._replace(
+        buf=buf,
+        count=out.count + can.astype(I32),
+        escalate=out.escalate | jnp.where(overflow, ESC_OVERFLOW, 0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# role transitions (oracle: Raft._reset / become_*)
+# ---------------------------------------------------------------------------
+def _reset(st: DeviceState, mask, new_term) -> DeviceState:
+    term_changed = mask & (st.term != new_term)
+    st = st._replace(
+        term=_w(mask, new_term, st.term),
+        vote=_w(term_changed, 0, st.vote),
+        leader_id=_w(mask, 0, st.leader_id),
+        election_tick=_w(mask, 0, st.election_tick),
+        heartbeat_tick=_w(mask, 0, st.heartbeat_tick),
+        granted=_w(mask, 0, st.granted),
+        transfer_target=_w(mask, 0, st.transfer_target),
+        pending_cc=_w(mask, 0, st.pending_cc),
+    )
+    st = reset_timeout(st, mask)
+    # remotes: rm.reset(last+1); self slot keeps match=last
+    mgp = mask[:, None] & _valid(st)
+    is_self = (
+        jnp.arange(st.P)[None, :] == st.self_slot[:, None]
+    ) & mgp
+    last = st.last_index[:, None]
+    return st._replace(
+        match=_wp(mgp, jnp.where(is_self, last, 0), st.match),
+        next_idx=_wp(mgp, last + 1, st.next_idx),
+        rstate=_wp(mgp, RS_RETRY, st.rstate),
+        snap_index=_wp(mgp, 0, st.snap_index),
+    )
+
+
+def _become_follower(st, mask, new_term, leader) -> DeviceState:
+    sk = _self_kind(st)
+    role = jnp.where(
+        sk == KIND_NON_VOTING,
+        ROLE_NON_VOTING,
+        jnp.where(sk == KIND_WITNESS, ROLE_WITNESS, ROLE_FOLLOWER),
+    )
+    st = st._replace(role=_w(mask, role, st.role))
+    st = _reset(st, mask, jnp.broadcast_to(jnp.asarray(new_term, I32), (st.G,)))
+    return st._replace(leader_id=_w(mask, leader, st.leader_id))
+
+
+def _become_pre_candidate(st, mask) -> DeviceState:
+    """oracle: become_pre_candidate — does NOT touch term/vote/remotes."""
+    st = st._replace(
+        role=_w(mask, ROLE_PRE_CANDIDATE, st.role),
+        granted=_w(mask, 0, st.granted),
+        leader_id=_w(mask, 0, st.leader_id),
+        election_tick=_w(mask, 0, st.election_tick),
+    )
+    return reset_timeout(st, mask)
+
+
+def _become_candidate(st, mask) -> DeviceState:
+    st = st._replace(role=_w(mask, ROLE_CANDIDATE, st.role))
+    st = _reset(st, mask, st.term + 1)
+    st = st._replace(vote=_w(mask, st.replica_id, st.vote))
+    return st._replace(granted=_grant_self(st, mask))
+
+
+def _grant_self(st, mask):
+    g = jnp.arange(st.G)
+    cur = st.granted[g, st.self_slot]
+    return st.granted.at[g, st.self_slot].set(jnp.where(mask, 1, cur))
+
+
+def _vote_quorum(st):
+    n = jnp.sum(_voters(st) & (st.granted == 1), axis=1).astype(I32)
+    return n >= _quorum(st)
+
+
+def _vote_rejected(st):
+    n = jnp.sum(_voters(st) & (st.granted == 2), axis=1).astype(I32)
+    return n >= _quorum(st)
+
+
+def _append_one(st, mask, cc) -> DeviceState:
+    """Leader-side append of one entry at the current term
+    (oracle: _append_entries for a single entry, incl. self try_update)."""
+    new_last = st.last_index + 1
+    st = _ring_append_one(st, mask, new_last, st.term, cc)
+    st = st._replace(last_index=_w(mask, new_last, st.last_index))
+    g = jnp.arange(st.G)
+    self_match = st.match[g, st.self_slot]
+    self_next = st.next_idx[g, st.self_slot]
+    st = st._replace(
+        match=_set_col(
+            st.match, st.self_slot, mask, jnp.maximum(self_match, new_last)
+        ),
+        next_idx=_set_col(
+            st.next_idx, st.self_slot, mask, jnp.maximum(self_next, new_last + 1)
+        ),
+    )
+    return st
+
+
+def _try_commit(st, out, mask) -> Tuple[DeviceState, DeviceOut, jnp.ndarray]:
+    """oracle: try_commit — sorted-match quorum + current-term-only gate."""
+    voters = _voters(st)
+    eff = jnp.where(voters, st.match, -1)
+    s = jnp.sort(eff, axis=1)  # ascending; non-voters sink to the left
+    q = _quorum(st)
+    qidx = jnp.take_along_axis(s, (st.P - q)[:, None], axis=1)[:, 0]
+    higher = mask & (qidx > st.committed)
+    ok, esc = _match_term(st, qidx, st.term)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(higher & esc, ESC_WINDOW, 0)
+    )
+    adv = higher & ok
+    st = st._replace(committed=_w(adv, qidx, st.committed))
+    return st, out, adv
+
+
+# ---------------------------------------------------------------------------
+# sending replicate / heartbeats
+# ---------------------------------------------------------------------------
+def _send_replicate(st, out, mask, slot, E) -> Tuple[DeviceState, DeviceOut]:
+    """oracle: send_replicate(to) with the device entry cap E.
+
+    ``slot`` is a per-row peer-slot index [G].
+    """
+    rs = _col(st.rstate, slot)
+    nxt = _col(st.next_idx, slot)
+    to = _col(st.peer_id, slot)
+    paused = (rs == RS_WAIT) | (rs == RS_SNAPSHOT)
+    m = mask & ~paused & (to != 0)
+    prev = nxt - 1
+    # compacted below the resolvable boundary -> snapshot path
+    need_ss = m & (prev < st.first_index - 1)
+    g = jnp.arange(st.G)
+    ns = out.need_snapshot.at[g, slot].set(
+        jnp.where(need_ss, 1, out.need_snapshot[g, slot])
+    )
+    out = out._replace(need_snapshot=ns)
+    # hold the remote paused until the host starts the snapshot stream
+    st = st._replace(rstate=_set_col(st.rstate, slot, need_ss, RS_WAIT))
+    prev_term, known, esc = _log_term(st, prev)
+    m2 = m & ~need_ss
+    out = out._replace(
+        escalate=out.escalate | jnp.where(m2 & esc, ESC_WINDOW, 0)
+    )
+    m3 = m2 & known
+    n = jnp.clip(st.last_index - prev, 0, E)
+    out = _emit(
+        out,
+        m3,
+        mtype=MT_REPLICATE,
+        to=to,
+        term=st.term,
+        log_index=prev,
+        log_term=prev_term,
+        commit=st.committed,
+        n_entries=n,
+    )
+    # oracle: rm.progress(last sent) only when entries were carried
+    prog = m3 & (n > 0)
+    last_sent = prev + n
+    st = st._replace(
+        next_idx=_set_col(
+            st.next_idx, slot, prog & (rs == RS_REPLICATE), last_sent + 1
+        ),
+        rstate=_set_col(st.rstate, slot, prog & (rs == RS_RETRY), RS_WAIT),
+    )
+    return st, out
+
+
+def _broadcast_replicate(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
+    for p in range(st.P):
+        slot = jnp.full((st.G,), p, I32)
+        pm = mask & _valid(st)[:, p] & (st.self_slot != p)
+        st, out = _send_replicate(st, out, pm, slot, E)
+    return st, out
+
+
+def _broadcast_heartbeat(st, out, mask) -> DeviceOut:
+    """oracle: broadcast_heartbeat (device path carries no read-index ctx;
+    rows with pending reads are host-stepped — see engine routing)."""
+    for p in range(st.P):
+        pm = mask & _valid(st)[:, p] & (st.self_slot != p)
+        out = _emit(
+            out,
+            pm,
+            mtype=MT_HEARTBEAT,
+            to=st.peer_id[:, p],
+            term=st.term,
+            commit=jnp.minimum(st.match[:, p], st.committed),
+        )
+    return out
+
+
+def _become_leader(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
+    """oracle: become_leader (+ the single-voter fast commit)."""
+    st = st._replace(role=_w(mask, ROLE_LEADER, st.role))
+    st = _reset(st, mask, st.term)
+    st = st._replace(leader_id=_w(mask, st.replica_id, st.leader_id))
+    any_cc, esc = _pending_cc_scan(st, mask)
+    out = out._replace(escalate=out.escalate | jnp.where(esc, ESC_WINDOW, 0))
+    st = st._replace(
+        pending_cc=_w(mask, any_cc.astype(I32), st.pending_cc)
+    )
+    # commit barrier: empty entry at the new term
+    st = _append_one(st, mask, jnp.zeros((st.G,), I32))
+    single = _num_voters(st) == 1
+    st, out, _ = _try_commit(st, out, mask & single & _self_is_voter(st))
+    return st, out
+
+
+# ---------------------------------------------------------------------------
+# campaign (oracle: campaign / _handle_election)
+# ---------------------------------------------------------------------------
+def _campaign(st, out, mask, pre, transfer, E) -> Tuple[DeviceState, DeviceOut]:
+    pre_m = mask & pre
+    real_m = mask & ~pre
+    # --- prevote leg ---------------------------------------------------
+    st = _become_pre_candidate(st, pre_m)
+    st = st._replace(granted=_grant_self(st, pre_m))
+    promote = pre_m & _vote_quorum(st)  # single-voter shortcut
+    bcast_pre = pre_m & ~promote
+    lt, lt_esc = _last_term(st)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(bcast_pre & lt_esc, ESC_WINDOW, 0)
+    )
+    for p in range(st.P):
+        pm = (
+            bcast_pre
+            & _voters(st)[:, p]
+            & (st.self_slot != p)
+        )
+        out = _emit(
+            out,
+            pm,
+            mtype=MT_REQUEST_PREVOTE,
+            to=st.peer_id[:, p],
+            term=st.term + 1,
+            log_index=st.last_index,
+            log_term=lt,
+        )
+    real_m = real_m | promote
+    # --- real leg ------------------------------------------------------
+    st = _become_candidate(st, real_m)
+    lead = real_m & _vote_quorum(st)  # single voter
+    st, out = _become_leader(st, out, lead, E)
+    bcast = real_m & ~lead
+    lt2, lt2_esc = _last_term(st)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(bcast & lt2_esc, ESC_WINDOW, 0)
+    )
+    hint = jnp.where(transfer, st.replica_id, 0)
+    for p in range(st.P):
+        pm = bcast & _voters(st)[:, p] & (st.self_slot != p)
+        out = _emit(
+            out,
+            pm,
+            mtype=MT_REQUEST_VOTE,
+            to=st.peer_id[:, p],
+            term=st.term,
+            log_index=st.last_index,
+            log_term=lt2,
+            hint=hint,
+        )
+    return st, out
+
+
+def _handle_election(st, out, mask, hint, E):
+    """oracle: _handle_election."""
+    m = (
+        mask
+        & (st.role != ROLE_LEADER)
+        & (st.role != ROLE_NON_VOTING)
+        & (st.role != ROLE_WITNESS)
+        & _self_is_voter(st)
+    )
+    transfer = hint == st.replica_id
+    pre = (st.pre_vote == 1) & ~transfer
+    return _campaign(st, out, m, pre, transfer, E)
+
+
+# ---------------------------------------------------------------------------
+# check quorum (oracle: _handle_check_quorum)
+# ---------------------------------------------------------------------------
+def _check_quorum(st, mask) -> DeviceState:
+    voters = _voters(st)
+    is_self = jnp.arange(st.P)[None, :] == st.self_slot[:, None]
+    cnt = 1 + jnp.sum(voters & ~is_self & (st.active == 1), axis=1).astype(I32)
+    st = st._replace(
+        active=_wp(mask[:, None] & voters, 0, st.active)
+    )
+    down = mask & (cnt < _quorum(st))
+    return _become_follower(st, down, st.term, 0)
+
+
+# ---------------------------------------------------------------------------
+# tick (oracle: Raft.tick)
+# ---------------------------------------------------------------------------
+def _tick(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
+    lead = mask & (st.role == ROLE_LEADER)
+    non = mask & (st.role != ROLE_LEADER)
+    # --- leader tick ---------------------------------------------------
+    el = st.election_tick + 1
+    hb = st.heartbeat_tick + 1
+    fired = el >= st.election_timeout
+    st = st._replace(
+        election_tick=_w(lead, jnp.where(fired, 0, el), st.election_tick),
+        heartbeat_tick=_w(lead, hb, st.heartbeat_tick),
+    )
+    cq = lead & fired & (st.check_quorum == 1)
+    st = _check_quorum(st, cq)
+    still = lead & (st.role == ROLE_LEADER)
+    st = st._replace(
+        transfer_target=_w(still & fired, 0, st.transfer_target)
+    )
+    hb_fire = still & (st.heartbeat_tick >= st.heartbeat_timeout)
+    st = st._replace(heartbeat_tick=_w(hb_fire, 0, st.heartbeat_tick))
+    out = _broadcast_heartbeat(st, out, hb_fire)
+    # --- non-leader tick ----------------------------------------------
+    el2 = st.election_tick + 1
+    time_up = el2 >= st.rand_timeout
+    nvw = (st.role == ROLE_NON_VOTING) | (st.role == ROLE_WITNESS)
+    probe = non & nvw & (st.check_quorum == 1) & time_up
+    st = st._replace(election_tick=_w(non, el2, st.election_tick))
+    st = st._replace(election_tick=_w(probe, 0, st.election_tick))
+    st = reset_timeout(st, probe)
+    elect = non & ~nvw & time_up
+    st = st._replace(election_tick=_w(elect, 0, st.election_tick))
+    st, out = _handle_election(st, out, elect, jnp.zeros((st.G,), I32), E)
+    return st, out
+
+
+# ---------------------------------------------------------------------------
+# message-term gate (oracle: _on_message_term)
+# ---------------------------------------------------------------------------
+def _on_message_term(st, out, msg, mask):
+    mt = msg["mtype"]
+    mterm = msg["term"]
+    local = mterm == 0
+    higher = mask & ~local & (mterm > st.term)
+    lower = mask & ~local & (mterm < st.term)
+    vote_like = (mt == MT_REQUEST_VOTE) | (mt == MT_REQUEST_PREVOTE)
+    in_lease = (
+        (st.check_quorum == 1)
+        & (st.leader_id != 0)
+        & (st.election_tick < st.election_timeout)
+    )
+    drop_lease = higher & vote_like & in_lease & (msg["hint"] == 0)
+    leader_msg = (
+        (mt == MT_REPLICATE)
+        | (mt == MT_INSTALL_SNAPSHOT)
+        | (mt == MT_HEARTBEAT)
+        | (mt == MT_TIMEOUT_NOW)
+        | (mt == MT_READ_INDEX_RESP)
+    )
+    keep_term = (mt == MT_REQUEST_PREVOTE) | (
+        (mt == MT_REQUEST_PREVOTE_RESP) & (msg["reject"] == 0)
+    )
+    become = higher & ~drop_lease & ~keep_term
+    st = _become_follower(
+        st, become, mterm, jnp.where(leader_msg, msg["from_id"], 0)
+    )
+    # deposed-leader poke: a lower-term leader must step down
+    poke = (
+        lower
+        & ((mt == MT_REPLICATE) | (mt == MT_HEARTBEAT) | (mt == MT_INSTALL_SNAPSHOT))
+        & ((st.check_quorum == 1) | (st.pre_vote == 1))
+    )
+    out = _emit(
+        out, poke, mtype=MT_REPLICATE_RESP, to=msg["from_id"], term=st.term
+    )
+    pv_rej = lower & (mt == MT_REQUEST_PREVOTE)
+    out = _emit(
+        out,
+        pv_rej,
+        mtype=MT_REQUEST_PREVOTE_RESP,
+        to=msg["from_id"],
+        term=st.term,
+        reject=1,
+    )
+    passed = mask & (local | (mterm == st.term) | (higher & ~drop_lease))
+    return st, out, passed
+
+
+# ---------------------------------------------------------------------------
+# vote handling
+# ---------------------------------------------------------------------------
+def _can_grant_vote(st, msg, prevote):
+    return (
+        (st.vote == 0)
+        | (st.vote == msg["from_id"])
+        | (prevote & (msg["term"] > st.term))
+    )
+
+
+def _up_to_date(st, out, mask, msg):
+    lt, esc = _last_term(st)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(mask & esc, ESC_WINDOW, 0)
+    )
+    utd = (msg["log_term"] > lt) | (
+        (msg["log_term"] == lt) & (msg["log_index"] >= st.last_index)
+    )
+    return out, utd
+
+
+def _handle_request_vote(st, out, msg, mask):
+    m = mask & (st.role != ROLE_NON_VOTING)
+    out, utd = _up_to_date(st, out, m, msg)
+    grant = m & _can_grant_vote(st, msg, jnp.asarray(False)) & utd
+    st = st._replace(
+        election_tick=_w(grant, 0, st.election_tick),
+        vote=_w(grant, msg["from_id"], st.vote),
+    )
+    out = _emit(
+        out,
+        m,
+        mtype=MT_REQUEST_VOTE_RESP,
+        to=msg["from_id"],
+        term=st.term,
+        reject=jnp.where(grant, 0, 1),
+    )
+    return st, out
+
+
+def _handle_request_prevote(st, out, msg, mask):
+    m = mask & (st.role != ROLE_NON_VOTING)
+    out, utd = _up_to_date(st, out, m, msg)
+    grant = m & utd & (
+        (msg["term"] > st.term) | _can_grant_vote(st, msg, jnp.asarray(True))
+    )
+    out = _emit(
+        out,
+        m,
+        mtype=MT_REQUEST_PREVOTE_RESP,
+        to=msg["from_id"],
+        term=jnp.where(grant, msg["term"], st.term),
+        reject=jnp.where(grant, 0, 1),
+    )
+    return st, out
+
+
+# ---------------------------------------------------------------------------
+# replicate / heartbeat handling (follower side)
+# ---------------------------------------------------------------------------
+def _handle_replicate(st, out, msg, mask, slot_i):
+    """oracle: _handle_replicate (follower log append + log matching)."""
+    E = int(msg["ent_term"].shape[1])
+    stale = mask & (msg["log_index"] < st.committed)
+    out = _emit(
+        out,
+        stale,
+        mtype=MT_REPLICATE_RESP,
+        to=msg["from_id"],
+        term=st.term,
+        log_index=st.committed,
+    )
+    m = mask & ~stale
+    prev_ok, esc = _match_term(st, msg["log_index"], msg["log_term"])
+    out = out._replace(
+        escalate=out.escalate | jnp.where(m & esc, ESC_WINDOW, 0)
+    )
+    ok = m & prev_ok
+    n = msg["n_entries"]
+    last_new = msg["log_index"] + n
+    # conflict scan: first carried entry whose (index, term) mismatches
+    conflict_off = jnp.full((st.G,), E + 1, I32)
+    conflict_esc = jnp.zeros((st.G,), bool)
+    for i in reversed(range(E)):
+        idx = msg["log_index"] + 1 + i
+        et = msg["ent_term"][:, i]
+        mt_ok, e_esc = _match_term(st, idx, et)
+        has = ok & (i < n)
+        conflict_off = jnp.where(has & ~mt_ok, i, conflict_off)
+        conflict_esc = jnp.where(has & ~mt_ok, e_esc, conflict_esc)
+    # a conflict beyond last_index is an append, not an escalation
+    idx_at_conf = msg["log_index"] + 1 + conflict_off
+    conflict_esc = conflict_esc & (idx_at_conf <= st.last_index)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(ok & conflict_esc, ESC_WINDOW, 0)
+    )
+    has_conflict = ok & (conflict_off <= E)
+    # invariant: conflict must be above commit (oracle raises otherwise)
+    bad = has_conflict & (idx_at_conf <= st.committed)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(bad, ESC_INVARIANT, 0)
+    )
+    # append entries[conflict_off:] — ring writes + truncation to last_new
+    for i in range(E):
+        idx = msg["log_index"] + 1 + i
+        wmask = has_conflict & (i >= conflict_off) & (i < n)
+        st = _ring_append_one(
+            st, wmask, idx, msg["ent_term"][:, i], msg["ent_cc"][:, i]
+        )
+    st = st._replace(
+        last_index=_w(has_conflict, last_new, st.last_index)
+    )
+    # commit_to(min(m.commit, last_new))
+    new_commit = jnp.minimum(msg["commit"], last_new)
+    st = st._replace(
+        committed=_w(ok, jnp.maximum(st.committed, new_commit), st.committed)
+    )
+    out = _emit(
+        out,
+        ok,
+        mtype=MT_REPLICATE_RESP,
+        to=msg["from_id"],
+        term=st.term,
+        log_index=last_new,
+    )
+    rej = m & ~prev_ok
+    out = _emit(
+        out,
+        rej,
+        mtype=MT_REPLICATE_RESP,
+        to=msg["from_id"],
+        term=st.term,
+        reject=1,
+        log_index=msg["log_index"],
+        hint=st.last_index,
+    )
+    return st, out
+
+
+def _handle_heartbeat(st, out, msg, mask):
+    new_commit = jnp.minimum(msg["commit"], st.last_index)
+    st = st._replace(
+        committed=_w(mask, jnp.maximum(st.committed, new_commit), st.committed)
+    )
+    out = _emit(
+        out,
+        mask,
+        mtype=MT_HEARTBEAT_RESP,
+        to=msg["from_id"],
+        term=st.term,
+        hint=msg["hint"],
+        hint_high=msg["hint_high"],
+    )
+    return st, out
+
+
+# ---------------------------------------------------------------------------
+# leader-side response handling
+# ---------------------------------------------------------------------------
+def _handle_replicate_resp(st, out, msg, mask, E):
+    slot, found = _slot_of(st, msg["from_id"])
+    m = mask & found
+    st = st._replace(active=_set_col(st.active, slot, m, 1))
+    rs = _col(st.rstate, slot)
+    match = _col(st.match, slot)
+    nxt = _col(st.next_idx, slot)
+    snap = _col(st.snap_index, slot)
+    rej = m & (msg["reject"] == 1)
+    # -- decrease (oracle: remote.decrease) -----------------------------
+    repl = rs == RS_REPLICATE
+    do_r = rej & repl & (msg["log_index"] > match)
+    # become_retry from REPLICATE: next = match + 1
+    st = st._replace(
+        next_idx=_set_col(st.next_idx, slot, do_r, match + 1),
+        snap_index=_set_col(st.snap_index, slot, do_r, 0),
+        rstate=_set_col(st.rstate, slot, do_r, RS_RETRY),
+    )
+    do_nr = rej & ~repl & (nxt - 1 == msg["log_index"])
+    dec_next = jnp.maximum(
+        jnp.maximum(jnp.minimum(msg["log_index"], msg["hint"] + 1), match + 1),
+        1,
+    )
+    st = st._replace(
+        next_idx=_set_col(st.next_idx, slot, do_nr, dec_next),
+        rstate=_set_col(
+            st.rstate,
+            slot,
+            do_nr & (rs == RS_WAIT),
+            RS_RETRY,
+        ),
+    )
+    st, out = _send_replicate(st, out, do_r | do_nr, slot, E)
+    # -- ack (oracle: _handle_replicate_resp accept path) ---------------
+    ack = m & (msg["reject"] == 0)
+    paused = (rs == RS_WAIT) | (rs == RS_SNAPSHOT)
+    advanced = ack & (match < msg["log_index"])
+    new_match = jnp.maximum(match, msg["log_index"])
+    new_next = jnp.maximum(nxt, msg["log_index"] + 1)
+    st = st._replace(
+        match=_set_col(st.match, slot, advanced, new_match),
+        next_idx=_set_col(st.next_idx, slot, ack, new_next),
+        rstate=_set_col(
+            st.rstate, slot, advanced & (rs == RS_WAIT), RS_RETRY
+        ),
+    )
+    # snapshot -> retry -> replicate promotions
+    rs2 = _col(st.rstate, slot)
+    promote_ss = advanced & (rs2 == RS_SNAPSHOT) & (new_match >= snap)
+    st = st._replace(
+        next_idx=_set_col(
+            st.next_idx,
+            slot,
+            promote_ss,
+            jnp.maximum(new_match + 1, snap + 1),
+        ),
+        snap_index=_set_col(st.snap_index, slot, promote_ss, 0),
+        rstate=_set_col(st.rstate, slot, promote_ss, RS_RETRY),
+    )
+    rs3 = _col(st.rstate, slot)
+    promote_r = advanced & (rs3 == RS_RETRY)
+    st = st._replace(
+        next_idx=_set_col(st.next_idx, slot, promote_r, new_match + 1),
+        snap_index=_set_col(st.snap_index, slot, promote_r, 0),
+        rstate=_set_col(st.rstate, slot, promote_r, RS_REPLICATE),
+    )
+    st, out, committed_adv = _try_commit(st, out, advanced)
+    st, out = _broadcast_replicate(st, out, committed_adv, E)
+    st, out = _send_replicate(
+        st, out, advanced & ~committed_adv & paused, slot, E
+    )
+    # leader transfer: target caught up -> TIMEOUT_NOW
+    ready = (
+        advanced
+        & (st.transfer_target == msg["from_id"])
+        & (st.last_index == new_match)
+    )
+    out = _emit(
+        out, ready, mtype=MT_TIMEOUT_NOW, to=msg["from_id"], term=st.term
+    )
+    # stale ack while streaming a snapshot that has completed
+    rs4 = _col(st.rstate, slot)
+    m4 = _col(st.match, slot)
+    s4 = _col(st.snap_index, slot)
+    stale_ss = ack & ~advanced & (rs4 == RS_SNAPSHOT) & (m4 >= s4)
+    st = st._replace(
+        next_idx=_set_col(
+            st.next_idx, slot, stale_ss, jnp.maximum(m4 + 1, s4 + 1)
+        ),
+        snap_index=_set_col(st.snap_index, slot, stale_ss, 0),
+        rstate=_set_col(st.rstate, slot, stale_ss, RS_RETRY),
+    )
+    return st, out
+
+
+def _handle_heartbeat_resp(st, out, msg, mask, E):
+    slot, found = _slot_of(st, msg["from_id"])
+    m = mask & found
+    st = st._replace(active=_set_col(st.active, slot, m, 1))
+    rs = _col(st.rstate, slot)
+    st = st._replace(
+        rstate=_set_col(st.rstate, slot, m & (rs == RS_WAIT), RS_RETRY)
+    )
+    lag = m & (_col(st.match, slot) < st.last_index)
+    st, out = _send_replicate(st, out, lag, slot, E)
+    return st, out
+
+
+def _handle_unreachable(st, msg, mask):
+    slot, found = _slot_of(st, msg["from_id"])
+    m = mask & found & (_col(st.rstate, slot) == RS_REPLICATE)
+    match = _col(st.match, slot)
+    st = st._replace(
+        next_idx=_set_col(st.next_idx, slot, m, match + 1),
+        snap_index=_set_col(st.snap_index, slot, m, 0),
+        rstate=_set_col(st.rstate, slot, m, RS_RETRY),
+    )
+    return st
+
+
+def _handle_snapshot_status(st, msg, mask):
+    """oracle: _handle_snapshot_status / _handle_snapshot_received — the
+    remote leaves SNAPSHOT into WAIT (become_wait)."""
+    slot, found = _slot_of(st, msg["from_id"])
+    m = mask & found & (_col(st.rstate, slot) == RS_SNAPSHOT)
+    snap = _col(st.snap_index, slot)
+    # reject=1 clears the pending snapshot index first (SNAPSHOT_STATUS)
+    snap = jnp.where(m & (msg["reject"] == 1), 0, snap)
+    match = _col(st.match, slot)
+    new_next = jnp.maximum(match + 1, snap + 1)
+    st = st._replace(
+        next_idx=_set_col(st.next_idx, slot, m, new_next),
+        snap_index=_set_col(st.snap_index, slot, m, 0),
+        rstate=_set_col(st.rstate, slot, m, RS_WAIT),
+    )
+    return st
+
+
+# ---------------------------------------------------------------------------
+# propose (oracle: _handle_propose)
+# ---------------------------------------------------------------------------
+def _handle_propose(st, out, msg, mask, slot_i, E):
+    lead = mask & (st.role == ROLE_LEADER)
+    n = msg["n_entries"]
+    transferring = st.transfer_target != 0
+    drop_all = lead & transferring
+    accept = lead & ~transferring
+    base = st.last_index
+    # per-entry config-change gate, sequential within the message
+    appended_any = jnp.zeros((st.G,), bool)
+    ent_drop = out.ent_drop
+    for i in range(E):
+        has = accept & (i < n)
+        is_cc = msg["ent_cc"][:, i] == 1
+        dropped = has & is_cc & (st.pending_cc == 1)
+        ent_drop = ent_drop.at[:, slot_i, i].set(
+            jnp.where(dropped, 1, ent_drop[:, slot_i, i])
+        )
+        put = has & ~dropped
+        st = st._replace(
+            pending_cc=_w(put & is_cc, 1, st.pending_cc)
+        )
+        st = _append_one(st, put, jnp.where(is_cc, 1, 0))
+        appended_any = appended_any | put
+    out = out._replace(ent_drop=ent_drop)
+    # single-voter commit advance happens inside _append_entries via
+    # try_commit; mirror it once after the batch (equivalent because the
+    # commit quorum for a single voter is just its own last_index)
+    single = (_num_voters(st) == 1) & _self_is_voter(st)
+    st, out, _ = _try_commit(st, out, appended_any & single)
+    st, out = _broadcast_replicate(st, out, appended_any, E)
+    # host bookkeeping: where did this slot's entries land?
+    sb = jnp.where(
+        accept,
+        base,
+        jnp.where(drop_all, SLOT_DROPPED, out.slot_base[:, slot_i]),
+    )
+    stm = jnp.where(accept, st.term, out.slot_term[:, slot_i])
+    # follower: forward to the leader; candidate/no-leader: drop
+    foll = mask & (
+        (st.role == ROLE_FOLLOWER)
+        | (st.role == ROLE_NON_VOTING)
+        | (st.role == ROLE_WITNESS)
+    )
+    fwd = foll & (st.leader_id != 0)
+    out = _emit(
+        out,
+        fwd,
+        mtype=MT_PROPOSE,
+        to=st.leader_id,
+        term=st.term,
+        n_entries=n,
+        src_slot=slot_i,
+    )
+    sb = jnp.where(fwd, SLOT_FORWARDED, sb)
+    dropped_f = (foll & (st.leader_id == 0)) | (
+        mask
+        & ((st.role == ROLE_CANDIDATE) | (st.role == ROLE_PRE_CANDIDATE))
+    )
+    sb = jnp.where(dropped_f, SLOT_DROPPED, sb)
+    out = out._replace(
+        slot_base=out.slot_base.at[:, slot_i].set(sb),
+        slot_term=out.slot_term.at[:, slot_i].set(stm),
+    )
+    return st, out
+
+
+# ---------------------------------------------------------------------------
+# the per-slot dispatcher (oracle: Raft.handle + _step)
+# ---------------------------------------------------------------------------
+def _is_hot(mt):
+    acc = jnp.zeros_like(mt, dtype=bool)
+    for t in HOT_TYPES:
+        acc = acc | (mt == t)
+    return acc
+
+
+def _process_slot(st, out, msg, slot_i, E):
+    mask = (msg["mtype"] != 0) & (out.escalate == 0)
+    mt = msg["mtype"]
+    # cold types escalate the whole row
+    out = out._replace(
+        escalate=out.escalate | jnp.where(mask & ~_is_hot(mt), ESC_COLD, 0)
+    )
+    mask = mask & _is_hot(mt)
+
+    # LOCAL_TICK short-circuits the gate (oracle: handle)
+    st, out = _tick(st, out, mask & (mt == MT_TICK), E)
+    rest = mask & (mt != MT_TICK)
+    st, out, passed = _on_message_term(st, out, msg, rest)
+
+    # local/global messages valid in any role
+    st, out = _handle_election(
+        st, out, passed & (mt == MT_ELECTION), msg["hint"], E
+    )
+    st, out = _handle_request_vote(
+        st, out, msg, passed & (mt == MT_REQUEST_VOTE)
+    )
+    st, out = _handle_request_prevote(
+        st, out, msg, passed & (mt == MT_REQUEST_PREVOTE)
+    )
+    role_routed = passed & ~(
+        (mt == MT_ELECTION)
+        | (mt == MT_REQUEST_VOTE)
+        | (mt == MT_REQUEST_PREVOTE)
+    )
+
+    # ---- leader role --------------------------------------------------
+    lead = role_routed & (st.role == ROLE_LEADER)
+    st, out = _handle_propose(st, out, msg, role_routed & (mt == MT_PROPOSE), slot_i, E)
+    st = _check_quorum(st, lead & (mt == MT_CHECK_QUORUM))
+    st, out = _handle_replicate_resp(
+        st, out, msg, lead & (mt == MT_REPLICATE_RESP), E
+    )
+    st, out = _handle_heartbeat_resp(
+        st, out, msg, lead & (mt == MT_HEARTBEAT_RESP), E
+    )
+    st = _handle_unreachable(st, msg, lead & (mt == MT_UNREACHABLE))
+    st = _handle_snapshot_status(
+        st,
+        msg,
+        lead & ((mt == MT_SNAPSHOT_STATUS) | (mt == MT_SNAPSHOT_RECEIVED)),
+    )
+
+    # ---- candidate roles ---------------------------------------------
+    cand = role_routed & (
+        (st.role == ROLE_CANDIDATE) | (st.role == ROLE_PRE_CANDIDATE)
+    )
+    # REPLICATE / HEARTBEAT at our term from a legitimate leader
+    from_leader = cand & ((mt == MT_REPLICATE) | (mt == MT_HEARTBEAT))
+    st = _become_follower(st, from_leader, st.term, msg["from_id"])
+    # vote responses
+    vr = cand & (mt == MT_REQUEST_VOTE_RESP) & (st.role == ROLE_CANDIDATE)
+    slot, found = _slot_of(st, msg["from_id"])
+    rec = vr & found
+    st = st._replace(
+        granted=_set_col(
+            st.granted, slot, rec, jnp.where(msg["reject"] == 1, 2, 1)
+        )
+    )
+    win = vr & _vote_quorum(st)
+    st, out = _become_leader(st, out, win, E)
+    st, out = _broadcast_replicate(st, out, win, E)
+    lose = vr & ~win & _vote_rejected(st)
+    st = _become_follower(st, lose, st.term, 0)
+    pv = cand & (mt == MT_REQUEST_PREVOTE_RESP) & (st.role == ROLE_PRE_CANDIDATE)
+    slot2, found2 = _slot_of(st, msg["from_id"])
+    rec2 = pv & found2
+    st = st._replace(
+        granted=_set_col(
+            st.granted, slot2, rec2, jnp.where(msg["reject"] == 1, 2, 1)
+        )
+    )
+    pv_win = pv & _vote_quorum(st)
+    st, out = _campaign(
+        st,
+        out,
+        pv_win,
+        jnp.zeros((st.G,), bool),
+        jnp.zeros((st.G,), bool),
+        E,
+    )
+    pv_lose = pv & ~pv_win & _vote_rejected(st)
+    st = _become_follower(st, pv_lose, st.term, 0)
+
+    # ---- follower-ish roles (+ the just-demoted candidates) -----------
+    foll = role_routed & (
+        (st.role == ROLE_FOLLOWER)
+        | (st.role == ROLE_NON_VOTING)
+        | (st.role == ROLE_WITNESS)
+    )
+    lmsg = foll & ((mt == MT_REPLICATE) | (mt == MT_HEARTBEAT))
+    st = st._replace(
+        election_tick=_w(lmsg, 0, st.election_tick),
+        leader_id=_w(lmsg, msg["from_id"], st.leader_id),
+    )
+    st, out = _handle_replicate(st, out, msg, lmsg & (mt == MT_REPLICATE), slot_i)
+    st, out = _handle_heartbeat(st, out, msg, lmsg & (mt == MT_HEARTBEAT))
+    tn = (
+        foll
+        & (mt == MT_TIMEOUT_NOW)
+        & (st.role == ROLE_FOLLOWER)
+        & _self_is_voter(st)
+    )
+    st, out = _campaign(
+        st, out, tn, jnp.zeros((st.G,), bool), jnp.ones((st.G,), bool), E
+    )
+    return st, out
+
+
+def _slot_view(inbox: Inbox, i):
+    """Slot i of every row ([G] / [G, E] views); i may be traced."""
+
+    def ix(a):
+        return lax.dynamic_index_in_dim(a, i, axis=1, keepdims=False)
+
+    return {
+        "mtype": ix(inbox.mtype),
+        "from_id": ix(inbox.from_id),
+        "term": ix(inbox.term),
+        "log_term": ix(inbox.log_term),
+        "log_index": ix(inbox.log_index),
+        "commit": ix(inbox.commit),
+        "reject": ix(inbox.reject),
+        "hint": ix(inbox.hint),
+        "hint_high": ix(inbox.hint_high),
+        "n_entries": ix(inbox.n_entries),
+        "ent_term": ix(inbox.ent_term),
+        "ent_cc": ix(inbox.ent_cc),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("out_capacity",))
+def step(
+    state: DeviceState, inbox: Inbox, out_capacity: int = 32
+) -> Tuple[DeviceState, DeviceOut]:
+    """Advance every row through its inbox.  Pure and jit-compiled; the
+    host wrapper (ops/engine.py) owns staging, payload logs and the
+    escalation replay.
+
+    Slots run under ``lax.fori_loop`` so the compiled program contains
+    ONE slot body regardless of M — compile time stays flat and XLA
+    still fuses the whole body into a few kernels per slot iteration.
+    """
+    G, P, M, E = state.G, state.P, inbox.M, inbox.E
+    out = make_out(G, P, M, E, out_capacity)
+
+    def body(i, carry):
+        st, o = carry
+        return _process_slot(st, o, _slot_view(inbox, i), i, E)
+
+    state, out = lax.fori_loop(0, M, body, (state, out))
+    return state, out

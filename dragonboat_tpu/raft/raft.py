@@ -49,19 +49,25 @@ class RaftRole(enum.IntEnum):
     WITNESS = 5
 
 
-def splitmix64(x: int) -> int:
-    """Counter-based deterministic hash; identical formula on device
-    (ops/step_kernel.py) — this is what makes election jitter replayable."""
-    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+def splitmix32(x: int) -> int:
+    """Counter-based deterministic 32-bit hash (murmur3 finalizer over a
+    Weyl-incremented counter); identical formula on device
+    (ops/kernel.py) — this is what makes election jitter replayable.
+    32-bit on purpose: TPUs have no native int64 and the device kernel
+    runs entirely in int32/uint32 lanes."""
+    x = (x + 0x9E3779B9) & 0xFFFFFFFF
     z = x
-    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
-    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
-    return z ^ (z >> 31)
+    z ^= z >> 16
+    z = (z * 0x85EBCA6B) & 0xFFFFFFFF
+    z ^= z >> 13
+    z = (z * 0xC2B2AE35) & 0xFFFFFFFF
+    z ^= z >> 16
+    return z
 
 
 def election_jitter(shard_id: int, replica_id: int, seq: int, span: int) -> int:
     """Deterministic jitter in [0, span)."""
-    h = splitmix64((shard_id << 24) ^ (replica_id << 8) ^ seq)
+    h = splitmix32(((shard_id << 24) ^ (replica_id << 8) ^ seq) & 0xFFFFFFFF)
     return h % span
 
 
@@ -242,6 +248,9 @@ class Raft:
             self.election_tick = 0
             if self.check_quorum:
                 self.handle(Message(type=MessageType.CHECK_QUORUM))
+                if self.role != RaftRole.LEADER:
+                    # check-quorum stepped us down: no heartbeats at this term
+                    return
             if self.leader_transfer_target != NO_NODE:
                 # transfer did not complete within one election timeout
                 self._abort_leader_transfer()

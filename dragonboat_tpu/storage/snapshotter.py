@@ -3,10 +3,10 @@
 reference: snapshotter.go + internal/fileutil atomic dir finalize [U].
 
 Two backends:
-  * ``InMemSnapshotStorage`` — process-global table (the in-proc analogue
-    of finalized snapshot dirs); used by tests and BASELINE configs 1-2.
-  * ``FileSnapshotStorage`` — atomic temp-file + fsync + rename layout
-    (reference: fileutil.CreateFlagFile / SyncDir [U]).
+  * ``InMemSnapshotStorage`` — per-NodeHost in-memory store (tests); NOT
+    shared between hosts — snapshots cross hosts only via the chunk lane.
+  * ``FileSnapshotStorage`` — atomic temp-file + fsync + rename layout,
+    the NodeHost default (reference: fileutil.CreateFlagFile / SyncDir [U]).
 """
 from __future__ import annotations
 

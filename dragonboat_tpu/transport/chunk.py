@@ -57,12 +57,21 @@ def split_snapshot_message(
 
 
 class _InFlight:
-    __slots__ = ("pieces", "next_chunk", "count")
+    __slots__ = ("pieces", "next_chunk", "count", "ident")
 
-    def __init__(self, count: int):
+    def __init__(self, count: int, ident: tuple):
         self.pieces: List[bytes] = []
         self.next_chunk = 0
         self.count = count
+        # stream identity: every chunk of one stream must agree on these,
+        # otherwise two interleaved streams from the same sender could
+        # splice into one corrupted payload (reference: Chunk.Add validates
+        # non-leading chunks against the in-flight record [U])
+        self.ident = ident
+
+
+def _chunk_ident(c: Chunk) -> tuple:
+    return (c.index, c.term, c.message_term, c.chunk_count, c.file_size, c.filepath)
 
 
 class ChunkSink:
@@ -95,11 +104,15 @@ class ChunkSink:
         with self._lock:
             fl = self._inflight.get(key)
             if c.chunk_id == 0:
-                fl = _InFlight(c.chunk_count)
+                fl = _InFlight(c.chunk_count, _chunk_ident(c))
                 self._inflight[key] = fl
-            elif fl is None or c.chunk_id != fl.next_chunk:
+            elif (
+                fl is None
+                or c.chunk_id != fl.next_chunk
+                or _chunk_ident(c) != fl.ident
+            ):
                 _log.warning(
-                    "out-of-order chunk %d for shard %d from %d",
+                    "out-of-order/mismatched chunk %d for shard %d from %d",
                     c.chunk_id,
                     c.shard_id,
                     c.from_,

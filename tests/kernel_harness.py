@@ -1,0 +1,240 @@
+"""Differential co-simulation harness: scalar oracle vs device kernel.
+
+Both sides receive IDENTICAL per-row ordered message batches each step;
+after every step the full device state is compared bit-for-bit against
+the oracle rows and emitted messages are compared as multisets (emission
+order differs — the oracle emits in sorted-peer loops, the kernel in
+slot-unrolled loops — but the set of wire messages must be identical).
+
+The harness always DELIVERS the oracle's messages (they carry entry
+payloads); the kernel's outbox is used only for the equivalence check.
+This keeps inputs identical on both sides so any divergence is a kernel
+bug, not input skew.
+"""
+from __future__ import annotations
+
+import collections
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dragonboat_tpu.ops import kernel as K
+from dragonboat_tpu.ops import sync as S
+from dragonboat_tpu.ops import types as T
+from dragonboat_tpu.pb import Entry, EntryType, Message, MessageType
+from dragonboat_tpu.raft.raft import Raft
+
+# standard harness geometry (one compile for the whole test module)
+P = 5
+W = 32
+M = 6
+E = 4
+O = 64
+
+
+def eager_step(state, inbox):
+    """Un-jitted slot-by-slot reference run of the kernel (debug aid)."""
+    out = T.make_out(state.G, state.P, inbox.M, inbox.E, O)
+    for i in range(inbox.M):
+        msg = {
+            k: jnp.asarray(np.asarray(getattr(inbox, k))[:, i])
+            for k in inbox._fields
+        }
+        state, out = K._process_slot(state, out, msg, i, inbox.E)
+    return state, out
+
+
+def msg_key(m: Message) -> tuple:
+    return (
+        int(m.type),
+        m.to,
+        m.from_,
+        m.term,
+        m.log_term,
+        m.log_index,
+        m.commit,
+        bool(m.reject),
+        m.hint,
+        m.hint_high,
+        len(m.entries),
+    )
+
+
+class Cluster:
+    """A set of raft groups co-simulated on oracle and device."""
+
+    def __init__(
+        self,
+        groups: Dict[int, Sequence[int]],
+        *,
+        election_timeout: int = 10,
+        heartbeat_timeout: int = 2,
+        check_quorum: bool = False,
+        pre_vote: bool = False,
+        witnesses: Optional[Dict[int, Sequence[int]]] = None,
+        non_votings: Optional[Dict[int, Sequence[int]]] = None,
+        max_entries: int = E,
+    ):
+        self.rafts: Dict[Tuple[int, int], Raft] = {}
+        self.rows: List[Tuple[int, int]] = []
+        witnesses = witnesses or {}
+        non_votings = non_votings or {}
+        for shard, replicas in sorted(groups.items()):
+            wit = set(witnesses.get(shard, ()))
+            nv = set(non_votings.get(shard, ()))
+            voters = {r: f"a{r}" for r in replicas if r not in wit and r not in nv}
+            for rid in sorted(replicas):
+                r = Raft(
+                    shard_id=shard,
+                    replica_id=rid,
+                    peers=dict(voters),
+                    non_votings={i: f"a{i}" for i in sorted(nv)},
+                    witnesses={i: f"a{i}" for i in sorted(wit)},
+                    election_timeout=election_timeout,
+                    heartbeat_timeout=heartbeat_timeout,
+                    check_quorum=check_quorum,
+                    pre_vote=pre_vote,
+                    is_non_voting=rid in nv,
+                    is_witness=rid in wit,
+                    max_entries_per_replicate=max_entries,
+                )
+                self.rafts[(shard, rid)] = r
+                self.rows.append((shard, rid))
+        self.row_of = {key: g for g, key in enumerate(self.rows)}
+        self.state = S.state_from_rafts(
+            [self.rafts[k] for k in self.rows], P, W
+        )
+        # in-flight wire messages per destination row, FIFO
+        self.net: Dict[Tuple[int, int], collections.deque] = {
+            k: collections.deque() for k in self.rows
+        }
+        self.steps = 0
+        # structured tests are strict (no escalation expected); the fuzz
+        # opts in to exercise the escalate-and-replay contract
+        self.allow_escalation = False
+        self.escalations = 0
+
+    # -- driving ---------------------------------------------------------
+    def step(self, batches: Dict[Tuple[int, int], List[Message]]):
+        """Process one batch per row on both sides and compare."""
+        ordered = [list(batches.get(k, ())) for k in self.rows]
+        for msgs in ordered:
+            assert len(msgs) <= M, f"harness batch too large: {len(msgs)}"
+        inbox, overflow = S.encode_inbox(ordered, M, E)
+        assert not overflow, f"inbox overflow rows {overflow}"
+        # oracle side
+        oracle_out: Dict[Tuple[int, int], List[Message]] = {}
+        for key, msgs in zip(self.rows, ordered):
+            r = self.rafts[key]
+            for m in msgs:
+                r.handle(m)
+            oracle_out[key] = r.drain_messages()
+        # device side
+        self.state, out = K.step(self.state, inbox, out_capacity=O)
+        out_np = S.out_to_numpy(out)
+        esc = out_np["escalate"]
+        esc_rows = set(np.nonzero(esc)[0].tolist())
+        if esc_rows and not self.allow_escalation:
+            raise AssertionError(
+                f"unexpected escalation: rows {sorted(esc_rows)} "
+                f"bits {esc[esc != 0].tolist()} at step {self.steps}"
+            )
+        self.compare_state(skip=esc_rows)
+        self.compare_messages(oracle_out, out_np, skip=esc_rows)
+        if esc_rows:
+            # the production escalation contract: discard every device
+            # effect for the row and replay on the oracle (the oracle ran
+            # above), then reload the row onto the device
+            self.escalations += len(esc_rows)
+            self.state = S.state_from_rafts(
+                [self.rafts[k] for k in self.rows], P, W
+            )
+        # queue oracle messages for delivery
+        for key, msgs in oracle_out.items():
+            shard = key[0]
+            for m in msgs:
+                dst = (shard, m.to)
+                if dst in self.net:
+                    self.net[dst].append(m)
+        self.steps += 1
+        return oracle_out
+
+    def deliver_batches(
+        self,
+        *,
+        tick: bool = False,
+        limit: int = M,
+        extra: Optional[Dict[Tuple[int, int], List[Message]]] = None,
+    ) -> Dict[Tuple[int, int], List[Message]]:
+        """Drain up to ``limit`` queued messages per row (+ optional tick
+        first, + optional extra local messages appended last)."""
+        batches: Dict[Tuple[int, int], List[Message]] = {}
+        for key in self.rows:
+            msgs: List[Message] = []
+            if tick:
+                msgs.append(Message(type=MessageType.LOCAL_TICK))
+            q = self.net[key]
+            while q and len(msgs) < limit:
+                msgs.append(q.popleft())
+            for m in (extra or {}).get(key, []):
+                assert len(msgs) < M
+                msgs.append(m)
+            if msgs:
+                batches[key] = msgs
+        return batches
+
+    def run(self, n: int, *, tick=True):
+        for _ in range(n):
+            self.step(self.deliver_batches(tick=tick))
+
+    # -- comparisons -----------------------------------------------------
+    def compare_state(self, skip=()):
+        for g, key in enumerate(self.rows):
+            if g in skip:
+                continue
+            errs = S.row_diff(self.state, g, self.rafts[key])
+            assert not errs, (
+                f"row {key} diverged at step {self.steps}:\n  "
+                + "\n  ".join(errs)
+            )
+
+    def compare_messages(self, oracle_out, out_np, skip=()):
+        for g, key in enumerate(self.rows):
+            if g in skip:
+                continue
+            shard, rid = key
+            dev = S.decode_out_row(out_np, g, shard, rid)
+            want = sorted(msg_key(m) for m in oracle_out[key])
+            got = sorted(
+                msg_key(m)[:-1] + (n,) for (m, n, _src) in dev
+            )
+            assert want == got, (
+                f"row {key} messages diverged at step {self.steps}:\n"
+                f"  oracle: {want}\n  device: {got}"
+            )
+
+    # -- convenience -----------------------------------------------------
+    def leader_of(self, shard: int) -> Optional[int]:
+        for (s, rid), r in self.rafts.items():
+            if s == shard and r.is_leader():
+                return rid
+        return None
+
+    def elect(self, shard: int, max_steps: int = 200) -> int:
+        for _ in range(max_steps):
+            if (lid := self.leader_of(shard)) is not None:
+                # settle in-flight traffic so followers learn the leader
+                for _ in range(4):
+                    if any(self.net[k] for k in self.rows):
+                        self.step(self.deliver_batches(tick=False))
+                return lid
+            self.step(self.deliver_batches(tick=True))
+        raise AssertionError(f"no leader for shard {shard}")
+
+    def propose(self, shard: int, rid: int, payloads: List[bytes], **kw):
+        ents = tuple(
+            Entry(type=EntryType.APPLICATION, cmd=p, **kw) for p in payloads
+        )
+        return Message(type=MessageType.PROPOSE, entries=ents)
