@@ -1,0 +1,82 @@
+"""Headline benchmark: raft group-ticks/sec on one chip.
+
+North star (BASELINE.json): step 100k concurrent raft groups at >=10k
+ticks/sec on a single v5e-1 == 1e9 group-ticks/sec.  This bench hosts
+all 3 replicas of 100k groups as 300k device rows, fuses 8 logical
+ticks per kernel launch (multi-tick fusion, SURVEY.md §7 hard parts),
+and measures steady-state launch throughput on the default JAX backend.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from dragonboat_tpu.ops.kernel import step
+    from dragonboat_tpu.ops.types import MT_TICK, make_inbox, make_state
+
+    NORTH_STAR = 1e9  # group-ticks/sec
+
+    GROUPS = 100_000
+    REPLICAS = 3
+    G = GROUPS * REPLICAS
+    P, W, M, E, O = 3, 8, 8, 1, 16
+
+    # row layout: group-major; group g hosts replicas {1,2,3}
+    shard_ids = np.repeat(np.arange(1, GROUPS + 1, dtype=np.int32), REPLICAS)
+    replica_ids = np.tile(np.arange(1, REPLICAS + 1, dtype=np.int32), GROUPS)
+    peer_ids = np.broadcast_to(
+        np.arange(1, REPLICAS + 1, dtype=np.int32), (G, P)
+    ).copy()
+
+    st = make_state(
+        G,
+        P,
+        W,
+        shard_ids=shard_ids,
+        replica_ids=replica_ids,
+        peer_ids=peer_ids,
+        election_timeout=10,
+        heartbeat_timeout=1,
+    )
+    inbox = make_inbox(G, M, E)
+    inbox = inbox._replace(mtype=inbox.mtype.at[:, :].set(MT_TICK))
+
+    dev = jax.devices()[0]
+    st = jax.device_put(st, dev)
+    inbox = jax.device_put(inbox, dev)
+
+    # warmup: compile + settle into steady-state election churn
+    for _ in range(3):
+        st, out = step(st, inbox, out_capacity=O)
+    jax.block_until_ready(st)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        st, out = step(st, inbox, out_capacity=O)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+
+    group_ticks_per_sec = GROUPS * M * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "raft_group_ticks_per_sec_per_chip",
+                "value": round(group_ticks_per_sec, 1),
+                "unit": "group-ticks/sec",
+                "vs_baseline": round(group_ticks_per_sec / NORTH_STAR, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

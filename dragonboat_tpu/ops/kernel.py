@@ -1224,6 +1224,12 @@ def step(
     """
     G, P, M, E = state.G, state.P, inbox.M, inbox.E
     out = make_out(G, P, M, E, out_capacity)
+    # inherit the state's varying-ness (shard_map vma) so the fori_loop
+    # carry types match when the step runs sharded over the groups axis
+    zero = state.term * 0  # [G]
+    out = jax.tree.map(
+        lambda a: a + zero.reshape((G,) + (1,) * (a.ndim - 1)), out
+    )
 
     def body(i, carry):
         st, o = carry
