@@ -81,6 +81,9 @@ class IStepEngine(abc.ABC):
 
     def stop(self) -> None: ...
 
+    def detach(self, shard_id: int) -> None:
+        """A shard was unregistered; release any engine-held row state."""
+
 
 class HostStepEngine(IStepEngine):
     """Default serial step loop with cross-shard batched WAL writes."""
@@ -164,6 +167,7 @@ class ExecEngine:
     def unregister(self, shard_id: int) -> None:
         with self._nodes_lock:
             self._nodes.pop(shard_id, None)
+        self.step_engine.detach(shard_id)
 
     def nodes_for_partition(self, shard_ids: List[int]) -> List["Node"]:
         with self._nodes_lock:

@@ -48,6 +48,53 @@ from .storage.logdb import LogDBLogReader
 _log = get_logger("nodehost")
 
 
+class StepInputs:
+    """One atomic drain of a node's input queues (see drain_step_inputs)."""
+
+    __slots__ = (
+        "received",
+        "proposals",
+        "read_indexes",
+        "config_changes",
+        "cc_results",
+        "transfers",
+        "snapshot_reqs",
+        "ticks",
+    )
+
+    def __init__(
+        self,
+        received=(),
+        proposals=(),
+        read_indexes=(),
+        config_changes=(),
+        cc_results=(),
+        transfers=(),
+        snapshot_reqs=(),
+        ticks=0,
+    ):
+        self.received = list(received)
+        self.proposals = list(proposals)
+        self.read_indexes = list(read_indexes)
+        self.config_changes = list(config_changes)
+        self.cc_results = list(cc_results)
+        self.transfers = list(transfers)
+        self.snapshot_reqs = list(snapshot_reqs)
+        self.ticks = ticks
+
+    def empty(self) -> bool:
+        return not (
+            self.received
+            or self.proposals
+            or self.read_indexes
+            or self.config_changes
+            or self.cc_results
+            or self.transfers
+            or self.snapshot_reqs
+            or self.ticks
+        )
+
+
 class Node:
     def __init__(
         self,
@@ -254,28 +301,48 @@ class Node:
     # ------------------------------------------------------------------
     # step path (owning step worker only)
     # ------------------------------------------------------------------
+    def drain_step_inputs(self) -> "StepInputs":
+        """Atomically drain every input queue (the first half of stepNode;
+        split out so a vectorized step engine can route drained inputs to
+        the device or replay them on the scalar peer — ops/engine.py)."""
+        with self._qlock:
+            si = StepInputs(
+                received=list(self._received),
+                proposals=list(self._proposals),
+                read_indexes=list(self._read_indexes),
+                config_changes=list(self._config_changes),
+                cc_results=list(self._cc_to_apply),
+                transfers=list(self._leader_transfers),
+                snapshot_reqs=list(self._snapshot_reqs),
+                ticks=self._pending_ticks,
+            )
+            self._received.clear()
+            self._proposals.clear()
+            self._read_indexes.clear()
+            self._config_changes.clear()
+            self._cc_to_apply.clear()
+            self._leader_transfers.clear()
+            self._snapshot_reqs.clear()
+            self._pending_ticks = 0
+        return si
+
     def step(self) -> Optional[Update]:
         """Drain inputs into the raft peer and produce this shard's Update
         (reference: node.stepNode [U])."""
         if self.stopped:
             return None
-        with self._qlock:
-            received = list(self._received)
-            self._received.clear()
-            proposals = list(self._proposals)
-            self._proposals.clear()
-            read_indexes = list(self._read_indexes)
-            self._read_indexes.clear()
-            config_changes = list(self._config_changes)
-            self._config_changes.clear()
-            cc_results = list(self._cc_to_apply)
-            self._cc_to_apply.clear()
-            transfers = list(self._leader_transfers)
-            self._leader_transfers.clear()
-            snapshot_reqs = list(self._snapshot_reqs)
-            self._snapshot_reqs.clear()
-            ticks = self._pending_ticks
-            self._pending_ticks = 0
+        return self.step_with_inputs(self.drain_step_inputs())
+
+    def step_with_inputs(self, si: "StepInputs") -> Optional[Update]:
+        """Run the scalar step on pre-drained inputs."""
+        received = si.received
+        proposals = si.proposals
+        read_indexes = si.read_indexes
+        config_changes = si.config_changes
+        cc_results = si.cc_results
+        transfers = si.transfers
+        snapshot_reqs = si.snapshot_reqs
+        ticks = si.ticks
 
         # config-change application results from the apply loop
         for cc, accepted in cc_results:
@@ -340,6 +407,11 @@ class Node:
         for path in rx_candidates:
             if path != accepted_path:
                 self.snapshot_storage.remove(path)
+        self.dispatch_dropped(u)
+        return u
+
+    def dispatch_dropped(self, u: Update) -> None:
+        """Fail dropped-request futures fast (both step engines call this)."""
         for e in u.dropped_entries:
             # route by entry kind: proposal and config-change futures live
             # in different tables with independent key spaces
@@ -351,7 +423,6 @@ class Node:
                 self.pending_proposal.dropped(e.key)
         for ctx in u.dropped_read_indexes:
             self.pending_read_index.dropped(ctx)
-        return u
 
     def _sync_registry(self, membership: Membership) -> None:
         """Every replica (not just the API caller) must be able to resolve

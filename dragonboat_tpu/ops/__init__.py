@@ -4,9 +4,11 @@ Layout (SURVEY.md §7 build step 4):
   types.py  — SoA DeviceState / Inbox / DeviceOut tensor layouts
   kernel.py — the jit/vmap step function (the "raft.Step as MXU work" core)
   sync.py   — oracle<->row conversion, message staging, parity helpers
+  engine.py — VectorStepEngine: the device-backed IStepEngine
 """
 from .types import DeviceOut, DeviceState, Inbox, make_inbox, make_out, make_state
 from .kernel import step
+from .engine import VectorStepEngine, vector_step_engine_factory
 
 __all__ = [
     "DeviceOut",
@@ -16,4 +18,6 @@ __all__ = [
     "make_out",
     "make_state",
     "step",
+    "VectorStepEngine",
+    "vector_step_engine_factory",
 ]

@@ -1,0 +1,804 @@
+"""VectorStepEngine: the device-backed step engine (the north star).
+
+Replaces the per-shard scalar ``node.step()`` loop of ``HostStepEngine``
+with ONE kernel launch over a `[G]`-row device-resident state tensor
+(reference: engine.go stepWorkerMain becomes a vectorized kernel, per
+BASELINE.json north_star).  The division of labor:
+
+  * **device** — protocol state (term/vote/role/ticks/remotes/log-term
+    ring) and the hot step function (`ops/kernel.py`).
+  * **host (scalar ``Raft``)** — the authoritative payload log
+    (``EntryLog`` over the LogDB reader), sessions, ReadIndex
+    bookkeeping, snapshots, and every cold input.  For device-resident
+    rows the scalar's protocol fields are stale EXCEPT term / vote /
+    leader_id / role / log.committed, which are re-synced from the
+    device after every step so the standard ``Peer.get_update()`` /
+    ``node.process_update()`` plumbing keeps working unchanged.
+
+Row routing per step (see `_plan_device`):
+
+  * hot inputs (ticks, hot wire messages, application proposals) →
+    encoded into the device inbox;
+  * cold inputs (config change, read index, snapshot request, leader
+    transfer, cold message types, oversized batches) → the row is
+    **materialized** (device → scalar copy) and stepped by the scalar
+    path; the row is re-uploaded when it goes hot again;
+  * kernel escalation (ESC_* bits) → the row's device effects are
+    discarded (pre-step state restored) and the drained inputs are
+    replayed on the materialized scalar — the escalation contract from
+    ops/kernel.py's module docstring.
+
+Log reconstruction: the kernel reports ``append_lo`` (lowest ring-
+written index).  The host stamps payload entries for
+[append_lo, last_index] from its staging map (proposal entries by
+slot_base; REPLICATE payloads by wire position), picking the last
+slot-order candidate whose term matches the ring term; gaps are
+become-leader noop barriers.  The merged entries flow out through
+``Update.entries_to_save`` exactly as in the scalar engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.execengine import IStepEngine
+from ..logger import get_logger
+from ..pb import Entry, EntryType, Message, MessageType, Snapshot
+from ..raft.raft import Raft, RaftRole
+from ..raft.remote import RemoteState
+from . import kernel as K
+from . import sync as S
+from .types import (
+    APPEND_LO_NONE,
+    F_LOG_INDEX,
+    F_MTYPE,
+    F_N_ENTRIES,
+    F_SRC_SLOT,
+    F_TO,
+    HOT_TYPES,
+    I32,
+    RS_SNAPSHOT,
+    SLOT_DROPPED,
+    SLOT_FORWARDED,
+    DeviceState,
+    make_state,
+)
+
+_log = get_logger("engine")
+
+_HOT_SET = frozenset(HOT_TYPES)
+
+# readback row indices of the _summarize stack
+_R_TERM, _R_VOTE, _R_COMMIT, _R_LEADER, _R_ROLE, _R_LAST = range(6)
+_R_COUNT, _R_ESC, _R_APPEND_LO, _R_NEED_SS = 6, 7, 8, 9
+
+
+def _bucket(n: int) -> int:
+    """Next power of two ≥ n (bounds jit recompiles for dynamic row sets)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _pad_idx(idx: Sequence[int]) -> np.ndarray:
+    pad = _bucket(len(idx))
+    out = np.empty((pad,), np.int32)
+    out[: len(idx)] = idx
+    out[len(idx):] = idx[-1]  # duplicate scatter/gather of one row is benign
+    return out
+
+
+@jax.jit
+def _scatter_rows(state: DeviceState, idx, sub: DeviceState) -> DeviceState:
+    return jax.tree.map(lambda a, b: a.at[idx].set(b), state, sub)
+
+
+@jax.jit
+def _select_rows(keep_new, old: DeviceState, new: DeviceState) -> DeviceState:
+    def sel(a, b):
+        m = keep_new.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, b, a)
+
+    return jax.tree.map(sel, old, new)
+
+
+@jax.jit
+def _gather_rows(state: DeviceState, idx) -> DeviceState:
+    return jax.tree.map(lambda a: a[idx], state)
+
+
+@jax.jit
+def _summarize(state: DeviceState, out) -> jnp.ndarray:
+    return jnp.stack(
+        [
+            state.term,
+            state.vote,
+            state.committed,
+            state.leader_id,
+            state.role,
+            state.last_index,
+            out.count,
+            out.escalate,
+            out.append_lo,
+            jnp.any(out.need_snapshot == 1, axis=1).astype(I32),
+        ]
+    )
+
+
+@jax.jit
+def _gather_tree(arrs, idx):
+    return jax.tree.map(lambda a: a[idx], arrs)
+
+
+@jax.jit
+def _set_remote_snapshot(state: DeviceState, g_idx, p_idx, snap_idx):
+    return state._replace(
+        rstate=state.rstate.at[g_idx, p_idx].set(RS_SNAPSHOT),
+        snap_index=state.snap_index.at[g_idx, p_idx].set(snap_idx),
+    )
+
+
+class _RowMeta:
+    __slots__ = ("node", "dirty")
+
+    def __init__(self, node):
+        self.node = node
+        # dirty = the scalar Raft is authoritative and the device row is
+        # stale (fresh rows, cold-stepped rows, escalated rows)
+        self.dirty = True
+
+
+class VectorStepEngine(IStepEngine):
+    """Device-backed IStepEngine (plug in via ExpertConfig
+    .step_engine_factory = vector_step_engine_factory(...))."""
+
+    def __init__(
+        self,
+        logdb,
+        *,
+        capacity: int = 1024,
+        P: int = 5,
+        W: int = 32,
+        M: int = 8,
+        E: int = 4,
+        O: int = 32,
+        device=None,
+    ):
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self.logdb = logdb
+        self.capacity, self.P, self.W, self.M, self.E, self.O = (
+            capacity,
+            P,
+            W,
+            M,
+            E,
+            O,
+        )
+        self._device = device if device is not None else jax.devices()[0]
+        # inert rows: no peers, empty inbox -> the kernel never touches them
+        self._state = jax.device_put(
+            make_state(capacity, P, W, replica_ids=np.zeros(capacity)),
+            self._device,
+        )
+        self._row_of: Dict[int, int] = {}  # shard_id -> g
+        self._meta: Dict[int, _RowMeta] = {}  # g -> meta
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._lock = threading.Lock()
+        self._warned_full = False
+        # host mirrors of the summary scalars (term/vote/commit/...)
+        self._mirror = np.zeros((6, capacity), np.int64)
+        self.stats = {
+            "device_steps": 0,
+            "device_rows_stepped": 0,
+            "host_rows_stepped": 0,
+            "escalations": 0,
+        }
+        self._warm()
+
+    def _put(self, x):
+        """Commit an array/pytree to the engine device.
+
+        EVERY array entering a jitted helper goes through this: jax keys
+        executables on argument committed-ness/sharding, so mixing
+        committed and uncommitted calls silently doubles every compile
+        (~60s each for the step kernel)."""
+        return jax.device_put(x, self._device)
+
+    def _warm(self) -> None:
+        """Pre-compile the kernel and every per-bucket helper shape so the
+        first real step doesn't stall the step worker for seconds (the
+        persistent compilation cache makes this nearly free after the
+        first process on a machine)."""
+        from .types import make_inbox
+
+        st = self._state
+        inbox = self._put(make_inbox(self.capacity, self.M, self.E))
+        _, out = K.step(st, inbox, out_capacity=self.O)
+        _summarize(st, out)
+        _select_rows(self._put(jnp.ones((self.capacity,), bool)), st, st)
+        b = 1
+        while b <= self.capacity:
+            idx = self._put(jnp.zeros((b,), jnp.int32))
+            sub = _gather_rows(st, idx)
+            _scatter_rows(st, idx, sub)
+            if b <= 4:
+                for arr in (
+                    out.buf,
+                    out.slot_base,
+                    out.ent_drop,
+                    out.need_snapshot,
+                    st.ring_term,
+                ):
+                    _gather_tree(arr, idx)
+            b <<= 1
+        one = self._put(jnp.zeros((1,), jnp.int32))
+        _set_remote_snapshot(st, one, one, one)
+        jax.block_until_ready(self._state)
+
+    # ------------------------------------------------------------------
+    # row lifecycle
+    # ------------------------------------------------------------------
+    def detach(self, shard_id: int) -> None:
+        with self._lock:
+            g = self._row_of.pop(shard_id, None)
+            if g is not None:
+                self._meta.pop(g, None)
+                self._free.append(g)
+
+    def _attach(self, node) -> Optional[int]:
+        g = self._row_of.get(node.shard_id)
+        if g is not None:
+            return g
+        if not self._free:
+            if not self._warned_full:
+                self._warned_full = True
+                _log.warning(
+                    "vector engine at capacity %d; overflow shards stay on "
+                    "the host path",
+                    self.capacity,
+                )
+            return None
+        g = self._free.pop()
+        self._row_of[node.shard_id] = g
+        self._meta[g] = _RowMeta(node)
+        return g
+
+    # ------------------------------------------------------------------
+    # classification
+    # ------------------------------------------------------------------
+    def _plan_device(self, node, si) -> Optional[List[Tuple]]:
+        """Return the ordered inbox slot plan, or None for the host path.
+
+        Slot order mirrors the scalar replay order in
+        ``Node.step_with_inputs``: received messages, proposals, ticks.
+        """
+        if node.quiesce.enabled:
+            return None
+        if (
+            si.config_changes
+            or si.cc_results
+            or si.snapshot_reqs
+            or si.transfers
+            or si.read_indexes
+        ):
+            return None
+        r = node.peer.raft
+        if len(r.addresses) > self.P:
+            return None
+        if r.read_index.pending or r.read_index.queue:
+            return None
+        if r.snapshotting:
+            return None
+        slots: List[Tuple] = []
+        lim = 2**31 - 1
+        for m in si.received:
+            if int(m.type) not in _HOT_SET:
+                return None
+            if len(m.entries) > self.E:
+                return None
+            # the device inbox is int32; 64-bit fields (e.g. ReadIndex ctx
+            # keys riding heartbeat hints) take the scalar path
+            if (
+                m.term > lim
+                or m.log_term > lim
+                or m.log_index > lim
+                or m.commit > lim
+                or m.hint > lim
+                or m.hint_high > lim
+            ):
+                return None
+            slots.append(("msg", m))
+        E = self.E
+        props = si.proposals
+        for i in range(0, len(props), E):
+            slots.append(("prop", props[i : i + E]))
+        slots.extend(("tick", None) for _ in range(si.ticks))
+        if len(slots) > self.M:
+            return None
+        return slots
+
+    # ------------------------------------------------------------------
+    # device <-> scalar state movement
+    # ------------------------------------------------------------------
+    def _upload_rows(self, rows: List[Tuple[int, "Raft"]]) -> None:
+        """Scalar -> device for dirty rows (batched scatter)."""
+        if not rows:
+            return
+        sub = S.state_from_rafts([r for _, r in rows], self.P, self.W)
+        pad = _bucket(len(rows))
+        if pad > len(rows):
+            sub = jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.repeat(a[-1:], pad - a.shape[0], axis=0)]
+                ),
+                sub,
+            )
+        idx = self._put(jnp.asarray(_pad_idx([g for g, _ in rows])))
+        self._state = _scatter_rows(self._state, idx, self._put(sub))
+        for k, (g, r) in enumerate(rows):
+            self._mirror[_R_TERM, g] = r.term
+            self._mirror[_R_VOTE, g] = r.vote
+            self._mirror[_R_COMMIT, g] = r.log.committed
+            self._mirror[_R_LEADER, g] = r.leader_id
+            self._mirror[_R_ROLE, g] = int(r.role)
+            self._mirror[_R_LAST, g] = r.log.last_index()
+            self._meta[g].dirty = False
+
+    def _materialize_rows(
+        self, gs: List[int], state: Optional[DeviceState] = None
+    ) -> None:
+        """Device -> scalar for rows leaving the device (batched gather).
+
+        Copies the protocol fields the device owns; scalar-only state
+        (ReadIndex table, sessions, is_leader_transfer_target) was never
+        touched by the device path and stays as-is.
+        """
+        if not gs:
+            return
+        st = state if state is not None else self._state
+        idx = self._put(jnp.asarray(_pad_idx(gs)))
+        sub = jax.tree.map(np.asarray, _gather_rows(st, idx))
+        for k, g in enumerate(gs):
+            r = self._meta[g].node.peer.raft
+            r.term = int(sub.term[k])
+            r.vote = int(sub.vote[k])
+            r.leader_id = int(sub.leader_id[k])
+            r.role = RaftRole(int(sub.role[k]))
+            r.log.committed = int(sub.committed[k])
+            r.election_tick = int(sub.election_tick[k])
+            r.heartbeat_tick = int(sub.heartbeat_tick[k])
+            r.randomized_election_timeout = int(sub.rand_timeout[k])
+            r._timeout_seq = int(sub.timeout_seq[k])
+            r.pending_config_change = bool(sub.pending_cc[k])
+            r.leader_transfer_target = int(sub.transfer_target[k])
+            votes = {}
+            for p in range(self.P):
+                pid = int(sub.peer_id[k, p])
+                if pid == 0:
+                    continue
+                rm = r.get_remote(pid)
+                if rm is None:
+                    continue
+                rm.match = int(sub.match[k, p])
+                rm.next = int(sub.next_idx[k, p])
+                rm.state = RemoteState(int(sub.rstate[k, p]))
+                rm.snapshot_index = int(sub.snap_index[k, p])
+                rm.active = bool(sub.active[k, p])
+                granted = int(sub.granted[k, p])
+                if granted:
+                    votes[pid] = granted == 1
+            r.votes = votes
+            dev_last = int(sub.last_index[k])
+            host_last = r.log.last_index()
+            if dev_last != host_last:
+                _log.error(
+                    "[%d:%d] device/host log divergence: device last=%d "
+                    "host last=%d",
+                    r.shard_id,
+                    r.replica_id,
+                    dev_last,
+                    host_last,
+                )
+
+    # ------------------------------------------------------------------
+    # the step
+    # ------------------------------------------------------------------
+    def step_shards(self, nodes, worker_id: int) -> None:
+        with self._lock:
+            self._step_locked(nodes, worker_id)
+
+    def _step_locked(self, nodes, worker_id: int) -> None:
+        updates: List[Tuple] = []  # (node, Update)
+        host_rows: List[Tuple] = []  # (node, si)
+        batch: List[Tuple] = []  # (node, g, si, plan)
+        for node in nodes:
+            if node.stopped:
+                continue
+            si = node.drain_step_inputs()
+            plan = self._plan_device(node, si)
+            g = self._attach(node) if plan is not None else self._row_of.get(
+                node.shard_id
+            )
+            if plan is None or g is None:
+                host_rows.append((node, si))
+                continue
+            if not plan and not self._meta[g].dirty:
+                continue  # nothing to do for this row
+            batch.append((node, g, si, plan))
+
+        # ---- host path (cold rows) -----------------------------------
+        to_mat = []
+        for node, si in host_rows:
+            g = self._row_of.get(node.shard_id)
+            if g is not None and not self._meta[g].dirty:
+                to_mat.append(g)
+                self._meta[g].dirty = True
+        self._materialize_rows(to_mat)  # one batched gather for all
+        for node, si in host_rows:
+            u = node.step_with_inputs(si)
+            self.stats["host_rows_stepped"] += 1
+            if u is not None:
+                updates.append((node, u))
+
+        # ---- device path ---------------------------------------------
+        if batch:
+            self._upload_rows(
+                [
+                    (g, node.peer.raft)
+                    for node, g, si, plan in batch
+                    if self._meta[g].dirty
+                ]
+            )
+            updates.extend(self._device_step(batch))
+
+        if updates:
+            self.logdb.save_raft_state([u for _, u in updates], worker_id)
+            for node, u in updates:
+                if node.process_update(u):
+                    node.engine_apply_ready(node.shard_id)
+
+    def _device_step(self, batch) -> List[Tuple]:
+        G, M, E = self.capacity, self.M, self.E
+        # encode inboxes + staging (slot -> payload entries)
+        msg_rows: List[List[Message]] = [[] for _ in range(G)]
+        staging: Dict[int, Dict[int, List[Entry]]] = {}
+        prop_rows: List[int] = []
+        for node, g, si, plan in batch:
+            row_msgs = msg_rows[g]
+            stage = {}
+            for slot, (kind, payload) in enumerate(plan):
+                if kind == "msg":
+                    row_msgs.append(payload)
+                    if payload.entries:
+                        stage[slot] = list(payload.entries)
+                elif kind == "prop":
+                    row_msgs.append(
+                        Message(
+                            type=MessageType.PROPOSE,
+                            entries=tuple(payload),
+                        )
+                    )
+                    stage[slot] = list(payload)
+                else:  # tick
+                    row_msgs.append(Message(type=MessageType.LOCAL_TICK))
+            if stage:
+                staging[g] = stage
+            if any(k == "prop" for k, _ in plan) or any(
+                k == "msg" and int(p.type) == int(MessageType.PROPOSE)
+                for k, p in plan
+            ):
+                prop_rows.append(g)
+        inbox, overflow = S.encode_inbox(msg_rows, M, E)
+        assert not overflow, f"planner let oversized rows through: {overflow}"
+        inbox = jax.device_put(inbox, self._device)
+
+        old_state = self._state
+        new_state, out = K.step(old_state, inbox, out_capacity=self.O)
+        summary = np.asarray(_summarize(new_state, out))
+        self.stats["device_steps"] += 1
+        self.stats["device_rows_stepped"] += len(batch)
+
+        # ---- escalations: restore + scalar replay --------------------
+        esc_rows = [
+            (node, g, si)
+            for node, g, si, plan in batch
+            if summary[_R_ESC, g] != 0
+        ]
+        updates: List[Tuple] = []
+        if esc_rows:
+            self.stats["escalations"] += len(esc_rows)
+            keep_new = np.ones((G,), bool)
+            for _, g, _ in esc_rows:
+                keep_new[g] = False
+            new_state = _select_rows(
+                self._put(jnp.asarray(keep_new)), old_state, new_state
+            )
+            self._materialize_rows([g for _, g, _ in esc_rows], old_state)
+            for node, g, si in esc_rows:
+                self._meta[g].dirty = True
+                u = node.step_with_inputs(si)
+                if u is not None:
+                    updates.append((node, u))
+        self._state = new_state
+        esc_set = {g for _, g, _ in esc_rows}
+
+        # ---- gather detail for affected rows -------------------------
+        live = [(node, g, si) for node, g, si, plan in batch if g not in esc_set]
+        buf_rows = [g for _, g, _ in live if summary[_R_COUNT, g] > 0]
+        append_rows = [
+            g for _, g, _ in live if summary[_R_APPEND_LO, g] != APPEND_LO_NONE
+        ]
+        slot_rows = [g for g in prop_rows if g not in esc_set]
+        buf_np = self._gather(out.buf, buf_rows)
+        ring_t = self._gather(new_state.ring_term, append_rows)
+        ring_c = self._gather(new_state.ring_cc, append_rows)
+        slot_base = self._gather(out.slot_base, slot_rows)
+        slot_term = self._gather(out.slot_term, slot_rows)
+        ent_drop = self._gather(out.ent_drop, slot_rows)
+        need_rows = [g for _, g, _ in live if summary[_R_NEED_SS, g]]
+        need_np = self._gather(out.need_snapshot, need_rows)
+        buf_at = {g: k for k, g in enumerate(buf_rows)}
+        ring_at = {g: k for k, g in enumerate(append_rows)}
+        slot_at = {g: k for k, g in enumerate(slot_rows)}
+        need_at = {g: k for k, g in enumerate(need_rows)}
+
+        # ---- per-row update construction -----------------------------
+        snapshot_sends: List[Tuple[int, int, int]] = []  # (g, p, ss_index)
+        for node, g, si in live:
+            r = node.peer.raft
+            term, vote, committed, leader, role, last = (
+                int(summary[i, g]) for i in range(6)
+            )
+            changed = (
+                summary[:6, g] != self._mirror[:6, g]
+            ).any() or summary[_R_COUNT, g] > 0
+            appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
+            # tick bookkeeping (mirrors Node.step_with_inputs)
+            for _ in range(si.ticks):
+                node.tick_count += 1
+                node.pending_proposal.gc(node.tick_count)
+                node.pending_read_index.gc(node.tick_count)
+                node.pending_config_change.gc(node.tick_count)
+                node.pending_snapshot.gc(node.tick_count)
+                node.pending_leader_transfer.gc(node.tick_count)
+            if not (
+                changed
+                or appended
+                or summary[_R_NEED_SS, g]
+                or g in slot_at
+            ):
+                continue
+            # 1. append reconstruction
+            if appended:
+                self._merge_appends(
+                    r,
+                    g,
+                    int(summary[_R_APPEND_LO, g]),
+                    last,
+                    staging.get(g, {}),
+                    slot_at,
+                    slot_base,
+                    slot_term,
+                    ent_drop,
+                    ring_t[ring_at[g]],
+                    ring_c[ring_at[g]],
+                )
+            # 2. protocol scalar sync
+            r.term, r.vote, r.leader_id = term, vote, leader
+            r.role = RaftRole(role)
+            if committed > r.log.committed:
+                r.log.commit_to(committed)
+            # 3. outbox -> messages with payload attachment
+            if g in buf_at:
+                self._attach_messages(
+                    r,
+                    node,
+                    buf_np[buf_at[g]],
+                    int(summary[_R_COUNT, g]),
+                    staging.get(g, {}),
+                )
+            # 4. dropped proposal slots / cc-gated entries -> futures
+            if g in slot_at:
+                sb = slot_base[slot_at[g]]
+                drop = ent_drop[slot_at[g]]
+                for slot, ents in staging.get(g, {}).items():
+                    if sb[slot] == SLOT_DROPPED:
+                        r.dropped_entries.extend(ents)
+                    elif sb[slot] >= 0:
+                        r.dropped_entries.extend(
+                            e for j, e in enumerate(ents) if drop[slot, j]
+                        )
+            # 5. peers needing a snapshot stream
+            if g in need_at:
+                self._send_snapshots(
+                    r, g, need_np[need_at[g]], snapshot_sends
+                )
+            u = node.peer.get_update(last_applied=node.sm.last_applied)
+            node.dispatch_dropped(u)
+            updates.append((node, u))
+            self._mirror[:6, g] = summary[:6, g]
+            node._check_leader_change()
+
+        if snapshot_sends:
+            self._state = _set_remote_snapshot(
+                self._state,
+                self._put(jnp.asarray(_pad_idx([g for g, _, _ in snapshot_sends]))),
+                self._put(jnp.asarray(_pad_idx([p for _, p, _ in snapshot_sends]))),
+                self._put(jnp.asarray(_pad_idx([i for _, _, i in snapshot_sends]))),
+            )
+        return updates
+
+    def _gather(self, arr, rows: List[int]) -> Optional[np.ndarray]:
+        if not rows:
+            return None
+        return np.asarray(_gather_tree(arr, self._put(jnp.asarray(_pad_idx(rows)))))
+
+    # -- append reconstruction -----------------------------------------
+    def _merge_appends(
+        self,
+        r: Raft,
+        g: int,
+        lo: int,
+        last: int,
+        stage: Dict[int, List[Entry]],
+        slot_at,
+        slot_base,
+        slot_term,
+        ent_drop,
+        ring_term_row,
+        ring_cc_row,
+    ) -> None:
+        W = self.W
+        # candidates[idx] = (slot_order, Entry, term); later slots win
+        cand: Dict[int, List[Tuple[int, Entry, int]]] = {}
+        sb = slot_base[slot_at[g]] if g in slot_at else None
+        stm = slot_term[slot_at[g]] if g in slot_at else None
+        drop = ent_drop[slot_at[g]] if g in slot_at else None
+        for slot in sorted(stage):
+            ents = stage[slot]
+            if sb is not None and sb[slot] >= 0:
+                # a PROPOSE slot accepted at base sb[slot]
+                pos = int(sb[slot])
+                for j, e in enumerate(ents):
+                    if drop is not None and drop[slot, j]:
+                        continue
+                    pos += 1
+                    cand.setdefault(pos, []).append(
+                        (slot, e, int(stm[slot]))
+                    )
+            elif ents and ents[0].index > 0:
+                # REPLICATE payload: wire entries carry index+term
+                for e in ents:
+                    cand.setdefault(e.index, []).append((slot, e, e.term))
+        stamped: List[Entry] = []
+        for idx in range(lo, last + 1):
+            rt = int(ring_term_row[idx & (W - 1)])
+            pick: Optional[Tuple[int, Entry, int]] = None
+            for c in cand.get(idx, ()):
+                if c[2] == rt and (pick is None or c[0] >= pick[0]):
+                    pick = c
+            if pick is None:
+                # become-leader noop barrier (the only unstaged append)
+                if int(ring_cc_row[idx & (W - 1)]) != 0:
+                    raise RuntimeError(
+                        f"[{r.shard_id}:{r.replica_id}] unstaged config "
+                        f"change at index {idx}"
+                    )
+                stamped.append(
+                    Entry(term=rt, index=idx, type=EntryType.APPLICATION)
+                )
+            else:
+                e = pick[1]
+                stamped.append(
+                    Entry(
+                        term=rt,
+                        index=idx,
+                        type=e.type,
+                        key=e.key,
+                        client_id=e.client_id,
+                        series_id=e.series_id,
+                        responded_to=e.responded_to,
+                        cmd=e.cmd,
+                    )
+                )
+        r.log.inmem.merge(stamped)
+
+    # -- outbox decode + payload attachment ----------------------------
+    def _attach_messages(
+        self,
+        r: Raft,
+        node,
+        buf_row: np.ndarray,
+        count: int,
+        stage: Dict[int, List[Entry]],
+    ) -> None:
+        shim = {"count": np.array([count]), "buf": buf_row[None]}
+        for msg, n_ent, src_slot in S.decode_out_row(
+            shim, 0, r.shard_id, r.replica_id
+        ):
+            if msg.type == MessageType.REPLICATE and n_ent > 0:
+                ents = self._replicate_payload(r, msg, n_ent)
+                if ents is None:
+                    continue  # stale vs final log; dropping is raft-safe
+                msg = dataclasses.replace(msg, entries=tuple(ents))
+            elif msg.type == MessageType.PROPOSE and src_slot >= 0:
+                msg = dataclasses.replace(
+                    msg, entries=tuple(stage.get(src_slot, ()))
+                )
+            r.msgs.append(msg)
+
+    def _replicate_payload(
+        self, r: Raft, msg: Message, n_ent: int
+    ) -> Optional[List[Entry]]:
+        from ..raft.log import LogCompactedError, LogUnavailableError
+
+        try:
+            if msg.log_index > 0 and r.log.term(msg.log_index) != msg.log_term:
+                return None
+            ents = r.log._get_entries(
+                msg.log_index + 1, msg.log_index + 1 + n_ent, 2**62
+            )
+        except (LogCompactedError, LogUnavailableError):
+            return None
+        if len(ents) != n_ent:
+            return None
+        if msg.to in r.witnesses:
+            ents = [r._to_witness_entry(e) for e in ents]
+        return ents
+
+    # -- snapshot streaming kick-off -----------------------------------
+    def _send_snapshots(
+        self,
+        r: Raft,
+        g: int,
+        need_row: np.ndarray,
+        snapshot_sends: List[Tuple[int, int, int]],
+    ) -> None:
+        peer_ids = np.asarray(self._state.peer_id[g])  # small row fetch
+        ss = r.log.logdb.snapshot()
+        for p in range(self.P):
+            if not need_row[p]:
+                continue
+            pid = int(peer_ids[p])
+            if pid == 0 or ss.is_empty():
+                continue  # remote stays WAIT; retried via heartbeat resp
+            send = ss
+            if pid in r.witnesses:
+                send = Snapshot(
+                    index=ss.index,
+                    term=ss.term,
+                    membership=ss.membership,
+                    dummy=True,
+                    witness=True,
+                    shard_id=r.shard_id,
+                )
+            r.msgs.append(
+                Message(
+                    type=MessageType.INSTALL_SNAPSHOT,
+                    to=pid,
+                    from_=r.replica_id,
+                    shard_id=r.shard_id,
+                    term=r.term,
+                    snapshot=send,
+                )
+            )
+            snapshot_sends.append((g, p, ss.index))
+
+
+def vector_step_engine_factory(**kw):
+    """ExpertConfig.step_engine_factory hook:
+
+        expert.step_engine_factory = vector_step_engine_factory(capacity=2048)
+    """
+
+    def factory(nodehost):
+        return VectorStepEngine(nodehost.logdb, **kw)
+
+    return factory

@@ -394,10 +394,15 @@ def _vote_rejected(st):
     return n >= _quorum(st)
 
 
-def _append_one(st, mask, cc) -> DeviceState:
+def _append_one(st, out, mask, cc) -> Tuple[DeviceState, DeviceOut]:
     """Leader-side append of one entry at the current term
     (oracle: _append_entries for a single entry, incl. self try_update)."""
     new_last = st.last_index + 1
+    out = out._replace(
+        append_lo=jnp.where(
+            mask, jnp.minimum(out.append_lo, new_last), out.append_lo
+        )
+    )
     st = _ring_append_one(st, mask, new_last, st.term, cc)
     st = st._replace(last_index=_w(mask, new_last, st.last_index))
     g = jnp.arange(st.G)
@@ -411,7 +416,7 @@ def _append_one(st, mask, cc) -> DeviceState:
             st.next_idx, st.self_slot, mask, jnp.maximum(self_next, new_last + 1)
         ),
     )
-    return st
+    return st, out
 
 
 def _try_commit(st, out, mask) -> Tuple[DeviceState, DeviceOut, jnp.ndarray]:
@@ -519,7 +524,7 @@ def _become_leader(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
         pending_cc=_w(mask, any_cc.astype(I32), st.pending_cc)
     )
     # commit barrier: empty entry at the new term
-    st = _append_one(st, mask, jnp.zeros((st.G,), I32))
+    st, out = _append_one(st, out, mask, jnp.zeros((st.G,), I32))
     single = _num_voters(st) == 1
     st, out, _ = _try_commit(st, out, mask & single & _self_is_voter(st))
     return st, out
@@ -802,6 +807,14 @@ def _handle_replicate(st, out, msg, mask, slot_i):
         escalate=out.escalate | jnp.where(bad, ESC_INVARIANT, 0)
     )
     # append entries[conflict_off:] — ring writes + truncation to last_new
+    first_written = msg["log_index"] + 1 + conflict_off
+    out = out._replace(
+        append_lo=jnp.where(
+            has_conflict,
+            jnp.minimum(out.append_lo, first_written),
+            out.append_lo,
+        )
+    )
     for i in range(E):
         idx = msg["log_index"] + 1 + i
         wmask = has_conflict & (i >= conflict_off) & (i < n)
@@ -1020,7 +1033,7 @@ def _handle_propose(st, out, msg, mask, slot_i, E):
         st = st._replace(
             pending_cc=_w(put & is_cc, 1, st.pending_cc)
         )
-        st = _append_one(st, put, jnp.where(is_cc, 1, 0))
+        st, out = _append_one(st, out, put, jnp.where(is_cc, 1, 0))
         appended_any = appended_any | put
     out = out._replace(ent_drop=ent_drop)
     # single-voter commit advance happens inside _append_entries via

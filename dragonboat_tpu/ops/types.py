@@ -211,6 +211,9 @@ F_SRC_SLOT = 10
 N_FIELDS = 11
 
 
+APPEND_LO_NONE = 2**31 - 1  # DeviceOut.append_lo sentinel: no append
+
+
 class DeviceOut(NamedTuple):
     """Step outputs: emitted messages + host-coordination side channels."""
 
@@ -221,6 +224,10 @@ class DeviceOut(NamedTuple):
     slot_base: jnp.ndarray      # [G, M] PROPOSE: pre-append last_index or SLOT_*
     slot_term: jnp.ndarray      # [G, M] PROPOSE: term entries were stamped with
     ent_drop: jnp.ndarray       # [G, M, E] 0/1: proposal entry dropped (cc gate)
+    append_lo: jnp.ndarray      # [G] lowest log index ring-written this step
+                                # (APPEND_LO_NONE if nothing appended); with
+                                # state'.last_index this bounds the host's
+                                # entries_to_save reconstruction
 
     @property
     def O(self) -> int:
@@ -347,4 +354,5 @@ def make_out(G: int, P: int, M: int, E: int, O: int) -> DeviceOut:
         slot_base=jnp.full((G, M), SLOT_UNUSED, I32),
         slot_term=jnp.zeros((G, M), I32),
         ent_drop=jnp.zeros((G, M, E), I32),
+        append_lo=jnp.full((G,), APPEND_LO_NONE, I32),
     )

@@ -1,0 +1,203 @@
+"""Integration tests for the VectorStepEngine (BASELINE config 2 shape).
+
+Same multi-NodeHost-in-one-process pattern as test_nodehost.py, but every
+NodeHost steps its shards through the device kernel via
+ExpertConfig.step_engine_factory.  Cold operations (ReadIndex, config
+change, snapshot, leader transfer) route rows through the
+materialize->scalar->re-upload path, so these tests exercise the full
+hot/cold residency dance, not just the happy path.
+"""
+import pickle
+import shutil
+import time
+
+import pytest
+
+from dragonboat_tpu import (
+    EngineConfig,
+    ExpertConfig,
+    NodeHost,
+    NodeHostConfig,
+)
+from dragonboat_tpu.ops.engine import vector_step_engine_factory
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+from test_nodehost import (
+    ADDRS,
+    KVStore,
+    propose_r,
+    set_cmd,
+    shard_config,
+    wait_for_leader,
+)
+
+# one geometry for the whole module -> one kernel compile (persistent-cached)
+GEOM = dict(capacity=16, P=5, W=32, M=8, E=4, O=32)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def warm_kernel():
+    """Compile the step kernel up front so election timeouts in the tests
+    aren't spent inside the first jit trace (~60s cold on CPU)."""
+    import jax
+
+    from dragonboat_tpu.ops import kernel as K
+    from dragonboat_tpu.ops import types as T
+
+    st = T.make_state(GEOM["capacity"], GEOM["P"], GEOM["W"])
+    box = T.make_inbox(GEOM["capacity"], GEOM["M"], GEOM["E"])
+    jax.block_until_ready(K.step(st, box, out_capacity=GEOM["O"]))
+
+
+def vec_shard_config(replica_id, shard_id=1, **kw):
+    # CPU kernel launches are ~10-15ms; keep the logical election timeout
+    # (election_rtt * rtt_ms) comfortably above several launch round-trips
+    kw.setdefault("election_rtt", 20)
+    kw.setdefault("heartbeat_rtt", 2)
+    return shard_config(replica_id, shard_id=shard_id, **kw)
+
+
+def make_vector_nodehost(replica_id, rtt_ms=5):
+    cfg = NodeHostConfig(
+        nodehost_dir=f"/tmp/nh-vec-{replica_id}",
+        rtt_millisecond=rtt_ms,
+        raft_address=ADDRS[replica_id],
+        expert=ExpertConfig(
+            engine=EngineConfig(exec_shards=1, apply_shards=2),
+            step_engine_factory=vector_step_engine_factory(**GEOM),
+        ),
+    )
+    return NodeHost(cfg)
+
+
+@pytest.fixture
+def vcluster():
+    reset_inproc_network()
+    for rid in ADDRS:
+        shutil.rmtree(f"/tmp/nh-vec-{rid}", ignore_errors=True)
+    nhs = {rid: make_vector_nodehost(rid) for rid in ADDRS}
+    for rid, nh in nhs.items():
+        nh.start_replica(ADDRS, False, KVStore, vec_shard_config(rid))
+    yield nhs
+    for nh in nhs.values():
+        nh.close()
+
+
+def read_r(nh, shard_id, query, deadline=12.0):
+    """sync_read with retry: on CPU the device step latency is ~15ms per
+    hop, so a read that lands mid-election-churn can legitimately time
+    out or drop; clients retry exactly as with proposals."""
+    import dragonboat_tpu as dt
+
+    end = time.time() + deadline
+    while True:
+        try:
+            return nh.sync_read(shard_id, query, timeout=2.0)
+        except Exception:
+            if time.time() >= end:
+                raise
+            time.sleep(0.05)
+
+
+def engine_stats(nhs):
+    out = {}
+    for rid, nh in nhs.items():
+        out[rid] = dict(nh.engine.step_engine.stats)
+    return out
+
+
+class TestVectorCluster:
+    def test_leader_elected_on_device(self, vcluster):
+        lid = wait_for_leader(vcluster)
+        assert lid in (1, 2, 3)
+        stats = engine_stats(vcluster)
+        # the election must actually have run through the kernel
+        assert any(s["device_rows_stepped"] > 0 for s in stats.values()), stats
+
+    def test_propose_and_read(self, vcluster):
+        wait_for_leader(vcluster)
+        nh = vcluster[1]
+        s = nh.get_noop_session(1)
+        r = propose_r(nh, s, set_cmd("alpha", b"1"))
+        assert r.value == 1
+        # sync_read is a cold (ReadIndex) path: rows materialize to the
+        # scalar and come back
+        for rid, other in vcluster.items():
+            assert read_r(other, 1, "alpha") == b"1"
+
+    def test_propose_from_any_replica(self, vcluster):
+        wait_for_leader(vcluster)
+        for rid, nh in vcluster.items():
+            s = nh.get_noop_session(1)
+            propose_r(nh, s, set_cmd(f"k{rid}", bytes([rid])))
+        for rid in ADDRS:
+            assert read_r(vcluster[1], 1, f"k{rid}") == bytes([rid])
+
+    def test_many_proposals(self, vcluster):
+        wait_for_leader(vcluster)
+        nh = vcluster[1]
+        s = nh.get_noop_session(1)
+        for i in range(60):
+            propose_r(nh, s, set_cmd(f"key-{i}", str(i).encode()))
+        assert read_r(vcluster[3], 1, "key-59") == b"59"
+        stats = engine_stats(vcluster)
+        assert any(s["device_rows_stepped"] > 0 for s in stats.values()), stats
+
+    def test_membership_change_cold_path(self, vcluster):
+        wait_for_leader(vcluster)
+        nh = vcluster[1]
+        s = nh.get_noop_session(1)
+        propose_r(nh, s, set_cmd("pre", b"1"))
+        m = nh.sync_get_shard_membership(1)
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                nh.sync_request_add_non_voting(
+                    1, 9, "nh-9", m.config_change_id, timeout=2.0
+                )
+                break
+            except Exception:
+                m = nh.sync_get_shard_membership(1)
+                if time.time() > deadline:
+                    raise
+        m2 = nh.sync_get_shard_membership(1)
+        assert 9 in m2.non_votings
+        # the shard keeps working after the cold excursion
+        propose_r(nh, s, set_cmd("post", b"2"))
+        assert read_r(nh, 1, "post") == b"2"
+
+    def test_multi_shard(self, vcluster):
+        for shard in (2, 3, 4):
+            for rid, nh in vcluster.items():
+                nh.start_replica(
+                    ADDRS, False, KVStore, vec_shard_config(rid, shard_id=shard)
+                )
+        for shard in (2, 3, 4):
+            wait_for_leader(vcluster, shard_id=shard)
+            nh = vcluster[1]
+            s = nh.get_noop_session(shard)
+            propose_r(nh, s, set_cmd(f"s{shard}", bytes([shard])))
+        for shard in (2, 3, 4):
+            assert read_r(vcluster[2], shard, f"s{shard}") == bytes([shard])
+
+    def test_restart_replays(self, vcluster):
+        wait_for_leader(vcluster)
+        nh = vcluster[1]
+        s = nh.get_noop_session(1)
+        for i in range(10):
+            propose_r(nh, s, set_cmd(f"r-{i}", str(i).encode()))
+        assert read_r(vcluster[2], 1, "r-9") == b"9"
+        # stop replica 3 and bring it back: WAL replay + catch-up
+        vcluster[3].stop_replica(1, 3)
+        propose_r(nh, s, set_cmd("while-down", b"x"))
+        vcluster[3].start_replica(ADDRS, False, KVStore, vec_shard_config(3))
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            try:
+                if vcluster[3].stale_read(1, "while-down") == b"x":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("restarted replica never caught up")
