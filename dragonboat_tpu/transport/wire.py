@@ -1,0 +1,397 @@
+"""Binary wire codec for the TCP transport.
+
+reference: the reference serializes raftpb protobufs onto a framed TCP
+stream (internal/transport/tcp.go [U]).  This codec is a hand-rolled
+positional binary format (length-prefixed, little-endian, crc-framed by
+the transport) rather than pickle: wire input is untrusted and must
+never be able to execute code or allocate unboundedly on decode.
+
+Frame layout (transport level, see tcp.py):
+    magic  u32  = 0x54524654 ("TRFT")
+    kind   u8   (1 = MessageBatch, 2 = Chunk)
+    length u32  payload byte length
+    crc    u32  zlib.crc32 of payload
+    payload
+"""
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+from typing import Tuple
+
+from ..pb import (
+    Chunk,
+    CompressionType,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageBatch,
+    MessageType,
+    Snapshot,
+    SnapshotFile,
+)
+
+MAGIC = 0x54524654
+KIND_BATCH = 1
+KIND_CHUNK = 2
+
+# decode-side sanity bounds (wire input is untrusted)
+MAX_PAYLOAD = 256 * 1024 * 1024
+MAX_ITEMS = 1 << 20
+
+_i64 = struct.Struct("<q")
+_u32 = struct.Struct("<I")
+_u8 = struct.Struct("<B")
+
+
+class WireError(Exception):
+    """Malformed or out-of-bounds wire data."""
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+def _wi(b: BytesIO, v: int) -> None:
+    b.write(_i64.pack(v))
+
+
+def _wu32(b: BytesIO, v: int) -> None:
+    b.write(_u32.pack(v))
+
+
+def _wu8(b: BytesIO, v: int) -> None:
+    b.write(_u8.pack(v))
+
+
+def _wb(b: BytesIO, v: bytes) -> None:
+    _wu32(b, len(v))
+    b.write(v)
+
+
+def _ws(b: BytesIO, v: str) -> None:
+    _wb(b, v.encode("utf-8"))
+
+
+class _R:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self.data):
+            raise WireError(f"short read: want {n} at {self.pos}")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def i64(self) -> int:
+        return _i64.unpack(self.take(8))[0]
+
+    def u32(self) -> int:
+        return _u32.unpack(self.take(4))[0]
+
+    def u8(self) -> int:
+        return _u8.unpack(self.take(1))[0]
+
+    def blob(self) -> bytes:
+        n = self.u32()
+        if n > MAX_PAYLOAD:
+            raise WireError(f"blob too large: {n}")
+        return self.take(n)
+
+    def s(self) -> str:
+        return self.blob().decode("utf-8")
+
+    def count(self) -> int:
+        n = self.u32()
+        if n > MAX_ITEMS:
+            raise WireError(f"count too large: {n}")
+        return n
+
+
+# ---------------------------------------------------------------------------
+# entries / membership / snapshots
+# ---------------------------------------------------------------------------
+def _w_entry(b: BytesIO, e: Entry) -> None:
+    _wi(b, e.term)
+    _wi(b, e.index)
+    _wu8(b, int(e.type))
+    _wi(b, e.key)
+    _wi(b, e.client_id)
+    _wi(b, e.series_id)
+    _wi(b, e.responded_to)
+    _wb(b, e.cmd)
+
+
+def _r_entry(r: _R) -> Entry:
+    term = r.i64()
+    index = r.i64()
+    etype = EntryType(r.u8())
+    key = r.i64()
+    client_id = r.i64()
+    series_id = r.i64()
+    responded_to = r.i64()
+    cmd = r.blob()
+    return Entry(
+        term=term,
+        index=index,
+        type=etype,
+        key=key,
+        client_id=client_id,
+        series_id=series_id,
+        responded_to=responded_to,
+        cmd=cmd,
+    )
+
+
+def _w_addr_map(b: BytesIO, m: dict) -> None:
+    _wu32(b, len(m))
+    for rid in sorted(m):
+        _wi(b, rid)
+        _ws(b, m[rid])
+
+
+def _r_addr_map(r: _R) -> dict:
+    return {r.i64(): r.s() for _ in range(r.count())}
+
+
+def _w_membership(b: BytesIO, m: Membership) -> None:
+    _wi(b, m.config_change_id)
+    _w_addr_map(b, m.addresses)
+    _w_addr_map(b, m.non_votings)
+    _w_addr_map(b, m.witnesses)
+    _wu32(b, len(m.removed))
+    for rid in sorted(m.removed):
+        _wi(b, rid)
+
+
+def _r_membership(r: _R) -> Membership:
+    ccid = r.i64()
+    addresses = _r_addr_map(r)
+    non_votings = _r_addr_map(r)
+    witnesses = _r_addr_map(r)
+    removed = {r.i64(): True for _ in range(r.count())}
+    return Membership(
+        config_change_id=ccid,
+        addresses=addresses,
+        non_votings=non_votings,
+        witnesses=witnesses,
+        removed=removed,
+    )
+
+
+def _w_snapshot(b: BytesIO, s: Snapshot) -> None:
+    _ws(b, s.filepath)
+    _wi(b, s.file_size)
+    _wi(b, s.index)
+    _wi(b, s.term)
+    _w_membership(b, s.membership)
+    _wu32(b, len(s.files))
+    for f in s.files:
+        _wi(b, f.file_id)
+        _ws(b, f.filepath)
+        _wi(b, f.file_size)
+        _wb(b, f.metadata)
+    _wb(b, s.checksum)
+    _wu8(b, int(s.dummy))
+    _wi(b, s.shard_id)
+    _wi(b, s.replica_id)
+    _wi(b, s.on_disk_index)
+    _wu8(b, int(s.witness))
+    _wu8(b, int(s.imported))
+    _wu8(b, s.type)
+    _wu8(b, int(s.compression))
+
+
+def _r_snapshot(r: _R) -> Snapshot:
+    filepath = r.s()
+    file_size = r.i64()
+    index = r.i64()
+    term = r.i64()
+    membership = _r_membership(r)
+    files = tuple(
+        SnapshotFile(
+            file_id=r.i64(),
+            filepath=r.s(),
+            file_size=r.i64(),
+            metadata=r.blob(),
+        )
+        for _ in range(r.count())
+    )
+    checksum = r.blob()
+    dummy = bool(r.u8())
+    shard_id = r.i64()
+    replica_id = r.i64()
+    on_disk_index = r.i64()
+    witness = bool(r.u8())
+    imported = bool(r.u8())
+    stype = r.u8()
+    compression = CompressionType(r.u8())
+    return Snapshot(
+        filepath=filepath,
+        file_size=file_size,
+        index=index,
+        term=term,
+        membership=membership,
+        files=files,
+        checksum=checksum,
+        dummy=dummy,
+        shard_id=shard_id,
+        replica_id=replica_id,
+        on_disk_index=on_disk_index,
+        witness=witness,
+        imported=imported,
+        type=stype,
+        compression=compression,
+    )
+
+
+# ---------------------------------------------------------------------------
+# messages
+# ---------------------------------------------------------------------------
+def _w_message(b: BytesIO, m: Message) -> None:
+    _wu8(b, int(m.type))
+    _wu8(b, int(m.reject))
+    for v in (
+        m.to,
+        m.from_,
+        m.shard_id,
+        m.term,
+        m.log_term,
+        m.log_index,
+        m.commit,
+        m.hint,
+        m.hint_high,
+    ):
+        _wi(b, v)
+    _wu32(b, len(m.entries))
+    for e in m.entries:
+        _w_entry(b, e)
+    has_ss = not m.snapshot.is_empty()
+    _wu8(b, int(has_ss))
+    if has_ss:
+        _w_snapshot(b, m.snapshot)
+
+
+def _r_message(r: _R) -> Message:
+    mtype = MessageType(r.u8())
+    reject = bool(r.u8())
+    to, from_, shard_id, term, log_term, log_index, commit, hint, hint_high = (
+        r.i64() for _ in range(9)
+    )
+    entries = tuple(_r_entry(r) for _ in range(r.count()))
+    snapshot = _r_snapshot(r) if r.u8() else Snapshot()
+    return Message(
+        type=mtype,
+        to=to,
+        from_=from_,
+        shard_id=shard_id,
+        term=term,
+        log_term=log_term,
+        log_index=log_index,
+        commit=commit,
+        reject=reject,
+        hint=hint,
+        hint_high=hint_high,
+        entries=entries,
+        snapshot=snapshot,
+    )
+
+
+# ---------------------------------------------------------------------------
+# top-level payloads
+# ---------------------------------------------------------------------------
+def encode_batch(batch: MessageBatch) -> bytes:
+    b = BytesIO()
+    _ws(b, batch.source_address)
+    _wi(b, batch.deployment_id)
+    _wu32(b, batch.bin_ver)
+    _wu32(b, len(batch.messages))
+    for m in batch.messages:
+        _w_message(b, m)
+    return b.getvalue()
+
+
+def decode_batch(data: bytes) -> MessageBatch:
+    r = _R(data)
+    source_address = r.s()
+    deployment_id = r.i64()
+    bin_ver = r.u32()
+    messages = tuple(_r_message(r) for _ in range(r.count()))
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return MessageBatch(
+        messages=messages,
+        source_address=source_address,
+        deployment_id=deployment_id,
+        bin_ver=bin_ver,
+    )
+
+
+def encode_snapshot_meta(s: Snapshot) -> bytes:
+    """Standalone Snapshot metadata record (snapshot export dirs)."""
+    b = BytesIO()
+    _w_snapshot(b, s)
+    return b.getvalue()
+
+
+def decode_snapshot_meta(data: bytes) -> Snapshot:
+    r = _R(data)
+    s = _r_snapshot(r)
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return s
+
+
+def encode_chunk(c: Chunk) -> bytes:
+    b = BytesIO()
+    for v in (
+        c.shard_id,
+        c.replica_id,
+        c.from_,
+        c.chunk_id,
+        c.chunk_size,
+        c.chunk_count,
+        c.index,
+        c.term,
+        c.message_term,
+    ):
+        _wi(b, v)
+    _wb(b, c.data)
+    _w_membership(b, c.membership)
+    return b.getvalue()
+
+
+def decode_chunk(data: bytes) -> Chunk:
+    r = _R(data)
+    (
+        shard_id,
+        replica_id,
+        from_,
+        chunk_id,
+        chunk_size,
+        chunk_count,
+        index,
+        term,
+        message_term,
+    ) = (r.i64() for _ in range(9))
+    payload = r.blob()
+    membership = _r_membership(r)
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return Chunk(
+        shard_id=shard_id,
+        replica_id=replica_id,
+        from_=from_,
+        chunk_id=chunk_id,
+        chunk_size=chunk_size,
+        chunk_count=chunk_count,
+        index=index,
+        term=term,
+        message_term=message_term,
+        data=payload,
+        membership=membership,
+    )
