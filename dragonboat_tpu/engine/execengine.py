@@ -115,8 +115,14 @@ class ExecEngine:
         step_workers: int = 16,
         apply_workers: int = 16,
         step_engine: Optional[IStepEngine] = None,
+        metrics=None,
     ):
+        from ..metrics import MetricsRegistry
+
         self.logdb = logdb
+        # a disabled registry no-ops every record call, so the worker
+        # loop needs no metrics-enabled branch
+        self.metrics = metrics or MetricsRegistry(enabled=False)
         self.step_ready = WorkReady(step_workers)
         self.apply_ready = WorkReady(apply_workers)
         self.step_engine = step_engine or HostStepEngine(logdb)
@@ -193,7 +199,9 @@ class ExecEngine:
             if not nodes:
                 continue
             try:
-                self.step_engine.step_shards(nodes, worker_id)
+                with self.metrics.timer("raft_engine_step_seconds"):
+                    self.step_engine.step_shards(nodes, worker_id)
+                self.metrics.counter("raft_engine_step_iterations_total").add()
             except Exception:  # noqa: BLE001
                 _log.exception("step worker %d failed", worker_id)
             # shards with remaining work re-arm immediately

@@ -1,0 +1,157 @@
+"""Metrics: counters/gauges with Prometheus-text export.
+
+reference: dragonboat's EnableMetrics wiring (VictoriaMetrics/metrics
+counters in nodehost/transport/logdb/raft, exported via
+NodeHost.WriteHealthMetrics [U]).  Lock-free-ish: counters use a plain
+int guarded by the GIL for add(); export snapshots under a registry
+lock.  Disabled registries short-circuit to no-ops so the hot paths pay
+one attribute load when metrics are off.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "fn", "value")
+
+    def __init__(self, name: str, fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.fn = fn
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def get(self) -> float:
+        return float(self.fn()) if self.fn is not None else self.value
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (seconds)."""
+
+    BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+    __slots__ = ("name", "buckets", "count", "total")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.buckets = [0] * (len(self.BOUNDS) + 1)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        for i, b in enumerate(self.BOUNDS):
+            if v <= b:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+
+class _Noop:
+    def add(self, n: int = 1) -> None: ...
+
+    def set(self, v: float) -> None: ...
+
+    def observe(self, v: float) -> None: ...
+
+
+_NOOP = _Noop()
+
+
+class MetricsRegistry:
+    """Per-NodeHost metric registry (one per process is fine too)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def counter(self, name: str):
+        if not self.enabled:
+            return _NOOP
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None):
+        if not self.enabled:
+            return _NOOP
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, fn)
+            elif fn is not None:
+                g.fn = fn
+            return g
+
+    def histogram(self, name: str):
+        if not self.enabled:
+            return _NOOP
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name)
+            return h
+
+    def timer(self, name: str):
+        """Context manager recording elapsed seconds into a histogram."""
+        hist = self.histogram(name)
+
+        class _T:
+            __slots__ = ("t0",)
+
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0)
+                return False
+
+        return _T()
+
+    # -- export ----------------------------------------------------------
+    def export_text(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            for c in sorted(self._counters.values(), key=lambda x: x.name):
+                out.append(f"# TYPE {c.name} counter")
+                out.append(f"{c.name} {c.value}")
+            for g in sorted(self._gauges.values(), key=lambda x: x.name):
+                out.append(f"# TYPE {g.name} gauge")
+                out.append(f"{g.name} {g.get()}")
+            for h in sorted(self._hists.values(), key=lambda x: x.name):
+                out.append(f"# TYPE {h.name} histogram")
+                acc = 0
+                for i, b in enumerate(Histogram.BOUNDS):
+                    acc += h.buckets[i]
+                    out.append(f'{h.name}_bucket{{le="{b}"}} {acc}')
+                out.append(f'{h.name}_bucket{{le="+Inf"}} {h.count}')
+                out.append(f"{h.name}_sum {h.total}")
+                out.append(f"{h.name}_count {h.count}")
+        return "\n".join(out) + "\n"
+
+
+# module-level default used by components not owned by a NodeHost
+global_registry = MetricsRegistry(enabled=True)

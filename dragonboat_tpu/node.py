@@ -82,18 +82,6 @@ class StepInputs:
         self.snapshot_reqs = list(snapshot_reqs)
         self.ticks = ticks
 
-    def empty(self) -> bool:
-        return not (
-            self.received
-            or self.proposals
-            or self.read_indexes
-            or self.config_changes
-            or self.cc_results
-            or self.transfers
-            or self.snapshot_reqs
-            or self.ticks
-        )
-
 
 class Node:
     def __init__(

@@ -17,6 +17,7 @@ from .config import Config, ConfigError, NodeHostConfig
 from .engine.execengine import ExecEngine
 from .events import EventFanout
 from .logger import get_logger
+from .metrics import MetricsRegistry
 from .node import Node
 from .pb import (
     ConfigChange,
@@ -100,7 +101,23 @@ class NodeHost:
             self.snapshot_storage = FileSnapshotStorage(
                 os.path.join(config.nodehost_dir, "snapshots")
             )
-        self.registry = Registry()
+        self.gossip: Optional[object] = None
+        if config.address_by_nodehost_id:
+            from .id import get_nodehost_id
+            from .transport.gossip import GossipManager, GossipRegistry
+
+            self.nodehost_id = get_nodehost_id(config.nodehost_dir)
+            self.gossip = GossipManager(
+                self.nodehost_id,
+                config.raft_address,
+                config.gossip.bind_address,
+                list(config.gossip.seed),
+                advertise_address=config.gossip.advertise_address,
+            )
+            self.gossip.start()
+            self.registry = GossipRegistry(self.gossip)
+        else:
+            self.registry = Registry()
         self.events = EventFanout(
             config.raft_event_listener, config.system_event_listener
         )
@@ -137,6 +154,22 @@ class NodeHost:
         )
         self.transport.start()
 
+        self.metrics = MetricsRegistry(enabled=config.enable_metrics)
+        self.metrics.gauge(
+            "raft_nodehost_shards", lambda: len(self._nodes)
+        )
+        self.metrics.gauge(
+            "raft_transport_sent_total", lambda: self.transport.metrics["sent"]
+        )
+        self.metrics.gauge(
+            "raft_transport_dropped_total",
+            lambda: self.transport.metrics["dropped"],
+        )
+        self.metrics.gauge(
+            "raft_transport_failed_total",
+            lambda: self.transport.metrics["failed"],
+        )
+
         step_engine = (
             expert.step_engine_factory(self) if expert.step_engine_factory else None
         )
@@ -145,6 +178,7 @@ class NodeHost:
             step_workers=expert.engine.exec_shards,
             apply_workers=expert.engine.apply_shards,
             step_engine=step_engine,
+            metrics=self.metrics,
         )
         self.engine.start()
 
@@ -172,6 +206,8 @@ class NodeHost:
         # join worker threads before closing the user SMs: an apply worker
         # may still be inside sm.handle
         self.engine.stop()
+        if self.gossip is not None:
+            self.gossip.close()
         for n in nodes:
             n.stop()
         self.transport.close()
@@ -491,6 +527,12 @@ class NodeHost:
         return lid, lid != 0
 
     # -- info -------------------------------------------------------------
+    def write_health_metrics(self, writer) -> None:
+        """Prometheus-text metric export (reference:
+        NodeHost.WriteHealthMetrics [U]); enable via
+        NodeHostConfig.enable_metrics."""
+        writer.write(self.metrics.export_text())
+
     def get_nodehost_info(self) -> dict:
         with self._nodes_lock:
             return {

@@ -64,7 +64,6 @@ from .types import (
     I32,
     RS_SNAPSHOT,
     SLOT_DROPPED,
-    SLOT_FORWARDED,
     DeviceState,
     make_state,
 )
@@ -296,8 +295,12 @@ class VectorStepEngine(IStepEngine):
             return None
         if r.snapshotting:
             return None
-        slots: List[Tuple] = []
         lim = 2**31 - 1
+        # the device state is int32; a row whose terms/indexes outgrow it
+        # stays on the scalar path (the host WAL is 64-bit throughout)
+        if r.term >= lim or r.log.last_index() + self.M * self.E >= lim:
+            return None
+        slots: List[Tuple] = []
         for m in si.received:
             if int(m.type) not in _HOT_SET:
                 return None
@@ -411,36 +414,43 @@ class VectorStepEngine(IStepEngine):
     # the step
     # ------------------------------------------------------------------
     def step_shards(self, nodes, worker_id: int) -> None:
-        with self._lock:
-            self._step_locked(nodes, worker_id)
-
-    def _step_locked(self, nodes, worker_id: int) -> None:
+        """Per-node structures are safe without the engine lock — the
+        ExecEngine partitions shards over workers, so each node is only
+        ever stepped by its owning worker.  The lock guards the shared
+        device state (self._state, row tables, mirrors); host-path scalar
+        stepping and save/process run outside it so a slow cold shard
+        cannot stall the other workers' partitions."""
         updates: List[Tuple] = []  # (node, Update)
         host_rows: List[Tuple] = []  # (node, si)
         batch: List[Tuple] = []  # (node, g, si, plan)
-        for node in nodes:
-            if node.stopped:
-                continue
-            si = node.drain_step_inputs()
-            plan = self._plan_device(node, si)
-            g = self._attach(node) if plan is not None else self._row_of.get(
-                node.shard_id
-            )
-            if plan is None or g is None:
-                host_rows.append((node, si))
-                continue
-            if not plan and not self._meta[g].dirty:
-                continue  # nothing to do for this row
-            batch.append((node, g, si, plan))
+        with self._lock:
+            for node in nodes:
+                if node.stopped:
+                    continue
+                si = node.drain_step_inputs()
+                plan = self._plan_device(node, si)
+                g = (
+                    self._attach(node)
+                    if plan is not None
+                    else self._row_of.get(node.shard_id)
+                )
+                if plan is None or g is None:
+                    host_rows.append((node, si))
+                    continue
+                if not plan and not self._meta[g].dirty:
+                    continue  # nothing to do for this row
+                batch.append((node, g, si, plan))
 
-        # ---- host path (cold rows) -----------------------------------
-        to_mat = []
-        for node, si in host_rows:
-            g = self._row_of.get(node.shard_id)
-            if g is not None and not self._meta[g].dirty:
-                to_mat.append(g)
-                self._meta[g].dirty = True
-        self._materialize_rows(to_mat)  # one batched gather for all
+            # cold rows leave the device before their scalar step
+            to_mat = []
+            for node, si in host_rows:
+                g = self._row_of.get(node.shard_id)
+                if g is not None and not self._meta[g].dirty:
+                    to_mat.append(g)
+                    self._meta[g].dirty = True
+            self._materialize_rows(to_mat)  # one batched gather for all
+
+        # ---- host path (cold rows; engine lock released) -------------
         for node, si in host_rows:
             u = node.step_with_inputs(si)
             self.stats["host_rows_stepped"] += 1
@@ -449,14 +459,15 @@ class VectorStepEngine(IStepEngine):
 
         # ---- device path ---------------------------------------------
         if batch:
-            self._upload_rows(
-                [
-                    (g, node.peer.raft)
-                    for node, g, si, plan in batch
-                    if self._meta[g].dirty
-                ]
-            )
-            updates.extend(self._device_step(batch))
+            with self._lock:
+                self._upload_rows(
+                    [
+                        (g, node.peer.raft)
+                        for node, g, si, plan in batch
+                        if self._meta[g].dirty
+                    ]
+                )
+                updates.extend(self._device_step(batch))
 
         if updates:
             self.logdb.save_raft_state([u for _, u in updates], worker_id)

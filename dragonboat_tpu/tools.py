@@ -1,0 +1,89 @@
+"""Disaster-recovery tools: snapshot export and import.
+
+reference: tools/import.go (ImportSnapshot) and the exported-snapshot
+flow of SyncRequestSnapshot [U].  The scenario: a shard has lost its
+quorum permanently.  An exported snapshot from a surviving replica is
+imported on fresh hosts with a REWRITTEN membership, and the shard
+restarts from the snapshot with the new member set.
+
+Export dir layout:
+    <dir>/snapshot.bin   checksummed payload (FileSnapshotStorage format)
+    <dir>/META           wire-encoded Snapshot metadata
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict
+
+from .pb import Membership, Snapshot
+from .transport.wire import decode_snapshot_meta, encode_snapshot_meta
+
+META_FILENAME = "META"
+PAYLOAD_FILENAME = "snapshot.bin"
+
+
+def export_snapshot(nodehost, shard_id: int, export_dir: str) -> Snapshot:
+    """Write the shard's most recent snapshot to ``export_dir``.
+
+    Call ``nodehost.sync_request_snapshot(shard_id)`` first if the shard
+    has never snapshotted.
+    """
+    replica_id = nodehost._get_node(shard_id).replica_id
+    ss = nodehost.logdb.get_snapshot(shard_id, replica_id)
+    if ss.is_empty():
+        raise ValueError(f"shard {shard_id} has no snapshot to export")
+    os.makedirs(export_dir, exist_ok=True)
+    shutil.copyfile(ss.filepath, os.path.join(export_dir, PAYLOAD_FILENAME))
+    with open(os.path.join(export_dir, META_FILENAME), "wb") as f:
+        f.write(encode_snapshot_meta(ss))
+        f.flush()
+        os.fsync(f.fileno())
+    return ss
+
+
+def import_snapshot(
+    nodehost,
+    export_dir: str,
+    shard_id: int,
+    replica_id: int,
+    members: Dict[int, str],
+) -> Snapshot:
+    """Seed ``nodehost`` with an exported snapshot under a rewritten
+    membership, BEFORE start_replica for the shard.
+
+    ``members`` is the complete new voter set (replica_id -> address)
+    and MUST include ``replica_id`` itself; every listed replica must
+    import the same snapshot with the same membership (reference:
+    tools.ImportSnapshot preconditions [U]).
+    """
+    if replica_id not in members:
+        raise ValueError(f"replica {replica_id} not in new membership")
+    with open(os.path.join(export_dir, META_FILENAME), "rb") as f:
+        meta = decode_snapshot_meta(f.read())
+    if meta.shard_id != shard_id:
+        raise ValueError(
+            f"export is for shard {meta.shard_id}, not {shard_id}"
+        )
+    with open(os.path.join(export_dir, PAYLOAD_FILENAME), "rb") as f:
+        raw = f.read()
+    payload = raw[4:]  # strip the storage checksum; save() re-stamps it
+    path = nodehost.snapshot_storage.save(
+        shard_id, replica_id, meta.index, payload, suffix="imported"
+    )
+    new_membership = Membership(
+        config_change_id=meta.membership.config_change_id + 1,
+        addresses=dict(members),
+    )
+    ss = Snapshot(
+        filepath=path,
+        file_size=len(payload),
+        index=meta.index,
+        term=meta.term,
+        membership=new_membership,
+        shard_id=shard_id,
+        replica_id=replica_id,
+        imported=True,
+    )
+    nodehost.logdb.import_snapshot(ss, replica_id)
+    return ss

@@ -1,0 +1,212 @@
+"""Gossip registry: NodeHostID -> RaftAddress resolution over UDP.
+
+reference: internal/registry gossip mode (hashicorp/memberlist
+propagating NodeHostID->RaftAddress so replicas can move hosts) [U].
+This is a push-gossip epidemic: every interval each node sends its full
+(id, address, version) table to up to ``fanout`` random known peers
+plus the configured seeds; receivers merge by per-origin version.  The
+table is tiny (one row per nodehost), so full-state push keeps the
+protocol trivially convergent without anti-entropy digests.
+
+``GossipRegistry`` wraps the static (shard, replica) -> value registry:
+when the stored value is a NodeHostID the gossip table translates it to
+the host's current raft address at resolve time.
+"""
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+from io import BytesIO
+from typing import Dict, List, Optional, Tuple
+
+from ..id import is_nodehost_id
+from ..logger import get_logger
+from .registry import Registry
+from .tcp import parse_address
+
+_log = get_logger("registry")
+
+_MAGIC = 0x47535052  # "GSPR"
+_u32 = struct.Struct("<I")
+_u64 = struct.Struct("<Q")
+
+MAX_PACKET = 60 * 1024
+
+
+def _encode_table(table: Dict[str, Tuple[str, int]]) -> bytes:
+    b = BytesIO()
+    b.write(_u32.pack(_MAGIC))
+    b.write(_u32.pack(len(table)))
+    for nhid, (addr, ver) in table.items():
+        for s in (nhid, addr):
+            raw = s.encode("utf-8")
+            b.write(_u32.pack(len(raw)))
+            b.write(raw)
+        b.write(_u64.pack(ver))
+    return b.getvalue()
+
+
+def _decode_table(data: bytes) -> Optional[Dict[str, Tuple[str, int]]]:
+    try:
+        pos = 0
+
+        def take(n):
+            nonlocal pos
+            if pos + n > len(data):
+                raise ValueError("short")
+            out = data[pos : pos + n]
+            pos += n
+            return out
+
+        if _u32.unpack(take(4))[0] != _MAGIC:
+            return None
+        count = _u32.unpack(take(4))[0]
+        if count > 4096:
+            return None
+        table = {}
+        for _ in range(count):
+            nhid = take(_u32.unpack(take(4))[0]).decode("utf-8")
+            addr = take(_u32.unpack(take(4))[0]).decode("utf-8")
+            ver = _u64.unpack(take(8))[0]
+            table[nhid] = (addr, ver)
+        return table
+    except (ValueError, UnicodeDecodeError, struct.error):
+        return None
+
+
+class GossipManager:
+    """The UDP push-gossip epidemic itself."""
+
+    def __init__(
+        self,
+        nodehost_id: str,
+        raft_address: str,
+        bind_address: str,
+        seeds: List[str],
+        advertise_address: str = "",
+        interval: float = 0.2,
+        fanout: int = 3,
+    ):
+        self.nodehost_id = nodehost_id
+        self.raft_address = raft_address
+        self.bind_address = bind_address
+        self.advertise_address = advertise_address
+        self.seeds = list(seeds)
+        self.interval = interval
+        self.fanout = fanout
+        self._lock = threading.Lock()
+        # nodehost_id -> (raft_address, version)
+        self._table: Dict[str, Tuple[str, int]] = {nodehost_id: (raft_address, 1)}
+        # gossip peer addresses we have heard from (for fanout selection)
+        self._peers: set = set(seeds)
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        host, port = parse_address(self.bind_address)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.settimeout(0.2)
+        self._sock = s
+        self.bind_address = f"{host}:{s.getsockname()[1]}"
+        if not self.advertise_address:
+            self.advertise_address = self.bind_address
+        for fn, name in (
+            (self._recv_main, "gossip-recv"),
+            (self._push_main, "gossip-push"),
+        ):
+            t = threading.Thread(target=fn, daemon=True, name=f"tpu-raft-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        if self._sock is not None:
+            self._sock.close()
+
+    # -- api -------------------------------------------------------------
+    def set_raft_address(self, addr: str) -> None:
+        """Advertise a new raft address (host moved)."""
+        with self._lock:
+            _, ver = self._table[self.nodehost_id]
+            self._table[self.nodehost_id] = (addr, ver + 1)
+            self.raft_address = addr
+
+    def lookup(self, nodehost_id: str) -> Optional[str]:
+        with self._lock:
+            rec = self._table.get(nodehost_id)
+            return rec[0] if rec else None
+
+    def table(self) -> Dict[str, str]:
+        with self._lock:
+            return {k: v[0] for k, v in self._table.items()}
+
+    # -- internals -------------------------------------------------------
+    def _merge(self, table: Dict[str, Tuple[str, int]], sender) -> None:
+        with self._lock:
+            for nhid, (addr, ver) in table.items():
+                cur = self._table.get(nhid)
+                if cur is None or ver > cur[1]:
+                    self._table[nhid] = (addr, ver)
+            if sender:
+                self._peers.add(sender)
+
+    def _recv_main(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self._sock.recvfrom(MAX_PACKET)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            table = _decode_table(data)
+            if table is None:
+                continue
+            # the packet's trailing row carries the sender's gossip addr
+            sender = table.pop("__sender__", None)
+            self._merge(table, sender[0] if sender else None)
+
+    def _push_main(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.interval)
+            if self._stop.is_set():
+                return
+            with self._lock:
+                table = dict(self._table)
+                peers = list(self._peers)
+            table["__sender__"] = (self.advertise_address, 0)
+            pkt = _encode_table(table)
+            random.shuffle(peers)
+            targets = peers[: self.fanout]
+            for seed in self.seeds:
+                if seed not in targets:
+                    targets.append(seed)
+            for t in targets:
+                if t == self.advertise_address:
+                    continue
+                try:
+                    self._sock.sendto(pkt, parse_address(t))
+                except OSError:
+                    pass
+
+
+class GossipRegistry(Registry):
+    """(shard, replica) -> address registry that resolves NodeHostIDs
+    through the gossip table (reference: INodeRegistry gossip mode [U])."""
+
+    def __init__(self, manager: GossipManager):
+        super().__init__()
+        self.manager = manager
+
+    def resolve(self, shard_id: int, replica_id: int) -> Optional[str]:
+        v = super().resolve(shard_id, replica_id)
+        if v is not None and is_nodehost_id(v):
+            return self.manager.lookup(v)
+        return v
