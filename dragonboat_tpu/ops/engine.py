@@ -460,6 +460,17 @@ class VectorStepEngine(IStepEngine):
         # ---- device path ---------------------------------------------
         if batch:
             with self._lock:
+                # re-validate: a concurrent detach() (stop_replica) may
+                # have freed — or freed and re-assigned — a row between
+                # the lock sections
+                batch = [
+                    (node, g, si, plan)
+                    for node, g, si, plan in batch
+                    if self._row_of.get(node.shard_id) == g
+                    and self._meta.get(g) is not None
+                    and self._meta[g].node is node
+                    and not node.stopped
+                ]
                 self._upload_rows(
                     [
                         (g, node.peer.raft)
@@ -467,7 +478,8 @@ class VectorStepEngine(IStepEngine):
                         if self._meta[g].dirty
                     ]
                 )
-                updates.extend(self._device_step(batch))
+                if batch:
+                    updates.extend(self._device_step(batch))
 
         if updates:
             self.logdb.save_raft_state([u for _, u in updates], worker_id)

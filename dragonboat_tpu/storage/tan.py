@@ -1,0 +1,425 @@
+"""tan: the durable segmented append-only LogDB.
+
+reference: internal/tan/ — a log-structured LogDB (segmented append-only
+log files + an in-memory index of live records), the v4 default,
+designed to avoid general-KV write-amp for raft-log workloads [U].
+
+Shape here: every ``save_raft_state`` batch appends crc-framed records
+to the active segment and issues ONE fsync (the reference's
+single-fsync-per-iteration contract); an ``InMemLogDB`` mirror holds
+the live view for all reads.  At open, segments replay in order into
+the mirror; a torn record at the tail of the LAST segment is the
+crash point and replay stops there cleanly (any other corruption is an
+error).  When enough closed segments accumulate, a checkpoint segment
+is written that re-serializes only the live mirror state, and older
+segments are deleted — crash-safe because replaying old segments then
+the checkpoint converges to the same state as the checkpoint alone.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from io import BytesIO
+from typing import List, Optional
+
+from ..logger import get_logger
+from ..pb import Bootstrap, Entry, Snapshot, State, Update
+from ..raftio import ILogDB, NodeInfo, RaftState
+from ..transport.wire import (
+    WireError,
+    _R,
+    _r_entry,
+    _r_snapshot,
+    _w_entry,
+    _w_snapshot,
+)
+from .logdb import InMemLogDB
+
+_log = get_logger("logdb")
+
+_REC_HEADER = struct.Struct("<BII")  # kind, length, crc
+
+K_STATE_ENTRIES = 1
+K_SNAPSHOT = 2
+K_BOOTSTRAP = 3
+K_REMOVE_TO = 4
+K_REMOVE_NODE = 5
+
+_i64 = struct.Struct("<q")
+
+SEGMENT_PREFIX = "SEGMENT-"
+DEFAULT_MAX_SEGMENT_BYTES = 64 * 1024 * 1024
+DEFAULT_GC_SEGMENTS = 4
+
+
+class CorruptLogError(Exception):
+    """Mid-log corruption (not a clean torn tail)."""
+
+
+def _wi(b: BytesIO, v: int) -> None:
+    b.write(_i64.pack(v))
+
+
+def _wb(b: BytesIO, v: bytes) -> None:
+    b.write(struct.pack("<I", len(v)))
+    b.write(v)
+
+
+def _ws(b: BytesIO, v: str) -> None:
+    _wb(b, v.encode("utf-8"))
+
+
+def _encode_state_entries(u: Update) -> bytes:
+    b = BytesIO()
+    _wi(b, u.shard_id)
+    _wi(b, u.replica_id)
+    _wi(b, u.state.term)
+    _wi(b, u.state.vote)
+    _wi(b, u.state.commit)
+    b.write(struct.pack("<I", len(u.entries_to_save)))
+    for e in u.entries_to_save:
+        _w_entry(b, e)
+    has_ss = not u.snapshot.is_empty()
+    b.write(struct.pack("<B", int(has_ss)))
+    if has_ss:
+        _w_snapshot(b, u.snapshot)
+    return b.getvalue()
+
+
+def _encode_snapshot(shard_id: int, replica_id: int, ss: Snapshot) -> bytes:
+    b = BytesIO()
+    _wi(b, shard_id)
+    _wi(b, replica_id)
+    _w_snapshot(b, ss)
+    return b.getvalue()
+
+
+def _encode_bootstrap(shard_id: int, replica_id: int, bs: Bootstrap) -> bytes:
+    b = BytesIO()
+    _wi(b, shard_id)
+    _wi(b, replica_id)
+    b.write(struct.pack("<I", len(bs.addresses)))
+    for rid in sorted(bs.addresses):
+        _wi(b, rid)
+        _ws(b, bs.addresses[rid])
+    b.write(struct.pack("<B", int(bs.join)))
+    return b.getvalue()
+
+
+def _encode_pair_index(shard_id: int, replica_id: int, index: int) -> bytes:
+    b = BytesIO()
+    _wi(b, shard_id)
+    _wi(b, replica_id)
+    _wi(b, index)
+    return b.getvalue()
+
+
+def _encode_pair(shard_id: int, replica_id: int) -> bytes:
+    b = BytesIO()
+    _wi(b, shard_id)
+    _wi(b, replica_id)
+    return b.getvalue()
+
+
+class TanLogDB(ILogDB):
+    """Durable ILogDB: WAL segments + in-memory mirror."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_segment_bytes: int = DEFAULT_MAX_SEGMENT_BYTES,
+        gc_segments: int = DEFAULT_GC_SEGMENTS,
+    ):
+        self.dir = directory
+        self.max_segment_bytes = max_segment_bytes
+        self.gc_segments = gc_segments
+        self._mirror = InMemLogDB()
+        self._lock = threading.Lock()
+        self._fh = None
+        self._active_seq = 0
+        self._active_bytes = 0
+        os.makedirs(directory, exist_ok=True)
+        self._replay()
+        self._open_active()
+
+    # -- segment plumbing -------------------------------------------------
+    def _segments(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(SEGMENT_PREFIX) and name.endswith(".log"):
+                try:
+                    out.append(int(name[len(SEGMENT_PREFIX) : -4]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _segment_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"{SEGMENT_PREFIX}{seq:08d}.log")
+
+    def _open_active(self) -> None:
+        segs = self._segments()
+        self._active_seq = (segs[-1] + 1) if segs else 1
+        path = self._segment_path(self._active_seq)
+        self._fh = open(path, "ab")
+        self._active_bytes = self._fh.tell()
+        self._sync_dir()
+
+    def _sync_dir(self) -> None:
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    # -- replay -----------------------------------------------------------
+    def _replay(self) -> None:
+        segs = self._segments()
+        for i, seq in enumerate(segs):
+            last = i == len(segs) - 1
+            self._replay_segment(self._segment_path(seq), torn_ok=last)
+
+    def _replay_segment(self, path: str, torn_ok: bool) -> None:
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            if pos + _REC_HEADER.size > n:
+                if torn_ok:
+                    return self._truncate_tail(path, pos)
+                raise CorruptLogError(f"{path}: torn header at {pos}")
+            kind, length, crc = _REC_HEADER.unpack_from(data, pos)
+            body_at = pos + _REC_HEADER.size
+            if body_at + length > n:
+                if torn_ok:
+                    return self._truncate_tail(path, pos)
+                raise CorruptLogError(f"{path}: torn body at {pos}")
+            body = data[body_at : body_at + length]
+            if zlib.crc32(body) != crc:
+                if torn_ok and body_at + length == n:
+                    return self._truncate_tail(path, pos)  # torn final record
+                raise CorruptLogError(f"{path}: bad crc at {pos}")
+            try:
+                self._apply_record(kind, body)
+            except (WireError, ValueError, struct.error) as e:
+                raise CorruptLogError(f"{path}: bad record at {pos}: {e}")
+            pos = body_at + length
+
+    def _truncate_tail(self, path: str, pos: int) -> None:
+        """Cut the torn bytes off a crash tail — otherwise the next open
+        replays this segment as a non-last segment (torn_ok=False) and the
+        WAL becomes permanently unopenable."""
+        _log.warning("%s: truncating torn tail at %d", path, pos)
+        with open(path, "r+b") as f:
+            f.truncate(pos)
+            f.flush()
+            os.fsync(f.fileno())
+        self._sync_dir()
+
+    def _apply_record(self, kind: int, body: bytes) -> None:
+        r = _R(body)
+        if kind == K_STATE_ENTRIES:
+            shard_id, replica_id = r.i64(), r.i64()
+            state = State(term=r.i64(), vote=r.i64(), commit=r.i64())
+            entries = tuple(_r_entry(r) for _ in range(r.count()))
+            ss = _r_snapshot(r) if r.u8() else Snapshot()
+            u = Update(shard_id=shard_id, replica_id=replica_id)
+            u.state = state
+            u.entries_to_save = list(entries)
+            u.snapshot = ss
+            self._mirror.save_raft_state([u], 0)
+        elif kind == K_SNAPSHOT:
+            shard_id, replica_id = r.i64(), r.i64()
+            ss = _r_snapshot(r)
+            u = Update(shard_id=shard_id, replica_id=replica_id)
+            u.snapshot = ss
+            self._mirror.save_snapshots([u])
+        elif kind == K_BOOTSTRAP:
+            shard_id, replica_id = r.i64(), r.i64()
+            addresses = {}
+            for _ in range(r.count()):
+                rid = r.i64()
+                addresses[rid] = r.s()
+            join = bool(r.u8())
+            self._mirror.save_bootstrap_info(
+                shard_id, replica_id, Bootstrap(addresses=addresses, join=join)
+            )
+        elif kind == K_REMOVE_TO:
+            shard_id, replica_id, index = r.i64(), r.i64(), r.i64()
+            self._mirror.remove_entries_to(shard_id, replica_id, index)
+        elif kind == K_REMOVE_NODE:
+            shard_id, replica_id = r.i64(), r.i64()
+            self._mirror.remove_node_data(shard_id, replica_id)
+        else:
+            raise WireError(f"unknown record kind {kind}")
+
+    # -- writes -----------------------------------------------------------
+    def _append_records(
+        self, recs: List[tuple], sync: bool = True, rotate: bool = True
+    ) -> None:
+        """recs = [(kind, body)]; one write + one fsync for the batch."""
+        buf = BytesIO()
+        for kind, body in recs:
+            buf.write(_REC_HEADER.pack(kind, len(body), zlib.crc32(body)))
+            buf.write(body)
+        raw = buf.getvalue()
+        self._fh.write(raw)
+        self._fh.flush()
+        if sync:
+            os.fsync(self._fh.fileno())
+        self._active_bytes += len(raw)
+        if rotate and self._active_bytes >= self.max_segment_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        self._open_active()
+        closed = len(self._segments()) - 1
+        if closed > self.gc_segments:
+            self._checkpoint_gc()
+
+    def _checkpoint_gc(self) -> None:
+        """Re-serialize the live mirror into the new active segment and
+        delete every older segment."""
+        old = [s for s in self._segments() if s != self._active_seq]
+        recs: List[tuple] = []
+        with self._mirror._lock:
+            for (shard_id, replica_id), ns in self._mirror._nodes.items():
+                if ns.bootstrap is not None:
+                    recs.append(
+                        (
+                            K_BOOTSTRAP,
+                            _encode_bootstrap(shard_id, replica_id, ns.bootstrap),
+                        )
+                    )
+                u = Update(shard_id=shard_id, replica_id=replica_id)
+                u.state = ns.state
+                u.entries_to_save = [
+                    ns.entries[i] for i in sorted(ns.entries)
+                ]
+                u.snapshot = ns.snapshot
+                recs.append((K_STATE_ENTRIES, _encode_state_entries(u)))
+                if ns.min_index > 1:
+                    recs.append(
+                        (
+                            K_REMOVE_TO,
+                            _encode_pair_index(
+                                shard_id, replica_id, ns.min_index - 1
+                            ),
+                        )
+                    )
+        # a checkpoint may itself exceed the segment cap; it must never
+        # re-trigger rotation (that would recurse into another checkpoint)
+        self._append_records(recs, sync=True, rotate=False)
+        self._sync_dir()
+        for seq in old:
+            try:
+                os.unlink(self._segment_path(seq))
+            except OSError:
+                pass
+        self._sync_dir()
+
+    # -- ILogDB -----------------------------------------------------------
+    def name(self) -> str:
+        return "tan"
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+
+    def list_node_info(self) -> List[NodeInfo]:
+        return self._mirror.list_node_info()
+
+    def save_bootstrap_info(self, shard_id, replica_id, bootstrap) -> None:
+        with self._lock:
+            self._append_records(
+                [(K_BOOTSTRAP, _encode_bootstrap(shard_id, replica_id, bootstrap))]
+            )
+            self._mirror.save_bootstrap_info(shard_id, replica_id, bootstrap)
+
+    def get_bootstrap_info(self, shard_id, replica_id):
+        return self._mirror.get_bootstrap_info(shard_id, replica_id)
+
+    def save_raft_state(self, updates: List[Update], worker_id: int) -> None:
+        recs = [
+            (K_STATE_ENTRIES, _encode_state_entries(u)) for u in updates
+        ]
+        with self._lock:
+            self._append_records(recs)  # ONE fsync for the whole batch
+            self._mirror.save_raft_state(updates, worker_id)
+
+    def read_raft_state(self, shard_id, replica_id, last_index):
+        return self._mirror.read_raft_state(shard_id, replica_id, last_index)
+
+    def iterate_entries(self, shard_id, replica_id, low, high, max_size):
+        return self._mirror.iterate_entries(
+            shard_id, replica_id, low, high, max_size
+        )
+
+    def term(self, shard_id, replica_id, index):
+        return self._mirror.term(shard_id, replica_id, index)
+
+    def remove_entries_to(self, shard_id, replica_id, index) -> None:
+        with self._lock:
+            self._append_records(
+                [(K_REMOVE_TO, _encode_pair_index(shard_id, replica_id, index))],
+                sync=False,  # compaction is advisory; replay just keeps more
+            )
+            self._mirror.remove_entries_to(shard_id, replica_id, index)
+
+    def compact_entries_to(self, shard_id, replica_id, index) -> None:
+        self.remove_entries_to(shard_id, replica_id, index)
+
+    def save_snapshots(self, updates: List[Update]) -> None:
+        recs = [
+            (K_SNAPSHOT, _encode_snapshot(u.shard_id, u.replica_id, u.snapshot))
+            for u in updates
+            if not u.snapshot.is_empty()
+        ]
+        if not recs:
+            return
+        with self._lock:
+            self._append_records(recs)
+            self._mirror.save_snapshots(updates)
+
+    def get_snapshot(self, shard_id, replica_id) -> Snapshot:
+        return self._mirror.get_snapshot(shard_id, replica_id)
+
+    def remove_node_data(self, shard_id, replica_id) -> None:
+        with self._lock:
+            self._append_records(
+                [(K_REMOVE_NODE, _encode_pair(shard_id, replica_id))]
+            )
+            self._mirror.remove_node_data(shard_id, replica_id)
+
+    def import_snapshot(self, snapshot: Snapshot, replica_id: int) -> None:
+        with self._lock:
+            self._mirror.import_snapshot(snapshot, replica_id)
+            ns = self._mirror._get(snapshot.shard_id, replica_id)
+            u = Update(shard_id=snapshot.shard_id, replica_id=replica_id)
+            u.state = ns.state
+            u.snapshot = snapshot
+            self._append_records(
+                [
+                    (K_STATE_ENTRIES, _encode_state_entries(u)),
+                    (
+                        K_REMOVE_TO,
+                        _encode_pair_index(
+                            snapshot.shard_id, replica_id, snapshot.index
+                        ),
+                    ),
+                ]
+            )
+
+
+def tan_logdb_factory(config) -> TanLogDB:
+    """NodeHostConfig.expert.logdb_factory hook."""
+    base = config.wal_dir or config.nodehost_dir
+    return TanLogDB(os.path.join(base, "tan"))

@@ -67,7 +67,11 @@ def import_snapshot(
         )
     with open(os.path.join(export_dir, PAYLOAD_FILENAME), "rb") as f:
         raw = f.read()
-    payload = raw[4:]  # strip the storage checksum; save() re-stamps it
+    payload = raw[4:]
+    from .storage.snapshotter import _checksum
+
+    if _checksum(payload) != raw[:4]:
+        raise IOError(f"corrupt snapshot export in {export_dir}")
     path = nodehost.snapshot_storage.save(
         shard_id, replica_id, meta.index, payload, suffix="imported"
     )

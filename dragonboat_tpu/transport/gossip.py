@@ -152,6 +152,14 @@ class GossipManager:
     def _merge(self, table: Dict[str, Tuple[str, int]], sender) -> None:
         with self._lock:
             for nhid, (addr, ver) in table.items():
+                if nhid == self.nodehost_id:
+                    # never accept a peer's view of OUR address: after a
+                    # restart peers gossip the old address at a higher
+                    # version; refute it by re-asserting ours above it
+                    cur_addr, cur_ver = self._table[nhid]
+                    if ver >= cur_ver and addr != cur_addr:
+                        self._table[nhid] = (cur_addr, ver + 1)
+                    continue
                 cur = self._table.get(nhid)
                 if cur is None or ver > cur[1]:
                     self._table[nhid] = (addr, ver)

@@ -146,6 +146,52 @@ class TestGossip:
             for m in managers:
                 m.close()
 
+    def test_restart_refutes_stale_own_address(self):
+        """A restarted host re-seeds its row at version 1 while peers
+        gossip the old address at a higher version; the node must refute
+        rather than adopt its own stale address (code-review finding)."""
+        managers = []
+        try:
+            a = GossipManager("nhid-a", "addr-old:1", "127.0.0.1:0", [], interval=0.05)
+            a.start()
+            managers.append(a)
+            b = GossipManager(
+                "nhid-b", "addr-b:1", "127.0.0.1:0", [a.bind_address], interval=0.05
+            )
+            b.start()
+            managers.append(b)
+            deadline = time.time() + 5.0
+            while time.time() < deadline and len(b.table()) < 2:
+                time.sleep(0.05)
+            # bump a's version a few times so b holds (addr-old, high ver)
+            for _ in range(3):
+                a.set_raft_address("addr-old:1")
+            time.sleep(0.3)
+            # "restart" a with a NEW address at version 1
+            a.close()
+            managers.remove(a)
+            a2 = GossipManager(
+                "nhid-a", "addr-new:9", a.bind_address, [b.bind_address],
+                interval=0.05,
+            )
+            a2.start()
+            managers.append(a2)
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if (
+                    a2.lookup("nhid-a") == "addr-new:9"
+                    and b.lookup("nhid-a") == "addr-new:9"
+                ):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"stale address won: a2={a2.lookup('nhid-a')} b={b.lookup('nhid-a')}"
+                )
+        finally:
+            for m in managers:
+                m.close()
+
     def test_registry_translation(self):
         mgr = GossipManager("nhid-x", "10.0.0.1:100", "127.0.0.1:0", [])
         try:

@@ -1,0 +1,237 @@
+"""Chaos tests: partitions, kills, restarts under concurrent client load.
+
+reference: the drummer/monkeytest methodology [U] — long-running
+multi-NodeHost clusters with fault injection and invariant checks:
+
+  I1 (no loss):      every ACKED write is present after healing
+  I2 (agreement):    all replicas' SM state is identical after settling
+  I3 (availability): the cluster accepts writes again after healing
+
+Faults are injected through the in-proc transport's drop hook
+(partitions) and real NodeHost close/reopen over tan WAL dirs (kills).
+"""
+import pickle
+import random
+import shutil
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import (
+    EngineConfig,
+    ExpertConfig,
+    NodeHost,
+    NodeHostConfig,
+    RequestDropped,
+    SystemBusy,
+    TimeoutError_,
+)
+from dragonboat_tpu.storage.tan import tan_logdb_factory
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+from test_nodehost import KVStore, set_cmd, shard_config, wait_for_leader
+
+ADDRS = {1: "cnh-1", 2: "cnh-2", 3: "cnh-3"}
+
+
+def make_chaos_nodehost(replica_id):
+    cfg = NodeHostConfig(
+        nodehost_dir=f"/tmp/nh-chaos-{replica_id}",
+        rtt_millisecond=2,
+        raft_address=ADDRS[replica_id],
+        expert=ExpertConfig(
+            engine=EngineConfig(exec_shards=2, apply_shards=2),
+            logdb_factory=tan_logdb_factory,
+        ),
+    )
+    return NodeHost(cfg)
+
+
+class Cluster:
+    def __init__(self):
+        reset_inproc_network()
+        for rid in ADDRS:
+            shutil.rmtree(f"/tmp/nh-chaos-{rid}", ignore_errors=True)
+        self.nhs = {}
+        for rid in ADDRS:
+            self.start(rid)
+        for rid, nh in self.nhs.items():
+            nh.start_replica(ADDRS, False, KVStore, shard_config(rid))
+
+    def start(self, rid):
+        self.nhs[rid] = make_chaos_nodehost(rid)
+
+    def kill(self, rid):
+        """Hard-ish kill: close the nodehost (tan WAL survives)."""
+        self.nhs.pop(rid).close()
+
+    def restart(self, rid):
+        self.start(rid)
+        self.nhs[rid].start_replica(ADDRS, False, KVStore, shard_config(rid))
+
+    def partition(self, side_a):
+        """Messages between side_a and the rest are dropped, both ways."""
+        side_a = set(side_a)
+        addr_side = {ADDRS[r] for r in side_a}
+
+        def mk_hook(my_rid):
+            mine_in_a = my_rid in side_a
+
+            def hook(target, _payload):
+                return (target in addr_side) != mine_in_a
+
+            return hook
+
+        for rid, nh in self.nhs.items():
+            nh.transport.raw.drop_hook = mk_hook(rid)
+
+    def heal(self):
+        for nh in self.nhs.values():
+            nh.transport.raw.drop_hook = None
+
+    def close(self):
+        for nh in self.nhs.values():
+            nh.close()
+        self.nhs = {}
+
+    def settle_and_check_agreement(self, acked, timeout=20.0):
+        """I1 + I2: wait until every replica's SM holds all acked writes
+        and all replicas agree byte-for-byte."""
+        deadline = time.time() + timeout
+        # nudge the shard so followers catch up
+        while time.time() < deadline:
+            datas = []
+            for nh in self.nhs.values():
+                node = nh._nodes.get(1)
+                sm = node.sm.managed.sm  # the user KVStore
+                datas.append(dict(sm.data))
+            ok = all(d == datas[0] for d in datas)
+            missing = [k for k in acked if acked[k] != datas[0].get(k)]
+            if ok and not missing:
+                return datas[0]
+            time.sleep(0.1)
+        raise AssertionError(
+            f"no agreement: sizes={[len(d) for d in datas]} "
+            f"missing_acked={len(missing)} sample={missing[:5]}"
+        )
+
+
+def chaos_client(cluster, acked, stop, tag):
+    """Proposes continuously via random replicas; records ACKs."""
+    i = 0
+    while not stop.is_set():
+        i += 1
+        key = f"{tag}-{i}"
+        val = f"{tag}v{i}".encode()
+        rids = list(cluster.nhs)
+        rid = random.choice(rids)
+        try:
+            nh = cluster.nhs.get(rid)
+            if nh is None:
+                continue
+            s = nh.get_noop_session(1)
+            nh.sync_propose(s, set_cmd(key, val), timeout=1.0)
+            acked[key] = val  # ONLY acked writes must survive
+        except (TimeoutError_, RequestDropped, SystemBusy, Exception):
+            pass
+        time.sleep(0.002)
+
+
+class TestChaos:
+    def test_partitions_and_restarts_preserve_acked_writes(self):
+        random.seed(7)
+        cluster = Cluster()
+        acked = {}
+        stop = threading.Event()
+        clients = [
+            threading.Thread(
+                target=chaos_client, args=(cluster, acked, stop, f"c{k}")
+            )
+            for k in range(3)
+        ]
+        try:
+            wait_for_leader(cluster.nhs)
+            for t in clients:
+                t.start()
+            # fault schedule: partitions + a kill/restart cycle
+            for round_ in range(4):
+                time.sleep(0.8)
+                minority = [random.choice(list(ADDRS))]
+                cluster.partition(minority)
+                time.sleep(0.8)
+                cluster.heal()
+                time.sleep(0.4)
+                victim = random.choice(list(ADDRS))
+                cluster.kill(victim)
+                time.sleep(0.6)
+                cluster.restart(victim)
+                wait_for_leader(cluster.nhs, timeout=20.0)
+            stop.set()
+            for t in clients:
+                t.join(timeout=5.0)
+            cluster.heal()
+            assert len(acked) > 20, f"chaos made no progress: {len(acked)}"
+            final = cluster.settle_and_check_agreement(acked)
+            # I3: cluster is still writable
+            wait_for_leader(cluster.nhs, timeout=10.0)
+            nh = next(iter(cluster.nhs.values()))
+            s = nh.get_noop_session(1)
+            deadline = time.time() + 10.0
+            while True:
+                try:
+                    nh.sync_propose(s, set_cmd("final", b"1"), timeout=1.0)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in clients:
+                t.join(timeout=5.0)
+            cluster.close()
+
+    def test_majority_partition_keeps_committing(self):
+        random.seed(11)
+        cluster = Cluster()
+        try:
+            wait_for_leader(cluster.nhs)
+            # isolate replica 3: the {1,2} majority must keep working
+            cluster.partition([3])
+            acked = {}
+            nh = cluster.nhs[1]
+            s = nh.get_noop_session(1)
+            deadline = time.time() + 15.0
+            n_ok = 0
+            while n_ok < 10 and time.time() < deadline:
+                try:
+                    key = f"maj-{n_ok}"
+                    nh.sync_propose(s, set_cmd(key, b"v"), timeout=1.0)
+                    acked[key] = b"v"
+                    n_ok += 1
+                except Exception:
+                    time.sleep(0.05)
+            assert n_ok == 10, f"majority only committed {n_ok}"
+            cluster.heal()
+            cluster.settle_and_check_agreement(acked)
+        finally:
+            cluster.close()
+
+    def test_minority_partition_cannot_commit(self):
+        cluster = Cluster()
+        try:
+            lid = wait_for_leader(cluster.nhs)
+            # isolate the LEADER alone: it must not be able to commit
+            cluster.partition([lid])
+            time.sleep(0.3)  # let the old leader notice nothing acks
+            nh = cluster.nhs[lid]
+            s = nh.get_noop_session(1)
+            with pytest.raises(Exception):
+                nh.sync_propose(s, set_cmd("stale", b"x"), timeout=1.5)
+            cluster.heal()
+            # after healing the write never appears (it was never committed
+            # by a quorum; the new term's log wins)
+            cluster.settle_and_check_agreement({})
+        finally:
+            cluster.close()

@@ -1,0 +1,226 @@
+"""tan LogDB tests: record round-trips, crash-reopen durability, torn
+tails, checkpoint GC, and a NodeHost that restarts from real disk.
+
+reference test pattern: internal/tan + logdb crash-reopen cycles under
+strict MemFS [U]; here real files + explicit torn-tail truncation.
+"""
+import os
+import shutil
+import time
+
+import pytest
+
+from dragonboat_tpu import (
+    EngineConfig,
+    ExpertConfig,
+    NodeHost,
+    NodeHostConfig,
+)
+from dragonboat_tpu.pb import Bootstrap, Entry, Snapshot, State, Update
+from dragonboat_tpu.storage.tan import (
+    CorruptLogError,
+    TanLogDB,
+    tan_logdb_factory,
+)
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+from test_nodehost import (
+    ADDRS,
+    KVStore,
+    propose_r,
+    set_cmd,
+    shard_config,
+    wait_for_leader,
+)
+
+
+def mk_update(shard=1, replica=1, term=1, vote=0, commit=0, entries=(), ss=None):
+    u = Update(shard_id=shard, replica_id=replica)
+    u.state = State(term=term, vote=vote, commit=commit)
+    u.entries_to_save = list(entries)
+    if ss is not None:
+        u.snapshot = ss
+    return u
+
+
+def ent(i, t=1, cmd=b""):
+    return Entry(term=t, index=i, cmd=cmd)
+
+
+class TestTanDurability:
+    def test_reopen_round_trip(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_bootstrap_info(1, 2, Bootstrap(addresses={1: "a", 2: "b"}))
+        db.save_raft_state(
+            [mk_update(term=3, vote=2, commit=2, entries=[ent(1), ent(2, 2), ent(3, 3)])],
+            0,
+        )
+        db.close()
+
+        db2 = TanLogDB(d)
+        bs = db2.get_bootstrap_info(1, 2)
+        assert bs.addresses == {1: "a", 2: "b"}
+        rs = db2.read_raft_state(1, 1, 0)
+        assert rs.state == State(term=3, vote=2, commit=2)
+        ents = db2.iterate_entries(1, 1, 1, 4, 2**30)
+        assert [e.index for e in ents] == [1, 2, 3]
+        assert db2.term(1, 1, 3) == 3
+        db2.close()
+
+    def test_truncation_overwrite_survives_reopen(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_raft_state([mk_update(entries=[ent(1), ent(2), ent(3)])], 0)
+        # a new leader truncates 2.. and writes a different suffix
+        db.save_raft_state([mk_update(term=2, entries=[ent(2, 2, b"x")])], 0)
+        db.close()
+        db2 = TanLogDB(d)
+        ents = db2.iterate_entries(1, 1, 1, 10, 2**30)
+        assert [(e.index, e.term) for e in ents] == [(1, 1), (2, 2)]
+        db2.close()
+
+    def test_torn_tail_is_clean_crash(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_raft_state([mk_update(entries=[ent(1)])], 0)
+        db.save_raft_state([mk_update(term=2, entries=[ent(2)])], 0)
+        seg = db._segment_path(db._active_seq)
+        db.close()
+        # simulate a crash mid-write of the LAST record
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - 7)
+        db2 = TanLogDB(d)
+        ents = db2.iterate_entries(1, 1, 1, 10, 2**30)
+        assert [e.index for e in ents] == [1]  # the torn batch is gone
+        assert db2.read_raft_state(1, 1, 0).state.term == 1
+        db2.close()
+
+    def test_torn_tail_double_reopen(self, tmp_path):
+        """The torn tail must be truncated at first reopen — otherwise the
+        second reopen replays the old segment with torn_ok=False and the
+        WAL is permanently unopenable (code-review finding)."""
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_raft_state([mk_update(entries=[ent(1)])], 0)
+        db.save_raft_state([mk_update(term=2, entries=[ent(2)])], 0)
+        seg = db._segment_path(db._active_seq)
+        db.close()
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - 7)
+        db2 = TanLogDB(d)
+        db2.save_raft_state([mk_update(term=3, entries=[ent(2, 3)])], 0)
+        db2.close()
+        db3 = TanLogDB(d)  # must NOT raise CorruptLogError
+        ents = db3.iterate_entries(1, 1, 1, 10, 2**30)
+        assert [(e.index, e.term) for e in ents] == [(1, 1), (2, 3)]
+        db3.close()
+
+    def test_mid_log_corruption_raises(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_raft_state([mk_update(entries=[ent(1)])], 0)
+        db.save_raft_state([mk_update(term=2, entries=[ent(2)])], 0)
+        seg = db._segment_path(db._active_seq)
+        db.close()
+        with open(seg, "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff")
+        with pytest.raises(CorruptLogError):
+            TanLogDB(d)
+
+    def test_compaction_and_snapshot_reopen(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_raft_state(
+            [mk_update(commit=5, entries=[ent(i) for i in range(1, 6)])], 0
+        )
+        ss = Snapshot(filepath="/x", index=4, term=1, shard_id=1, replica_id=1)
+        db.save_snapshots([mk_update(ss=ss)])
+        db.remove_entries_to(1, 1, 4)
+        db.close()
+        db2 = TanLogDB(d)
+        assert db2.get_snapshot(1, 1).index == 4
+        assert db2.term(1, 1, 4) == 1  # via snapshot
+        ents = db2.iterate_entries(1, 1, 5, 6, 2**30)
+        assert [e.index for e in ents] == [5]
+        db2.close()
+
+    def test_checkpoint_gc_shrinks_segments(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d, max_segment_bytes=2048, gc_segments=2)
+        for i in range(1, 200):
+            db.save_raft_state(
+                [mk_update(term=1, commit=i, entries=[ent(i, 1, b"p" * 64)])], 0
+            )
+            if i % 50 == 0:
+                db.remove_entries_to(1, 1, i - 10)
+        segs = db._segments()
+        assert len(segs) <= db.gc_segments + 2, segs
+        db.close()
+        db2 = TanLogDB(d)
+        last = db2.iterate_entries(1, 1, 199, 200, 2**30)
+        assert [e.index for e in last] == [199]
+        assert db2.read_raft_state(1, 1, 0).state.commit == 199
+        db2.close()
+
+
+# ---------------------------------------------------------------------------
+# NodeHost restart from real disk
+# ---------------------------------------------------------------------------
+def make_tan_nodehost(replica_id, rtt_ms=2):
+    cfg = NodeHostConfig(
+        nodehost_dir=f"/tmp/nh-tan-{replica_id}",
+        rtt_millisecond=rtt_ms,
+        raft_address=ADDRS[replica_id],
+        expert=ExpertConfig(
+            engine=EngineConfig(exec_shards=2, apply_shards=2),
+            logdb_factory=tan_logdb_factory,
+        ),
+    )
+    return NodeHost(cfg)
+
+
+class TestNodeHostOnTan:
+    def test_full_process_restart_replays_wal(self):
+        reset_inproc_network()
+        for rid in ADDRS:
+            shutil.rmtree(f"/tmp/nh-tan-{rid}", ignore_errors=True)
+        nhs = {rid: make_tan_nodehost(rid) for rid in ADDRS}
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(ADDRS, False, KVStore, shard_config(rid))
+            wait_for_leader(nhs)
+            s = nhs[1].get_noop_session(1)
+            for i in range(20):
+                propose_r(nhs[1], s, set_cmd(f"d-{i}", str(i).encode()))
+        finally:
+            for nh in nhs.values():
+                nh.close()
+
+        # "process restart": brand-new NodeHosts over the same dirs
+        reset_inproc_network()
+        nhs = {rid: make_tan_nodehost(rid) for rid in ADDRS}
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(ADDRS, False, KVStore, shard_config(rid))
+            wait_for_leader(nhs)
+            deadline = time.time() + 10.0
+            while True:
+                try:
+                    assert nhs[2].sync_read(1, "d-19", timeout=2.0) == b"19"
+                    break
+                except AssertionError:
+                    raise
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+            # and the shard still accepts writes
+            s = nhs[1].get_noop_session(1)
+            propose_r(nhs[1], s, set_cmd("after-restart", b"1"))
+        finally:
+            for nh in nhs.values():
+                nh.close()

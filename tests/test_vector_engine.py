@@ -173,10 +173,10 @@ class TestVectorCluster:
                     ADDRS, False, KVStore, vec_shard_config(rid, shard_id=shard)
                 )
         for shard in (2, 3, 4):
-            wait_for_leader(vcluster, shard_id=shard)
+            wait_for_leader(vcluster, shard_id=shard, timeout=20.0)
             nh = vcluster[1]
             s = nh.get_noop_session(shard)
-            propose_r(nh, s, set_cmd(f"s{shard}", bytes([shard])))
+            propose_r(nh, s, set_cmd(f"s{shard}", bytes([shard])), deadline=20.0)
         for shard in (2, 3, 4):
             assert read_r(vcluster[2], shard, f"s{shard}") == bytes([shard])
 
