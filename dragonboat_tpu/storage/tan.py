@@ -132,6 +132,7 @@ class TanLogDB(ILogDB):
         *,
         max_segment_bytes: int = DEFAULT_MAX_SEGMENT_BYTES,
         gc_segments: int = DEFAULT_GC_SEGMENTS,
+        use_native: Optional[bool] = None,
     ):
         self.dir = directory
         self.max_segment_bytes = max_segment_bytes
@@ -139,8 +140,20 @@ class TanLogDB(ILogDB):
         self._mirror = InMemLogDB()
         self._lock = threading.Lock()
         self._fh = None
+        self._writer = None  # native group-commit writer (when available)
+        if use_native is None or use_native:
+            from ..native import load_walwriter
+
+            native_ok = load_walwriter() is not None
+            if use_native and not native_ok:
+                raise OSError("native walwriter requested but unavailable")
+            self._use_native = native_ok
+        else:
+            self._use_native = False
         self._active_seq = 0
         self._active_bytes = 0
+        self._inflight = 0  # native appends running outside the lock
+        self._idle = threading.Condition(self._lock)  # inflight == 0
         os.makedirs(directory, exist_ok=True)
         self._replay()
         self._open_active()
@@ -163,9 +176,25 @@ class TanLogDB(ILogDB):
         segs = self._segments()
         self._active_seq = (segs[-1] + 1) if segs else 1
         path = self._segment_path(self._active_seq)
-        self._fh = open(path, "ab")
-        self._active_bytes = self._fh.tell()
+        if self._use_native:
+            from ..native import NativeWalWriter
+
+            self._writer = NativeWalWriter(path)
+            self._active_bytes = self._writer.size()
+        else:
+            self._fh = open(path, "ab")
+            self._active_bytes = self._fh.tell()
         self._sync_dir()
+
+    def _close_active(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None
 
     def _sync_dir(self) -> None:
         dfd = os.open(self.dir, os.O_RDONLY)
@@ -257,25 +286,48 @@ class TanLogDB(ILogDB):
             raise WireError(f"unknown record kind {kind}")
 
     # -- writes -----------------------------------------------------------
-    def _append_records(
-        self, recs: List[tuple], sync: bool = True, rotate: bool = True
-    ) -> None:
-        """recs = [(kind, body)]; one write + one fsync for the batch."""
+    @staticmethod
+    def _frame(recs: List[tuple]) -> bytes:
         buf = BytesIO()
         for kind, body in recs:
             buf.write(_REC_HEADER.pack(kind, len(body), zlib.crc32(body)))
             buf.write(body)
-        raw = buf.getvalue()
-        self._fh.write(raw)
-        self._fh.flush()
-        if sync:
-            os.fsync(self._fh.fileno())
+        return buf.getvalue()
+
+    def _quiesce_appends_locked(self) -> None:
+        """Wait (holding the lock) until no native append runs outside it.
+
+        Every locked mutator that appends records must call this first:
+        it restores the file-order == mirror-order invariant against the
+        unlocked native save path, and makes writer swaps (rotate/close)
+        safe."""
+        while self._inflight:
+            self._idle.wait()
+
+    def _append_records(
+        self, recs: List[tuple], sync: bool = True, rotate: bool = True
+    ) -> None:
+        """recs = [(kind, body)]; one write + one fsync for the batch."""
+        raw = self._frame(recs)
+        if self._writer is not None:
+            # native path: write+fsync on the group-commit thread, GIL
+            # released; concurrent workers' batches share one fsync
+            self._writer.append(raw, sync=sync)
+        else:
+            self._fh.write(raw)
+            self._fh.flush()
+            if sync:
+                os.fsync(self._fh.fileno())
         self._active_bytes += len(raw)
-        if rotate and self._active_bytes >= self.max_segment_bytes:
+        if (
+            rotate
+            and self._inflight == 0  # never swap the writer under an append
+            and self._active_bytes >= self.max_segment_bytes
+        ):
             self._rotate()
 
     def _rotate(self) -> None:
-        self._fh.close()
+        self._close_active()
         self._open_active()
         closed = len(self._segments()) - 1
         if closed > self.gc_segments:
@@ -328,17 +380,15 @@ class TanLogDB(ILogDB):
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                self._fh.flush()
-                os.fsync(self._fh.fileno())
-                self._fh.close()
-                self._fh = None
+            self._quiesce_appends_locked()
+            self._close_active()
 
     def list_node_info(self) -> List[NodeInfo]:
         return self._mirror.list_node_info()
 
     def save_bootstrap_info(self, shard_id, replica_id, bootstrap) -> None:
         with self._lock:
+            self._quiesce_appends_locked()
             self._append_records(
                 [(K_BOOTSTRAP, _encode_bootstrap(shard_id, replica_id, bootstrap))]
             )
@@ -351,9 +401,38 @@ class TanLogDB(ILogDB):
         recs = [
             (K_STATE_ENTRIES, _encode_state_entries(u)) for u in updates
         ]
+        if self._writer is None:
+            with self._lock:
+                self._append_records(recs)  # ONE fsync for the whole batch
+                self._mirror.save_raft_state(updates, worker_id)
+            return
+        # native path: the blocking (durable) append runs OUTSIDE the
+        # lock so concurrent workers' batches group-commit into shared
+        # fsyncs.  Per-shard record order is preserved (each shard is
+        # stepped by exactly one worker); locked mutators for the same
+        # shard quiesce in-flight appends first.
+        raw = self._frame(recs)
         with self._lock:
-            self._append_records(recs)  # ONE fsync for the whole batch
-            self._mirror.save_raft_state(updates, worker_id)
+            w = self._writer
+            self._inflight += 1
+        ok = False
+        try:
+            w.append(raw, sync=True)
+            ok = True
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._idle.notify_all()
+                if ok:
+                    # publish to readers only AFTER the bytes are durable
+                    self._active_bytes += len(raw)
+                    self._mirror.save_raft_state(updates, worker_id)
+                    if (
+                        self._inflight == 0
+                        and self._active_bytes >= self.max_segment_bytes
+                    ):
+                        self._rotate()
 
     def read_raft_state(self, shard_id, replica_id, last_index):
         return self._mirror.read_raft_state(shard_id, replica_id, last_index)
@@ -368,6 +447,7 @@ class TanLogDB(ILogDB):
 
     def remove_entries_to(self, shard_id, replica_id, index) -> None:
         with self._lock:
+            self._quiesce_appends_locked()
             self._append_records(
                 [(K_REMOVE_TO, _encode_pair_index(shard_id, replica_id, index))],
                 sync=False,  # compaction is advisory; replay just keeps more
@@ -386,6 +466,7 @@ class TanLogDB(ILogDB):
         if not recs:
             return
         with self._lock:
+            self._quiesce_appends_locked()
             self._append_records(recs)
             self._mirror.save_snapshots(updates)
 
@@ -394,6 +475,7 @@ class TanLogDB(ILogDB):
 
     def remove_node_data(self, shard_id, replica_id) -> None:
         with self._lock:
+            self._quiesce_appends_locked()
             self._append_records(
                 [(K_REMOVE_NODE, _encode_pair(shard_id, replica_id))]
             )
@@ -401,6 +483,7 @@ class TanLogDB(ILogDB):
 
     def import_snapshot(self, snapshot: Snapshot, replica_id: int) -> None:
         with self._lock:
+            self._quiesce_appends_locked()
             self._mirror.import_snapshot(snapshot, replica_id)
             ns = self._mirror._get(snapshot.shard_id, replica_id)
             u = Update(shard_id=snapshot.shard_id, replica_id=replica_id)

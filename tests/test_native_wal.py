@@ -1,0 +1,132 @@
+"""Native group-commit WAL writer tests (C++ via ctypes) + tan on top.
+
+Skipped wholesale if the toolchain can't build the library.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu.native import NativeWalWriter, load_walwriter
+from dragonboat_tpu.storage.tan import TanLogDB
+
+from test_tan import ent, mk_update
+
+pytestmark = pytest.mark.skipif(
+    load_walwriter() is None, reason="native walwriter unavailable"
+)
+
+
+class TestNativeWriter:
+    def test_append_durable_and_reopen(self, tmp_path):
+        p = str(tmp_path / "seg.log")
+        w = NativeWalWriter(p)
+        assert w.append(b"hello", sync=True) == 5
+        assert w.append(b"world", sync=True) == 10
+        w.close()
+        with open(p, "rb") as f:
+            assert f.read() == b"helloworld"
+        # reopen appends at the end
+        w2 = NativeWalWriter(p)
+        assert w2.size() == 10
+        w2.append(b"!", sync=True)
+        w2.close()
+        with open(p, "rb") as f:
+            assert f.read() == b"helloworld!"
+
+    def test_concurrent_group_commit(self, tmp_path):
+        p = str(tmp_path / "seg.log")
+        w = NativeWalWriter(p)
+        N, K = 8, 50
+        errs = []
+
+        def worker(tag):
+            try:
+                for i in range(K):
+                    rec = f"[{tag}:{i:04d}]".encode()
+                    w.append(rec, sync=True)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(N)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        w.close()
+        assert not errs
+        data = open(p, "rb").read()
+        # every record present exactly once (no tearing, no loss)
+        for tag in range(N):
+            for i in range(K):
+                assert data.count(f"[{tag}:{i:04d}]".encode()) == 1
+        # sanity: group commit must beat one-fsync-per-append rates; just
+        # assert it completed (timing asserts are flaky in CI); dt kept
+        # for local inspection
+        assert dt > 0
+
+    def test_unsync_append_then_sync(self, tmp_path):
+        p = str(tmp_path / "seg.log")
+        w = NativeWalWriter(p)
+        w.append(b"a" * 100, sync=False)
+        w.sync()
+        w.close()
+        assert os.path.getsize(p) == 100
+
+
+class TestTanOnNative:
+    def test_round_trip_native(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d, use_native=True)
+        assert db._writer is not None
+        db.save_raft_state(
+            [mk_update(term=3, commit=2, entries=[ent(1), ent(2)])], 0
+        )
+        db.close()
+        db2 = TanLogDB(d, use_native=True)
+        ents = db2.iterate_entries(1, 1, 1, 3, 2**30)
+        assert [e.index for e in ents] == [1, 2]
+        assert db2.read_raft_state(1, 1, 0).state.term == 3
+        db2.close()
+
+    def test_concurrent_shards_native(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d, use_native=True, max_segment_bytes=8192)
+        errs = []
+
+        def worker(shard):
+            try:
+                for i in range(1, 40):
+                    db.save_raft_state(
+                        [
+                            mk_update(
+                                shard=shard,
+                                commit=i,
+                                entries=[ent(i, 1, b"x" * 32)],
+                            )
+                        ],
+                        shard,
+                    )
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in range(1, 9)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        db.close()
+        assert not errs
+        db2 = TanLogDB(d, use_native=True)
+        for shard in range(1, 9):
+            ents = db2.iterate_entries(shard, 1, 39, 40, 2**30)
+            assert [e.index for e in ents] == [39], f"shard {shard}"
+            assert db2.read_raft_state(shard, 1, 0).state.commit == 39
+        db2.close()
