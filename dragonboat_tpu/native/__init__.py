@@ -33,16 +33,11 @@ _load_failed = False
 
 
 def _build() -> bool:
-    cmd = [
-        "g++",
-        "-O2",
-        "-shared",
-        "-fPIC",
-        "-pthread",
-        "-o",
-        _LIB,
-        _SRC,
-    ]
+    # compile to a per-process temp path and rename: concurrent builders
+    # (two processes constructing TanLogDB) must never load a
+    # half-written .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
@@ -52,7 +47,12 @@ def _build() -> bool:
         return False
     if proc.returncode != 0:
         _log.warning("native walwriter build failed:\n%s", proc.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+    os.replace(tmp, _LIB)
     return True
 
 
@@ -113,15 +113,23 @@ class NativeWalWriter:
             raise OSError(f"wal_open failed: {path}")
 
     def append(self, data: bytes, sync: bool = True) -> int:
+        if not self._h:
+            raise OSError("walwriter is closed")
+        if not data:  # zero-length appends must not consume a ticket
+            return self.size()
         n = self._lib.wal_append(self._h, data, len(data), int(sync))
         if n < 0:
             raise OSError("wal_append I/O error")
         return n
 
     def size(self) -> int:
+        if not self._h:
+            raise OSError("walwriter is closed")
         return self._lib.wal_size(self._h)
 
     def sync(self) -> None:
+        if not self._h:
+            raise OSError("walwriter is closed")
         if self._lib.wal_sync(self._h) != 0:
             raise OSError("wal_sync I/O error")
 

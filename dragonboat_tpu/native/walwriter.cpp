@@ -100,6 +100,7 @@ int64_t wal_append(void* h, const char* buf, int64_t len, int32_t sync) {
   {
     std::unique_lock<std::mutex> lk(w->mu);
     if (w->io_error || w->stop) return -1;
+    if (len <= 0) return w->total;  // empty append must not take a ticket
     w->pending.append(buf, static_cast<size_t>(len));
     my_seq = ++w->queued_seq;
     w->total += len;

@@ -292,6 +292,16 @@ class NodeHost:
                 node = self._nodes.get(m.shard_id)
                 if node is None or node.replica_id != m.to:
                     continue
+                # learn the sender's return address from the batch (the
+                # reference's MessageBatch.SourceAddress): a replica that
+                # joined with empty members can respond BEFORE the
+                # membership config change commits — without this the
+                # first contact deadlocks (it cannot ack, so the leader
+                # never resends)
+                if batch.source_address and m.from_:
+                    self.registry.add(
+                        m.shard_id, m.from_, batch.source_address
+                    )
                 node.enqueue_received(m)
                 touched.add(m.shard_id)
         if touched:

@@ -291,6 +291,11 @@ class VectorStepEngine(IStepEngine):
         r = node.peer.raft
         if len(r.addresses) > self.P:
             return None
+        if r.is_self_removed():
+            # mid-join (empty membership) or removed: the kernel derives
+            # the replica's tier from its own peer slot, which doesn't
+            # exist yet/anymore — scalar path until membership settles
+            return None
         if r.read_index.pending or r.read_index.queue:
             return None
         if r.snapshotting:

@@ -147,6 +147,8 @@ class Raft:
             self.witnesses[pid] = Remote(next=1)
             self.addresses[pid] = addr
 
+        self.launched_non_voting = is_non_voting
+        self.launched_witness = is_witness
         if is_non_voting:
             self.role = RaftRole.NON_VOTING
         elif is_witness:
@@ -294,11 +296,21 @@ class Raft:
                 rm.match = last
 
     def become_follower(self, term: int, leader_id: int) -> None:
+        # a replica that joined with empty membership must keep its
+        # configured tier until the config-change entry applies — a
+        # "follower" window would let a witness campaign
+        in_any = (
+            self.replica_id in self.remotes
+            or self.replica_id in self.non_votings
+            or self.replica_id in self.witnesses
+        )
         restore_role = (
             RaftRole.NON_VOTING
             if self.replica_id in self.non_votings
+            or (not in_any and self.launched_non_voting)
             else RaftRole.WITNESS
             if self.replica_id in self.witnesses
+            or (not in_any and self.launched_witness)
             else RaftRole.FOLLOWER
         )
         self.role = restore_role

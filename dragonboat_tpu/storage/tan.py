@@ -154,6 +154,7 @@ class TanLogDB(ILogDB):
         self._active_bytes = 0
         self._inflight = 0  # native appends running outside the lock
         self._idle = threading.Condition(self._lock)  # inflight == 0
+        self._rotate_pending = False  # gate: new appends wait, inflight drains
         os.makedirs(directory, exist_ok=True)
         self._replay()
         self._open_active()
@@ -188,8 +189,10 @@ class TanLogDB(ILogDB):
 
     def _close_active(self) -> None:
         if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+            # clear the reference FIRST: if close() raises (I/O error),
+            # a later append must see "no writer", not a dead handle
+            w, self._writer = self._writer, None
+            w.close()
         if self._fh is not None:
             self._fh.flush()
             os.fsync(self._fh.fileno())
@@ -413,7 +416,13 @@ class TanLogDB(ILogDB):
         # shard quiesce in-flight appends first.
         raw = self._frame(recs)
         with self._lock:
+            # a pending rotation blocks NEW appends so inflight can drain
+            # — otherwise sustained load starves rotation (and GC) forever
+            while self._rotate_pending:
+                self._idle.wait()
             w = self._writer
+            if w is None:
+                raise OSError("logdb is closed")
             self._inflight += 1
         ok = False
         try:
@@ -429,10 +438,16 @@ class TanLogDB(ILogDB):
                     self._active_bytes += len(raw)
                     self._mirror.save_raft_state(updates, worker_id)
                     if (
-                        self._inflight == 0
-                        and self._active_bytes >= self.max_segment_bytes
+                        self._active_bytes >= self.max_segment_bytes
+                        and not self._rotate_pending
                     ):
-                        self._rotate()
+                        self._rotate_pending = True
+                        try:
+                            self._quiesce_appends_locked()
+                            self._rotate()
+                        finally:
+                            self._rotate_pending = False
+                            self._idle.notify_all()
 
     def read_raft_state(self, shard_id, replica_id, last_index):
         return self._mirror.read_raft_state(shard_id, replica_id, last_index)
