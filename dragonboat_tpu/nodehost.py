@@ -87,106 +87,119 @@ class NodeHost:
         self._nodes_lock = threading.RLock()
         self._closed = False
 
-        expert = config.expert
-        self.logdb = (
-            expert.logdb_factory(config) if expert.logdb_factory else InMemLogDB()
-        )
-        if expert.snapshot_storage_factory:
-            self.snapshot_storage = expert.snapshot_storage_factory(config)
-        else:
-            # snapshots are durable by default, rooted in the nodehost dir
-            # (reference: snapshot dirs under NodeHostDir [U])
-            import os
+        # exclusive dir lock + deployment-id check (reference:
+        # internal/server environment [U])
+        from .env import Env
 
-            self.snapshot_storage = FileSnapshotStorage(
-                os.path.join(config.nodehost_dir, "snapshots")
+        self._env = Env(config.nodehost_dir, config.deployment_id)
+
+        try:
+
+            expert = config.expert
+            self.logdb = (
+                expert.logdb_factory(config) if expert.logdb_factory else InMemLogDB()
             )
-        self.gossip: Optional[object] = None
-        if config.address_by_nodehost_id:
-            from .id import get_nodehost_id
-            from .transport.gossip import GossipManager, GossipRegistry
+            if expert.snapshot_storage_factory:
+                self.snapshot_storage = expert.snapshot_storage_factory(config)
+            else:
+                # snapshots are durable by default, rooted in the nodehost dir
+                # (reference: snapshot dirs under NodeHostDir [U])
+                import os
 
-            self.nodehost_id = get_nodehost_id(config.nodehost_dir)
-            self.gossip = GossipManager(
-                self.nodehost_id,
+                self.snapshot_storage = FileSnapshotStorage(
+                    os.path.join(config.nodehost_dir, "snapshots")
+                )
+            self.gossip: Optional[object] = None
+            if config.address_by_nodehost_id:
+                from .id import get_nodehost_id
+                from .transport.gossip import GossipManager, GossipRegistry
+
+                self.nodehost_id = get_nodehost_id(config.nodehost_dir)
+                self.gossip = GossipManager(
+                    self.nodehost_id,
+                    config.raft_address,
+                    config.gossip.bind_address,
+                    list(config.gossip.seed),
+                    advertise_address=config.gossip.advertise_address,
+                )
+                self.gossip.start()
+                self.registry = GossipRegistry(self.gossip)
+            else:
+                self.registry = Registry()
+            self.events = EventFanout(
+                config.raft_event_listener, config.system_event_listener
+            )
+
+            # received snapshots get a unique suffix: re-streams of the same
+            # index must never clobber a file a queued recover task still wants
+            self._rx_snapshot_seq = itertools.count(1)
+            self._chunk_sink = ChunkSink(
+                save_fn=lambda s, r, i, p: self.snapshot_storage.save(
+                    s, r, i, p, suffix=f"rx{next(self._rx_snapshot_seq)}"
+                ),
+                deliver_fn=self._deliver_received_snapshot,
+                confirm_fn=self._confirm_received_snapshot,
+            )
+            raw_transport = (
+                expert.transport_factory(
+                    config, self._handle_message_batch, self._chunk_sink.add
+                )
+                if expert.transport_factory
+                else InProcTransport(
+                    config.raft_address,
+                    self._handle_message_batch,
+                    self._chunk_sink.add,
+                )
+            )
+            self.transport = Transport(
+                raw_transport,
+                self.registry.resolve,
                 config.raft_address,
-                config.gossip.bind_address,
-                list(config.gossip.seed),
-                advertise_address=config.gossip.advertise_address,
+                config.deployment_id,
+                unreachable_cb=self._report_unreachable,
+                snapshot_payload_loader=self._load_snapshot_payload,
+                snapshot_status_cb=self._report_snapshot_status,
             )
-            self.gossip.start()
-            self.registry = GossipRegistry(self.gossip)
-        else:
-            self.registry = Registry()
-        self.events = EventFanout(
-            config.raft_event_listener, config.system_event_listener
-        )
+            self.transport.start()
 
-        # received snapshots get a unique suffix: re-streams of the same
-        # index must never clobber a file a queued recover task still wants
-        self._rx_snapshot_seq = itertools.count(1)
-        self._chunk_sink = ChunkSink(
-            save_fn=lambda s, r, i, p: self.snapshot_storage.save(
-                s, r, i, p, suffix=f"rx{next(self._rx_snapshot_seq)}"
-            ),
-            deliver_fn=self._deliver_received_snapshot,
-            confirm_fn=self._confirm_received_snapshot,
-        )
-        raw_transport = (
-            expert.transport_factory(
-                config, self._handle_message_batch, self._chunk_sink.add
+            self.metrics = MetricsRegistry(enabled=config.enable_metrics)
+            self.metrics.gauge(
+                "raft_nodehost_shards", lambda: len(self._nodes)
             )
-            if expert.transport_factory
-            else InProcTransport(
-                config.raft_address,
-                self._handle_message_batch,
-                self._chunk_sink.add,
+            self.metrics.gauge(
+                "raft_transport_sent_total", lambda: self.transport.metrics["sent"]
             )
-        )
-        self.transport = Transport(
-            raw_transport,
-            self.registry.resolve,
-            config.raft_address,
-            config.deployment_id,
-            unreachable_cb=self._report_unreachable,
-            snapshot_payload_loader=self._load_snapshot_payload,
-            snapshot_status_cb=self._report_snapshot_status,
-        )
-        self.transport.start()
+            self.metrics.gauge(
+                "raft_transport_dropped_total",
+                lambda: self.transport.metrics["dropped"],
+            )
+            self.metrics.gauge(
+                "raft_transport_failed_total",
+                lambda: self.transport.metrics["failed"],
+            )
 
-        self.metrics = MetricsRegistry(enabled=config.enable_metrics)
-        self.metrics.gauge(
-            "raft_nodehost_shards", lambda: len(self._nodes)
-        )
-        self.metrics.gauge(
-            "raft_transport_sent_total", lambda: self.transport.metrics["sent"]
-        )
-        self.metrics.gauge(
-            "raft_transport_dropped_total",
-            lambda: self.transport.metrics["dropped"],
-        )
-        self.metrics.gauge(
-            "raft_transport_failed_total",
-            lambda: self.transport.metrics["failed"],
-        )
+            step_engine = (
+                expert.step_engine_factory(self) if expert.step_engine_factory else None
+            )
+            self.engine = ExecEngine(
+                self.logdb,
+                step_workers=expert.engine.exec_shards,
+                apply_workers=expert.engine.apply_shards,
+                step_engine=step_engine,
+                metrics=self.metrics,
+            )
+            self.engine.start()
 
-        step_engine = (
-            expert.step_engine_factory(self) if expert.step_engine_factory else None
-        )
-        self.engine = ExecEngine(
-            self.logdb,
-            step_workers=expert.engine.exec_shards,
-            apply_workers=expert.engine.apply_shards,
-            step_engine=step_engine,
-            metrics=self.metrics,
-        )
-        self.engine.start()
-
-        self._ticker_stop = threading.Event()
-        self._ticker = threading.Thread(
-            target=self._ticker_main, daemon=True, name="tpu-raft-ticker"
-        )
-        self._ticker.start()
+            self._ticker_stop = threading.Event()
+            self._ticker = threading.Thread(
+                target=self._ticker_main, daemon=True, name="tpu-raft-ticker"
+            )
+            self._ticker.start()
+        except Exception:
+            # never leak the dir flock on a failed construction:
+            # an in-process retry would hit DirLockedError forever
+            self._env.close()
+            raise
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -213,6 +226,9 @@ class NodeHost:
         self.transport.close()
         self.logdb.close()
         self.events.close()
+        # release the dir flock LAST: another process may acquire the dir
+        # the moment this unlocks, and the WAL must be closed by then
+        self._env.close()
 
     def _ticker_main(self) -> None:
         period = self.config.rtt_millisecond / 1000.0

@@ -1,0 +1,66 @@
+"""NodeHost dir environment tests: flock + deployment id.
+
+reference: internal/server/environment_test.go patterns [U].
+"""
+import pytest
+
+from dragonboat_tpu.env import DeploymentIDMismatch, DirLockedError, Env
+
+
+class TestEnv:
+    def test_exclusive_lock(self, tmp_path):
+        d = str(tmp_path)
+        a = Env(d)
+        with pytest.raises(DirLockedError):
+            Env(d)
+        a.close()
+        b = Env(d)  # released lock can be retaken
+        b.close()
+
+    def test_deployment_id_persisted(self, tmp_path):
+        d = str(tmp_path)
+        Env(d, deployment_id=7).close()
+        Env(d, deployment_id=7).close()  # same id reopens
+        with pytest.raises(DeploymentIDMismatch):
+            Env(d, deployment_id=8)
+
+    def test_mismatch_releases_lock(self, tmp_path):
+        d = str(tmp_path)
+        Env(d, deployment_id=1).close()
+        with pytest.raises(DeploymentIDMismatch):
+            Env(d, deployment_id=2)
+        # the failed open must not leave the dir locked
+        Env(d, deployment_id=1).close()
+
+
+    def test_corrupt_deployment_file(self, tmp_path):
+        d = str(tmp_path)
+        with open(f"{d}/DEPLOYMENT.ID", "w") as f:
+            f.write("garbage!!")
+        with pytest.raises(DeploymentIDMismatch):
+            Env(d)
+        # and the lock is not leaked
+        with open(f"{d}/DEPLOYMENT.ID", "w") as f:
+            f.write("0")
+        Env(d).close()
+
+    def test_failed_nodehost_init_releases_lock(self, tmp_path):
+        from dragonboat_tpu import NodeHost, NodeHostConfig, ExpertConfig
+
+        def bad_factory(config):
+            raise OSError("boom")
+
+        cfg = NodeHostConfig(
+            nodehost_dir=str(tmp_path), rtt_millisecond=50,
+            raft_address="env-x",
+            expert=ExpertConfig(logdb_factory=bad_factory),
+        )
+        with pytest.raises(OSError):
+            NodeHost(cfg)
+        # retry in the same process must not hit DirLockedError
+        cfg2 = NodeHostConfig(
+            nodehost_dir=str(tmp_path), rtt_millisecond=50,
+            raft_address="env-x",
+        )
+        nh = NodeHost(cfg2)
+        nh.close()
