@@ -58,6 +58,14 @@ class Config:
             )
         if self.max_in_mem_log_size != 0 and self.max_in_mem_log_size < 65536:
             raise ConfigError("max_in_mem_log_size must be >= 64KiB or 0")
+        from .pb import CompressionType
+
+        try:
+            CompressionType(self.snapshot_compression)
+        except ValueError:
+            raise ConfigError(
+                f"invalid snapshot_compression {self.snapshot_compression}"
+            )
         if self.is_witness and self.snapshot_entries > 0:
             raise ConfigError("witness can not take snapshots")
         if self.is_witness and self.is_non_voting:

@@ -179,7 +179,9 @@ class Node:
         membership: Optional[Membership] = None
         if not ss.is_empty():
             if not ss.dummy and not config.is_witness:
-                payload = snapshot_storage.load(ss.filepath)
+                payload = self.decompress_snapshot(
+                    ss, snapshot_storage.load(ss.filepath)
+                )
                 self.sm.recover_from_snapshot_data(payload)
             else:
                 self.sm.last_applied = max(self.sm.last_applied, ss.index)
@@ -550,6 +552,18 @@ class Node:
             )
             self.stopped = True
             raise
+        try:
+            payload = self.decompress_snapshot(ss, payload)
+        except Exception as e:  # noqa: BLE001 — same contract as load failure
+            _log.critical(
+                "[%d:%d] FATAL: snapshot %d undecodable (%s); halting replica",
+                self.shard_id,
+                self.replica_id,
+                ss.index,
+                e,
+            )
+            self.stopped = True
+            raise
         self.sm.recover_from_snapshot_data(payload)
         self._sync_registry(ss.membership)
         if self.events is not None:
@@ -562,6 +576,41 @@ class Node:
     # ------------------------------------------------------------------
     # snapshotting (step-worker context for now; dedicated workers later)
     # ------------------------------------------------------------------
+    def _compress_snapshot(self, payload: bytes):
+        """-> (bytes, CompressionType actually used).  reference: the
+        SnapshotCompression config + snappy option in snapshotio [U]."""
+        from .pb import CompressionType as CT
+
+        want = CT(self.config.snapshot_compression)
+        if want == CT.NO_COMPRESSION:
+            return payload, CT.NO_COMPRESSION
+        if want == CT.SNAPPY:
+            try:
+                import snappy  # type: ignore
+
+                return snappy.compress(payload), CT.SNAPPY
+            except ImportError:
+                pass  # record what we actually used below
+        import zlib
+
+        return zlib.compress(payload, 6), CT.ZLIB
+
+    @staticmethod
+    def decompress_snapshot(ss: Snapshot, payload: bytes) -> bytes:
+        """Inverse of _compress_snapshot, keyed by the recorded type."""
+        from .pb import CompressionType as CT
+
+        ct = CT(ss.compression)
+        if ct == CT.NO_COMPRESSION:
+            return payload
+        if ct == CT.SNAPPY:
+            import snappy  # type: ignore
+
+            return snappy.decompress(payload)
+        import zlib
+
+        return zlib.decompress(payload)
+
     def _save_snapshot_request(self, key: int, overhead: int) -> None:
         """Save a snapshot of the current applied state and compact the log
         (reference: rsm.SaveSnapshot + snapshotter [U])."""
@@ -588,6 +637,7 @@ class Node:
                 if key:
                     self.pending_snapshot.done(key, 0, failed=True)
                 return
+            payload, compression = self._compress_snapshot(payload)
             filepath = self.snapshot_storage.save(
                 self.shard_id, self.replica_id, index, payload
             )
@@ -599,6 +649,7 @@ class Node:
                 membership=self.sm.get_membership(),
                 shard_id=self.shard_id,
                 replica_id=self.replica_id,
+                compression=compression,
             )
             u = Update(
                 shard_id=self.shard_id, replica_id=self.replica_id, snapshot=ss

@@ -196,8 +196,15 @@ class NodeHost:
             )
             self._ticker.start()
         except Exception:
-            # never leak the dir flock on a failed construction:
-            # an in-process retry would hit DirLockedError forever
+            # release everything already started — a same-process retry
+            # must not hit DirLockedError, EADDRINUSE or orphan threads
+            for closer in ("engine", "transport", "gossip", "logdb"):
+                obj = getattr(self, closer, None)
+                if obj is not None:
+                    try:
+                        obj.stop() if closer == "engine" else obj.close()
+                    except Exception:  # noqa: BLE001
+                        pass
             self._env.close()
             raise
 

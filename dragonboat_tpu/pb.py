@@ -80,6 +80,7 @@ class ConfigChangeType(enum.IntEnum):
 class CompressionType(enum.IntEnum):
     NO_COMPRESSION = 0
     SNAPPY = 1
+    ZLIB = 2  # the built-in codec (snappy needs the optional module)
 
 
 NO_LEADER = 0

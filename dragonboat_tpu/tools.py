@@ -88,6 +88,7 @@ def import_snapshot(
         shard_id=shard_id,
         replica_id=replica_id,
         imported=True,
+        compression=meta.compression,
     )
     nodehost.logdb.import_snapshot(ss, replica_id)
     return ss
