@@ -39,6 +39,7 @@ from .request import (
     PendingReadIndex,
     PendingSnapshot,
     RequestState,
+    SystemBusy,
 )
 from .rsm.managed import wrap_state_machine
 from .rsm.statemachine import ApplyResult, StateMachine, Task, TaskType
@@ -218,6 +219,12 @@ class Node:
     def propose(
         self, session: Session, cmd: bytes, timeout_ticks: int
     ) -> RequestState:
+        if self.peer.raft.rate_limited():
+            # MaxInMemLogSize exceeded: refuse new load until the window
+            # drains (reference: ErrSystemBusy on rate limit [U]).
+            # Reading inmem.bytes from the API thread is a benign race —
+            # it only shifts WHEN the busy signal flips.
+            raise SystemBusy("in-memory log over MaxInMemLogSize")
         entry, rs = self.pending_proposal.propose(
             session, cmd, self.tick_count + timeout_ticks
         )

@@ -159,6 +159,9 @@ class NodeHost:
                 unreachable_cb=self._report_unreachable,
                 snapshot_payload_loader=self._load_snapshot_payload,
                 snapshot_status_cb=self._report_snapshot_status,
+                max_snapshot_send_bytes_per_second=(
+                    config.max_snapshot_send_bytes_per_second
+                ),
             )
             self.transport.start()
 

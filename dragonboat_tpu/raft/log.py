@@ -150,6 +150,9 @@ class InMemory:
         self.entries: List[Entry] = []
         self.saved_to = last_saved_index
         self.snapshot: Snapshot = EMPTY_SNAPSHOT  # pending restore
+        # byte size of the window — the MaxInMemLogSize rate-limit input
+        # (reference: internal/server/rate.go InMemRateLimiter [U])
+        self.bytes = 0
 
     def get_snapshot_index(self) -> Optional[int]:
         return None if self.snapshot.is_empty() else self.snapshot.index
@@ -178,22 +181,29 @@ class InMemory:
     def merge(self, entries: Sequence[Entry]) -> None:
         if not entries:
             return
+        added = sum(e.size_bytes() for e in entries)
         first_new = entries[0].index
         last_cur = self.marker + len(self.entries) - 1
         if first_new == last_cur + 1:
             self.entries = self.entries + list(entries)
+            self.bytes += added
         elif first_new <= self.marker:
             self.marker = first_new
             self.entries = list(entries)
+            self.bytes = added
             self.saved_to = min(self.saved_to, first_new - 1)
         else:
-            self.entries = self.entries[: first_new - self.marker] + list(entries)
+            keep = first_new - self.marker
+            self.bytes -= sum(e.size_bytes() for e in self.entries[keep:])
+            self.entries = self.entries[:keep] + list(entries)
+            self.bytes += added
             self.saved_to = min(self.saved_to, first_new - 1)
 
     def restore(self, ss: Snapshot) -> None:
         self.snapshot = ss
         self.marker = ss.index + 1
         self.entries = []
+        self.bytes = 0
         self.saved_to = ss.index
 
     def entries_to_save(self) -> List[Entry]:
@@ -218,6 +228,8 @@ class InMemory:
             return
         last = self.marker + len(self.entries) - 1
         keep_from = min(keep_from, last + 1)
+        dropped = self.entries[: keep_from - self.marker]
+        self.bytes -= sum(e.size_bytes() for e in dropped)
         self.entries = self.entries[keep_from - self.marker :]
         self.marker = keep_from
 

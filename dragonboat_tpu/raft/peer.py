@@ -63,6 +63,7 @@ class Peer:
             state=state,
             is_non_voting=config.is_non_voting,
             is_witness=config.is_witness,
+            max_in_mem_log_size=config.max_in_mem_log_size,
         )
         return cls(r)
 

@@ -90,6 +90,7 @@ class Raft:
         is_non_voting: bool = False,
         is_witness: bool = False,
         max_entries_per_replicate: Optional[int] = None,
+        max_in_mem_log_size: int = 0,
     ):
         from .log import InMemLogReader
 
@@ -105,6 +106,7 @@ class Raft:
             else settings.Soft.max_entries_per_replicate
         )
         self.max_replicate_bytes = settings.Soft.max_replicate_bytes
+        self.max_in_mem_log_size = max_in_mem_log_size
 
         self.term = 0
         self.vote = NO_NODE
@@ -214,6 +216,15 @@ class Raft:
         if r is None:
             r = self.witnesses.get(replica_id)
         return r
+
+    def rate_limited(self) -> bool:
+        """In-mem log window above MaxInMemLogSize: new proposals should
+        be refused with SystemBusy until apply/persist drains the window
+        (reference: rate limiter + ErrSystemBusy [U])."""
+        return (
+            self.max_in_mem_log_size > 0
+            and self.log.inmem.bytes > self.max_in_mem_log_size
+        )
 
     def raft_state(self) -> State:
         return State(term=self.term, vote=self.vote, commit=self.log.committed)

@@ -67,6 +67,7 @@ class Transport:
         unreachable_cb: Optional[Callable[[Message], None]] = None,
         snapshot_payload_loader: Optional[Callable[[object], bytes]] = None,
         snapshot_status_cb: Optional[Callable[[int, int, bool], None]] = None,
+        max_snapshot_send_bytes_per_second: int = 0,
     ):
         self.raw = raw
         self.resolver = resolver
@@ -78,6 +79,7 @@ class Transport:
         self.snapshot_payload_loader = snapshot_payload_loader
         # (shard_id, to_replica, failed) -> report to the sending raft peer
         self.snapshot_status_cb = snapshot_status_cb
+        self.max_snapshot_send_rate = max_snapshot_send_bytes_per_second
         self._stream_jobs = 0
         self._stream_lock = threading.Lock()
         self._queues: Dict[str, _SendQueue] = {}
@@ -226,10 +228,33 @@ class Transport:
         try:
             conn = self.raw.get_snapshot_connection(target)
             try:
-                for c in chunks:
+                # token pacing against MaxSnapshotSendBytesPerSecond
+                # (reference: snapshot bandwidth limits [U]).  The window
+                # resets every second so a network stall never banks
+                # unbounded burst credit, the final chunk is not followed
+                # by a sleep, and sleeps are sliced so close() interrupts
+                # promptly.
+                rate = self.max_snapshot_send_rate
+                window_start = time.monotonic()
+                sent_in_window = 0
+                chunk_list = list(chunks)
+                for k, c in enumerate(chunk_list):
                     if self._stopped:
                         raise ConnectionError("transport stopped")
                     conn.send_chunk(c)
+                    if rate <= 0 or k == len(chunk_list) - 1:
+                        continue
+                    sent_in_window += len(c.data)
+                    while not self._stopped:
+                        now = time.monotonic()
+                        if now - window_start >= 1.0:
+                            window_start = now
+                            sent_in_window = 0
+                            break
+                        owed = sent_in_window / rate - (now - window_start)
+                        if owed <= 0:
+                            break
+                        time.sleep(min(owed, 0.1))
             finally:
                 conn.close()
             self.metrics["snapshots_sent"] = self.metrics.get("snapshots_sent", 0) + 1

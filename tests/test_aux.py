@@ -408,3 +408,105 @@ class TestSnapshotCompression:
             assert nh2.stale_read(1, "z-19") == b"A" * 2000
         finally:
             nh2.close()
+
+
+# ---------------------------------------------------------------------------
+# rate limiting
+# ---------------------------------------------------------------------------
+class TestRateLimits:
+    def test_max_in_mem_log_size_system_busy(self):
+        """Proposals are refused with SystemBusy while the in-mem log
+        window exceeds MaxInMemLogSize (reference: ErrSystemBusy [U])."""
+        from dragonboat_tpu import SystemBusy
+        from dragonboat_tpu.raft.raft import Raft
+        from dragonboat_tpu.pb import Entry, Message, MessageType
+
+        r = Raft(
+            shard_id=1, replica_id=1, peers={1: "a", 2: "b", 3: "c"},
+            max_in_mem_log_size=65536,
+        )
+        assert not r.rate_limited()
+        # stuff the in-mem window way past the limit
+        big = [
+            Entry(term=1, index=i, cmd=b"x" * 8192) for i in range(1, 20)
+        ]
+        r.log.inmem.merge(big)
+        assert r.rate_limited()
+        # draining (persist + apply) clears the signal
+        r.log.inmem.saved_log_to(19, 1)
+        r.log.inmem.applied_log_to(19)
+        assert not r.rate_limited()
+
+    def test_nodehost_propose_system_busy(self):
+        from dragonboat_tpu import SystemBusy
+        from dragonboat_tpu.pb import Entry
+
+        reset_inproc_network()
+        for rid in ADDRS:
+            shutil.rmtree(f"/tmp/nh-{rid}", ignore_errors=True)
+        nhs = {rid: make_nodehost(rid) for rid in ADDRS}
+        try:
+            for rid, nh in nhs.items():
+                cfg = shard_config(rid)
+                cfg.max_in_mem_log_size = 65536
+                nh.start_replica(ADDRS, False, KVStore, cfg)
+            wait_for_leader(nhs)
+            node = nhs[1]._nodes[1]
+            # force the window over the limit from the outside
+            node.peer.raft.log.inmem.merge(
+                [Entry(term=1, index=node.peer.raft.log.last_index() + 1,
+                       cmd=b"x" * 100000)]
+            )
+            s = nhs[1].get_noop_session(1)
+            with pytest.raises(SystemBusy):
+                nhs[1].sync_propose(s, set_cmd("k", b"v"), timeout=1.0)
+        finally:
+            for nh in nhs.values():
+                nh.close()
+
+    def test_snapshot_send_rate_cap(self):
+        """The chunk stream is paced to MaxSnapshotSendBytesPerSecond."""
+        import time as _t
+
+        from dragonboat_tpu.pb import Chunk, Message, MessageType, Snapshot
+        from dragonboat_tpu.transport.transport import Transport
+        from dragonboat_tpu.transport.inproc import InProcTransport
+
+        reset_inproc_network()
+        got = []
+        rx = InProcTransport("rate-rx", lambda b: None, lambda c: got.append(c) or True)
+        rx.start()
+        tx_raw = InProcTransport("rate-tx", lambda b: None, None)
+        # shrink chunks so the stream spans several pacing rounds
+        from dragonboat_tpu import settings as _settings
+
+        old_chunk = _settings.Soft.snapshot_chunk_size
+        _settings.Soft.snapshot_chunk_size = 8192
+        payload = b"z" * 40000
+        tx = Transport(
+            tx_raw,
+            lambda s, r: "rate-rx",
+            "rate-tx",
+            snapshot_payload_loader=lambda ss: payload,
+            max_snapshot_send_bytes_per_second=80000,  # ~0.5s for 40KB
+        )
+        tx.start()
+        try:
+            ss = Snapshot(filepath="/x", file_size=len(payload), index=5,
+                          term=1, shard_id=1, replica_id=2)
+            m = Message(type=MessageType.INSTALL_SNAPSHOT, to=2, from_=1,
+                        shard_id=1, term=1, snapshot=ss)
+            t0 = _t.monotonic()
+            assert tx.send_snapshot(m)
+            deadline = _t.monotonic() + 5
+            while _t.monotonic() < deadline and (
+                not got or sum(len(c.data) for c in got) < len(payload)
+            ):
+                _t.sleep(0.01)
+            dt = _t.monotonic() - t0
+            assert sum(len(c.data) for c in got) >= len(payload)
+            assert dt >= 0.3, f"stream not paced: {dt:.2f}s"
+        finally:
+            _settings.Soft.snapshot_chunk_size = old_chunk
+            tx.close()
+            rx.close()
