@@ -348,10 +348,12 @@ class Node:
             else:
                 self.peer.reject_config_change()
 
-        # activity-based quiesce exit
+        # activity-based quiesce exit / peer enter-hints
         if self.quiesce.enabled:
             for m in received:
-                if self.quiesce.record_activity(m.type):
+                if m.type == MessageType.QUIESCE:
+                    self.quiesce.quiesce_hint()
+                elif self.quiesce.record_activity(m.type):
                     self._poke_peers_out_of_quiesce()
             if proposals or read_indexes or config_changes or transfers:
                 if self.quiesce.record_activity(MessageType.PROPOSE):
@@ -382,7 +384,10 @@ class Node:
 
         for _ in range(ticks):
             self.tick_count += 1
+            was_quiesced = self.quiesce.quiesced
             if self.quiesce.tick():
+                if not was_quiesced:  # newly entered: drag peers along
+                    self.broadcast_quiesce_enter()
                 self.peer.quiesced_tick()
             else:
                 self.peer.tick()
@@ -436,8 +441,27 @@ class Node:
                     self.registry.add(self.shard_id, pid, addr)
 
     def _poke_peers_out_of_quiesce(self) -> None:
+        # only the leader needs to poke (resume heartbeats, which reset
+        # follower election timers); a woken follower's real traffic
+        # (forwarded proposal, vote, replicate) wakes peers by itself
         if self.peer.is_leader():
             self.peer.raft.handle(Message(type=MessageType.LEADER_HEARTBEAT))
+
+    def broadcast_quiesce_enter(self) -> None:
+        """Announce entering quiesce so peers join promptly (reference:
+        pb.Quiesce [U]) — staggered entry would leave the leader
+        heartbeating at already-quiesced followers."""
+        for pid in sorted(self.peer.raft.addresses):
+            if pid == self.replica_id:
+                continue
+            self.transport.send(
+                Message(
+                    type=MessageType.QUIESCE,
+                    to=pid,
+                    from_=self.replica_id,
+                    shard_id=self.shard_id,
+                )
+            )
 
     def _check_leader_change(self) -> None:
         lid = self.peer.leader_id()

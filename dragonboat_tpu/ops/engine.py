@@ -277,9 +277,13 @@ class VectorStepEngine(IStepEngine):
 
         Slot order mirrors the scalar replay order in
         ``Node.step_with_inputs``: received messages, proposals, ticks.
+
+        Quiesce (reference: quiesceManager [U]) runs host-side even for
+        device rows: quiesced ticks simply produce no TICK slots, so an
+        idle shard's device row is never touched — the TPU equivalent of
+        "millions of idle groups cost nothing".  Exiting quiesce needs
+        the scalar poke path (LEADER_HEARTBEAT), so that step goes host.
         """
-        if node.quiesce.enabled:
-            return None
         if (
             si.config_changes
             or si.cc_results
@@ -287,6 +291,13 @@ class VectorStepEngine(IStepEngine):
             or si.transfers
             or si.read_indexes
         ):
+            return None
+        if node.quiesce.enabled and node.quiesce.is_quiesced() and (
+            si.received or si.proposals
+        ):
+            # activity exits quiesce; peers must be poked — scalar path
+            # (quiesce state deliberately untouched: step_with_inputs
+            # re-processes these inputs and performs the exit + poke)
             return None
         r = node.peer.raft
         if len(r.addresses) > self.P:
@@ -327,9 +338,29 @@ class VectorStepEngine(IStepEngine):
         props = si.proposals
         for i in range(0, len(props), E):
             slots.append(("prop", props[i : i + E]))
-        slots.extend(("tick", None) for _ in range(si.ticks))
-        if len(slots) > self.M:
+        # conservative capacity check BEFORE consuming quiesce state so a
+        # host fallback never double-processes ticks/activity
+        if len(slots) + si.ticks > self.M:
             return None
+        ticks = si.ticks
+        if node.quiesce.enabled:
+            # committed to the device path now: record (non-exiting)
+            # activity and swallow quiesced ticks — a quiesced row gets
+            # no TICK slots, so its device state is never touched.
+            # (QUIESCE enter-hints are a cold type and never reach here.)
+            for m in si.received:
+                node.quiesce.record_activity(m.type)
+            if si.proposals:
+                node.quiesce.record_activity(MessageType.PROPOSE)
+            ticks = 0
+            for _ in range(si.ticks):
+                was_quiesced = node.quiesce.quiesced
+                if node.quiesce.tick():
+                    if not was_quiesced:
+                        node.broadcast_quiesce_enter()
+                else:
+                    ticks += 1
+        slots.extend(("tick", None) for _ in range(ticks))
         return slots
 
     # ------------------------------------------------------------------
@@ -551,6 +582,10 @@ class VectorStepEngine(IStepEngine):
             self._materialize_rows([g for _, g, _ in esc_rows], old_state)
             for node, g, si in esc_rows:
                 self._meta[g].dirty = True
+                # quiesce note: _plan_device already consumed this step's
+                # quiesce ticks; the replay re-ticks the manager, which can
+                # only make the shard quiesce EARLIER — benign for a perf
+                # heuristic that exits on any activity
                 u = node.step_with_inputs(si)
                 if u is not None:
                     updates.append((node, u))

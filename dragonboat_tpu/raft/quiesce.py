@@ -40,16 +40,36 @@ class QuiesceManager:
         if not self.enabled:
             return False
         if msg_type in (MessageType.HEARTBEAT, MessageType.HEARTBEAT_RESP):
-            # heartbeats are not "activity": an idle-but-led group must
-            # still be able to quiesce (reference: quiesceManager [U])
-            if not self.quiesced:
-                return False
+            # heartbeats are NEVER "activity" — neither to stay awake
+            # (an idle-but-led group must quiesce) nor to wake up (a
+            # stale in-flight heartbeat from a not-yet-quiesced leader
+            # must not wake a just-quiesced follower: that churns the
+            # shard through wake/election cycles forever).  A quiesced
+            # node still processes heartbeats in raft; quiesce only
+            # gates its timers.
+            return False
         was = self.quiesced
         self.idle_ticks = 0
         if self.quiesced:
             self.quiesced = False
             self.exit_grace = self.threshold
         return was
+
+    def quiesce_hint(self) -> None:
+        """A peer announced it is entering quiesce (pb.Quiesce [U]): join
+        it if this node is also idle, so the whole shard goes silent
+        together (the leader stops heartbeating promptly)."""
+        if not self.enabled or self.quiesced:
+            return
+        if self.exit_grace > 0:
+            # recently woken by activity the hint sender didn't see;
+            # entering now would flag quiesced while tick() still runs
+            # live timers for the rest of the grace window — a
+            # half-quiesced node whose election can fire into a silent
+            # shard
+            return
+        if self.idle_ticks >= self.threshold // 2:
+            self.quiesced = True
 
     def new_to_quiesce(self) -> bool:
         return (

@@ -228,15 +228,17 @@ class Transport:
         try:
             conn = self.raw.get_snapshot_connection(target)
             try:
-                # token pacing against MaxSnapshotSendBytesPerSecond
-                # (reference: snapshot bandwidth limits [U]).  The window
-                # resets every second so a network stall never banks
-                # unbounded burst credit, the final chunk is not followed
-                # by a sleep, and sleeps are sliced so close() interrupts
-                # promptly.
+                # deficit pacing against MaxSnapshotSendBytesPerSecond
+                # (reference: snapshot bandwidth limits [U]).  Each sent
+                # chunk adds its size to a byte deficit that drains at
+                # `rate`; the next chunk waits until the deficit clears.
+                # Debt is never forgiven (chunks larger than one second
+                # of budget still average out correctly) and idle time
+                # banks no burst credit.  Sleeps are sliced so close()
+                # interrupts promptly; the final chunk pays no sleep.
                 rate = self.max_snapshot_send_rate
-                window_start = time.monotonic()
-                sent_in_window = 0
+                deficit = 0.0
+                last = time.monotonic()
                 chunk_list = list(chunks)
                 for k, c in enumerate(chunk_list):
                     if self._stopped:
@@ -244,17 +246,15 @@ class Transport:
                     conn.send_chunk(c)
                     if rate <= 0 or k == len(chunk_list) - 1:
                         continue
-                    sent_in_window += len(c.data)
-                    while not self._stopped:
+                    now = time.monotonic()
+                    deficit = max(0.0, deficit - (now - last) * rate)
+                    last = now
+                    deficit += len(c.data)
+                    while deficit > 0 and not self._stopped:
+                        time.sleep(min(deficit / rate, 0.1))
                         now = time.monotonic()
-                        if now - window_start >= 1.0:
-                            window_start = now
-                            sent_in_window = 0
-                            break
-                        owed = sent_in_window / rate - (now - window_start)
-                        if owed <= 0:
-                            break
-                        time.sleep(min(owed, 0.1))
+                        deficit = max(0.0, deficit - (now - last) * rate)
+                        last = now
             finally:
                 conn.close()
             self.metrics["snapshots_sent"] = self.metrics.get("snapshots_sent", 0) + 1

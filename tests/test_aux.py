@@ -510,3 +510,25 @@ class TestRateLimits:
             _settings.Soft.snapshot_chunk_size = old_chunk
             tx.close()
             rx.close()
+
+    def test_quiesce_hint_respects_exit_grace(self):
+        """A node inside its exit-grace window must not adopt a peer's
+        enter-hint (a half-quiesced node runs live timers while flagged
+        quiesced — review finding)."""
+        from dragonboat_tpu.pb import MessageType
+        from dragonboat_tpu.raft.quiesce import QuiesceManager
+
+        q = QuiesceManager(enabled=True, election_timeout=10)  # threshold 100
+        for _ in range(100):
+            q.tick()
+        assert q.is_quiesced()
+        q.record_activity(MessageType.PROPOSE)  # wake: grace = 100
+        assert not q.is_quiesced() and q.exit_grace > 0
+        for _ in range(60):
+            q.tick()  # idle_ticks back over threshold//2, grace remains
+        q.quiesce_hint()
+        assert not q.is_quiesced()  # hint refused during grace
+        for _ in range(60):
+            q.tick()  # grace drains
+        q.quiesce_hint()
+        assert q.is_quiesced()  # now the hint is honored

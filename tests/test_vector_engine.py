@@ -201,3 +201,48 @@ class TestVectorCluster:
             time.sleep(0.05)
         else:
             raise AssertionError("restarted replica never caught up")
+
+
+class TestVectorQuiesce:
+    def test_idle_shard_quiesces_on_device(self):
+        """Quiesce-enabled rows stay device-resident: after the idle
+        threshold the shard exchanges no messages (no TICK slots are
+        encoded), and any proposal wakes it back up."""
+        import dragonboat_tpu
+
+        reset_inproc_network()
+        for rid in ADDRS:
+            shutil.rmtree(f"/tmp/nh-vec-{rid}", ignore_errors=True)
+        nhs = {rid: make_vector_nodehost(rid) for rid in ADDRS}
+        try:
+            for rid, nh in nhs.items():
+                cfg = vec_shard_config(rid)
+                cfg.quiesce = True
+                nh.start_replica(ADDRS, False, KVStore, cfg)
+            wait_for_leader(nhs)
+            s = nhs[1].get_noop_session(1)
+            propose_r(nhs[1], s, set_cmd("q0", b"v"))
+            # idle threshold = election_rtt * 10 = 200 ticks (~1s)
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                if all(
+                    nh._nodes[1].quiesce.is_quiesced() for nh in nhs.values()
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"never quiesced: {[nh._nodes[1].quiesce.quiesced for nh in nhs.values()]}"
+                )
+            # traffic stops while quiesced
+            sent0 = {r: nh.transport.metrics["sent"] for r, nh in nhs.items()}
+            time.sleep(0.5)
+            sent1 = {r: nh.transport.metrics["sent"] for r, nh in nhs.items()}
+            assert sent0 == sent1, f"quiesced shard still chatting: {sent0} -> {sent1}"
+            # a proposal wakes the shard and commits
+            propose_r(nhs[2], s, set_cmd("q1", b"w"), deadline=15.0)
+            assert read_r(nhs[3], 1, "q1") == b"w"
+            assert not nhs[1]._nodes[1].quiesce.is_quiesced()
+        finally:
+            for nh in nhs.values():
+                nh.close()
