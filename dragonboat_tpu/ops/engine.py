@@ -251,6 +251,19 @@ class VectorStepEngine(IStepEngine):
                 self._meta.pop(g, None)
                 self._free.append(g)
 
+    def _static_host_only(self, node) -> bool:
+        """Shards that can never (currently) be device-resident — checked
+        BEFORE attaching a row or consuming quiesce state."""
+        r = node.peer.raft
+        if len(r.addresses) > self.P:
+            return True
+        if r.is_self_removed():
+            # mid-join (empty membership) or removed: the kernel derives
+            # the replica's tier from its own peer slot, which doesn't
+            # exist yet/anymore — scalar path until membership settles
+            return True
+        return False
+
     def _attach(self, node) -> Optional[int]:
         g = self._row_of.get(node.shard_id)
         if g is not None:
@@ -300,13 +313,6 @@ class VectorStepEngine(IStepEngine):
             # re-processes these inputs and performs the exit + poke)
             return None
         r = node.peer.raft
-        if len(r.addresses) > self.P:
-            return None
-        if r.is_self_removed():
-            # mid-join (empty membership) or removed: the kernel derives
-            # the replica's tier from its own peer slot, which doesn't
-            # exist yet/anymore — scalar path until membership settles
-            return None
         if r.read_index.pending or r.read_index.queue:
             return None
         if r.snapshotting:
@@ -464,13 +470,19 @@ class VectorStepEngine(IStepEngine):
                 if node.stopped:
                     continue
                 si = node.drain_step_inputs()
+                # row attachment must precede planning: _plan_device
+                # consumes quiesce ticks once committed to the device
+                # path, and a post-plan capacity fallback would make the
+                # host path re-process them
+                if self._static_host_only(node):
+                    host_rows.append((node, si))
+                    continue
+                g = self._attach(node)
+                if g is None:
+                    host_rows.append((node, si))
+                    continue
                 plan = self._plan_device(node, si)
-                g = (
-                    self._attach(node)
-                    if plan is not None
-                    else self._row_of.get(node.shard_id)
-                )
-                if plan is None or g is None:
+                if plan is None:
                     host_rows.append((node, si))
                     continue
                 if not plan and not self._meta[g].dirty:

@@ -297,3 +297,98 @@ class TestNonVoting:
         time.sleep(0.5)
         with pytest.raises(Exception):
             nhs[1].sync_propose(s, set_cmd("nv3", b"z"), timeout=1.5)
+
+
+# ---------------------------------------------------------------------------
+# concurrent state machine tier
+# ---------------------------------------------------------------------------
+from dragonboat_tpu import IConcurrentStateMachine
+
+
+class ConcurrentKV(IConcurrentStateMachine):
+    """Batched-update KV with PrepareSnapshot (lock-free tier)."""
+
+    def __init__(self, shard_id, replica_id):
+        self.data = {}
+        self.batches = 0
+        self.prepared = 0
+
+    def update(self, entries):
+        self.batches += 1
+        out = []
+        for e in entries:
+            op, k, v = pickle.loads(e.cmd)
+            if op == "set":
+                self.data[k] = v
+            out.append(
+                type(e)(index=e.index, cmd=e.cmd, result=Result(value=len(self.data)))
+            )
+        return out
+
+    def lookup(self, query):
+        return self.data.get(query)
+
+    def prepare_snapshot(self):
+        self.prepared += 1
+        return dict(self.data)  # cheap point-in-time capture
+
+    def save_snapshot(self, ctx, w, files, done):
+        w.write(pickle.dumps(ctx))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.data = pickle.loads(r.read())
+
+
+class TestConcurrentSM:
+    def test_batched_update_and_snapshot(self):
+        from dragonboat_tpu.transport.inproc import reset_inproc_network
+        from test_nodehost import ADDRS as NADDRS, make_nodehost, wait_for_leader
+
+        reset_inproc_network()
+        for rid in NADDRS:
+            shutil.rmtree(f"/tmp/nh-{rid}", ignore_errors=True)
+        nhs = {rid: make_nodehost(rid) for rid in NADDRS}
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(NADDRS, False, ConcurrentKV, od_config(rid))
+            wait_for_leader(nhs)
+            nh = nhs[1]
+            s = nh.get_noop_session(1)
+            from test_nodehost import propose_r, set_cmd
+
+            # cut the catch-up follower off FIRST: a replica restarted on
+            # a fresh logdb after acking entries is disk loss (outside
+            # raft's model); the snapshot path serves replicas that fell
+            # behind the compaction point
+            fid = 3
+            nhs[fid].close()
+            for i in range(25):
+                propose_r(nh, s, set_cmd(f"c-{i}", str(i).encode()))
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    if nhs[2].sync_read(1, "c-24", timeout=2.0) == b"24":
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            assert nhs[2].sync_read(1, "c-24", timeout=5.0) == b"24"
+            # snapshot uses PrepareSnapshot (concurrent path)
+            nh.sync_request_snapshot(1, compaction_overhead=1)
+            sm = nh._nodes[1].sm.managed.sm
+            assert sm.prepared >= 1
+            # catch-up from the snapshot still works: fresh follower
+            for i in range(3):
+                propose_r(nh, s, set_cmd(f"cp-{i}", b"v"))
+            nhf = make_nodehost(fid)
+            nhs[fid] = nhf
+            nhf.start_replica(NADDRS, False, ConcurrentKV, od_config(fid))
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if nhf.stale_read(1, "c-0") == b"0":
+                    break
+                time.sleep(0.05)
+            assert nhf.stale_read(1, "c-0") == b"0"
+        finally:
+            for h in nhs.values():
+                h.close()
