@@ -84,8 +84,10 @@ def _read_frame(sock) -> Optional[tuple]:
 
 
 class _TCPConnection(IConnection):
-    def __init__(self, sock):
+    def __init__(self, sock, owner: "TCPTransport", target: str):
         self._sock = sock
+        self._owner = owner
+        self._target = target
         self._lock = threading.Lock()
 
     def close(self) -> None:
@@ -95,13 +97,18 @@ class _TCPConnection(IConnection):
             pass
 
     def send_message_batch(self, batch: MessageBatch) -> None:
+        hook = self._owner.drop_hook
+        if hook is not None and hook(self._target, batch):
+            return  # chaos: silently dropped
         with self._lock:
             _write_frame(self._sock, KIND_BATCH, encode_batch(batch))
 
 
 class _TCPSnapshotConnection(ISnapshotConnection):
-    def __init__(self, sock):
+    def __init__(self, sock, owner: "TCPTransport", target: str):
         self._sock = sock
+        self._owner = owner
+        self._target = target
         self._lock = threading.Lock()
 
     def close(self) -> None:
@@ -111,6 +118,9 @@ class _TCPSnapshotConnection(ISnapshotConnection):
             pass
 
     def send_chunk(self, chunk: Chunk) -> None:
+        hook = self._owner.drop_hook
+        if hook is not None and hook(self._target, chunk):
+            return
         with self._lock:
             _write_frame(self._sock, KIND_CHUNK, encode_chunk(chunk))
 
@@ -139,6 +149,9 @@ class TCPTransport(ITransport):
         self._threads = []
         self._conn_lock = threading.Lock()
         self._inbound = set()
+        # chaos-injection hook, same contract as the in-proc transport:
+        # (target, batch_or_chunk) -> True to drop silently
+        self.drop_hook = None
 
     def name(self) -> str:
         return "tcp"
@@ -190,10 +203,10 @@ class TCPTransport(ITransport):
         return sock
 
     def get_connection(self, target: str) -> IConnection:
-        return _TCPConnection(self._connect(target))
+        return _TCPConnection(self._connect(target), self, target)
 
     def get_snapshot_connection(self, target: str) -> ISnapshotConnection:
-        return _TCPSnapshotConnection(self._connect(target))
+        return _TCPSnapshotConnection(self._connect(target), self, target)
 
     # -- inbound ---------------------------------------------------------
     def _accept_main(self) -> None:

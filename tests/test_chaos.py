@@ -49,15 +49,20 @@ def make_chaos_nodehost(replica_id):
 
 
 class Cluster:
+    ADDRS = ADDRS
+
     def __init__(self):
         reset_inproc_network()
-        for rid in ADDRS:
-            shutil.rmtree(f"/tmp/nh-chaos-{rid}", ignore_errors=True)
+        for rid in self.ADDRS:
+            shutil.rmtree(self._dir(rid), ignore_errors=True)
         self.nhs = {}
-        for rid in ADDRS:
+        for rid in self.ADDRS:
             self.start(rid)
         for rid, nh in self.nhs.items():
-            nh.start_replica(ADDRS, False, KVStore, shard_config(rid))
+            nh.start_replica(self.ADDRS, False, KVStore, shard_config(rid))
+
+    def _dir(self, rid):
+        return f"/tmp/nh-chaos-{rid}"
 
     def start(self, rid):
         self.nhs[rid] = make_chaos_nodehost(rid)
@@ -68,12 +73,12 @@ class Cluster:
 
     def restart(self, rid):
         self.start(rid)
-        self.nhs[rid].start_replica(ADDRS, False, KVStore, shard_config(rid))
+        self.nhs[rid].start_replica(self.ADDRS, False, KVStore, shard_config(rid))
 
     def partition(self, side_a):
         """Messages between side_a and the rest are dropped, both ways."""
         side_a = set(side_a)
-        addr_side = {ADDRS[r] for r in side_a}
+        addr_side = {self.ADDRS[r] for r in side_a}
 
         def mk_hook(my_rid):
             mine_in_a = my_rid in side_a
@@ -234,4 +239,86 @@ class TestChaos:
             # by a quorum; the new term's log wins)
             cluster.settle_and_check_agreement({})
         finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos over real TCP sockets + tan WAL (the config-5 transport stack)
+# ---------------------------------------------------------------------------
+from dragonboat_tpu.transport.tcp import tcp_transport_factory
+
+TCP_CHAOS_ADDRS = {1: "127.0.0.1:27601", 2: "127.0.0.1:27602", 3: "127.0.0.1:27603"}
+
+
+class TcpCluster(Cluster):
+    ADDRS = TCP_CHAOS_ADDRS
+
+    def _dir(self, rid):
+        return f"/tmp/nh-tchaos-{rid}"
+
+    def start(self, rid):
+        self.nhs[rid] = NodeHost(
+            NodeHostConfig(
+                nodehost_dir=self._dir(rid),
+                rtt_millisecond=2,
+                raft_address=self.ADDRS[rid],
+                expert=ExpertConfig(
+                    engine=EngineConfig(exec_shards=2, apply_shards=2),
+                    logdb_factory=tan_logdb_factory,
+                    transport_factory=tcp_transport_factory,
+                ),
+            )
+        )
+
+
+class TestChaosTCP:
+    def test_partitions_and_restarts_over_tcp_tan(self):
+        random.seed(23)
+        cluster = TcpCluster()
+        acked = {}
+        stop = threading.Event()
+        clients = [
+            threading.Thread(
+                target=chaos_client, args=(cluster, acked, stop, f"t{k}")
+            )
+            for k in range(3)
+        ]
+        try:
+            wait_for_leader(cluster.nhs)
+            for t in clients:
+                t.start()
+            for round_ in range(3):
+                time.sleep(0.8)
+                cluster.partition([random.choice(list(TCP_CHAOS_ADDRS))])
+                time.sleep(0.8)
+                cluster.heal()
+                time.sleep(0.4)
+                victim = random.choice(list(TCP_CHAOS_ADDRS))
+                cluster.kill(victim)
+                time.sleep(0.6)
+                cluster.restart(victim)
+                wait_for_leader(cluster.nhs, timeout=20.0)
+            stop.set()
+            for t in clients:
+                t.join(timeout=5.0)
+            cluster.heal()
+            assert len(acked) > 15, f"no progress: {len(acked)}"
+            cluster.settle_and_check_agreement(acked)
+            # I3: still writable after the chaos schedule
+            wait_for_leader(cluster.nhs, timeout=10.0)
+            nh = next(iter(cluster.nhs.values()))
+            s = nh.get_noop_session(1)
+            deadline = time.time() + 10.0
+            while True:
+                try:
+                    nh.sync_propose(s, set_cmd("tcp-final", b"1"), timeout=1.0)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in clients:
+                t.join(timeout=5.0)
             cluster.close()
