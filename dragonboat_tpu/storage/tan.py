@@ -47,6 +47,12 @@ K_BOOTSTRAP = 3
 K_REMOVE_TO = 4
 K_REMOVE_NODE = 5
 
+# kind-byte flag: the record body is zlib-compressed (entry compression
+# at the WAL level — reference: EntryCompression [U]; ours is adaptive:
+# bodies over a threshold that actually shrink get the flag)
+K_COMPRESSED = 0x80
+COMPRESS_THRESHOLD = 512
+
 _i64 = struct.Struct("<q")
 
 SEGMENT_PREFIX = "SEGMENT-"
@@ -133,10 +139,12 @@ class TanLogDB(ILogDB):
         max_segment_bytes: int = DEFAULT_MAX_SEGMENT_BYTES,
         gc_segments: int = DEFAULT_GC_SEGMENTS,
         use_native: Optional[bool] = None,
+        compression: bool = True,
     ):
         self.dir = directory
         self.max_segment_bytes = max_segment_bytes
         self.gc_segments = gc_segments
+        self.compression = compression
         self._mirror = InMemLogDB()
         self._lock = threading.Lock()
         self._fh = None
@@ -235,8 +243,11 @@ class TanLogDB(ILogDB):
                     return self._truncate_tail(path, pos)  # torn final record
                 raise CorruptLogError(f"{path}: bad crc at {pos}")
             try:
+                if kind & K_COMPRESSED:
+                    kind &= ~K_COMPRESSED
+                    body = zlib.decompress(body)
                 self._apply_record(kind, body)
-            except (WireError, ValueError, struct.error) as e:
+            except (WireError, ValueError, struct.error, zlib.error) as e:
                 raise CorruptLogError(f"{path}: bad record at {pos}: {e}")
             pos = body_at + length
 
@@ -289,10 +300,14 @@ class TanLogDB(ILogDB):
             raise WireError(f"unknown record kind {kind}")
 
     # -- writes -----------------------------------------------------------
-    @staticmethod
-    def _frame(recs: List[tuple]) -> bytes:
+    def _frame(self, recs: List[tuple]) -> bytes:
         buf = BytesIO()
         for kind, body in recs:
+            if self.compression and len(body) >= COMPRESS_THRESHOLD:
+                z = zlib.compress(body, 1)  # speed level: WAL hot path
+                if len(z) < len(body):
+                    kind |= K_COMPRESSED
+                    body = z
             buf.write(_REC_HEADER.pack(kind, len(body), zlib.crc32(body)))
             buf.write(body)
         return buf.getvalue()

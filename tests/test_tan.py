@@ -224,3 +224,57 @@ class TestNodeHostOnTan:
         finally:
             for nh in nhs.values():
                 nh.close()
+
+
+class TestWalCompression:
+    def test_large_records_compressed_and_replayed(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        payload = b"A" * 4000  # compressible
+        db.save_raft_state(
+            [mk_update(commit=1, entries=[ent(1, 1, payload)])], 0
+        )
+        db.close()
+        import os as _os
+
+        seg = [f for f in _os.listdir(d) if f.endswith(".log")]
+        size = sum(
+            _os.path.getsize(_os.path.join(d, f)) for f in seg
+        )
+        assert size < 2000, f"record not compressed: {size}B on disk"
+        db2 = TanLogDB(d)
+        got = db2.iterate_entries(1, 1, 1, 2, 2**30)
+        assert got[0].cmd == payload
+        db2.close()
+
+    def test_compression_off_round_trips(self, tmp_path):
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d, compression=False)
+        db.save_raft_state(
+            [mk_update(commit=1, entries=[ent(1, 1, b"B" * 4000)])], 0
+        )
+        db.close()
+        db2 = TanLogDB(d)  # reader handles both framings
+        assert db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd == b"B" * 4000
+        db2.close()
+
+    def test_incompressible_stays_raw(self, tmp_path):
+        import os as _os
+
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        db.save_raft_state(
+            [mk_update(commit=1, entries=[ent(1, 1, _os.urandom(4000))])], 0
+        )
+        db.close()
+        # the adaptive guard must store the body RAW (compression would
+        # only grow random bytes): on-disk size stays >= payload size
+        size = sum(
+            _os.path.getsize(_os.path.join(d, f))
+            for f in _os.listdir(d)
+            if f.endswith(".log")
+        )
+        assert size >= 4000, f"incompressible record was compressed: {size}B"
+        db2 = TanLogDB(d)
+        assert len(db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd) == 4000
+        db2.close()
