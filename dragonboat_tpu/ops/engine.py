@@ -131,8 +131,20 @@ def _summarize(state: DeviceState, out) -> jnp.ndarray:
 
 
 @jax.jit
-def _gather_tree(arrs, idx):
-    return jax.tree.map(lambda a: a[idx], arrs)
+def _gather_detail(state, out, idx4):
+    """All post-step detail reads in one dispatch, with the four equal-
+    length index sets stacked into one [4, b] transfer (latency floor is
+    round-trips, not bytes)."""
+    idx_buf, idx_slot, idx_need, idx_ring = idx4
+    return (
+        out.buf[idx_buf],
+        out.slot_base[idx_slot],
+        out.slot_term[idx_slot],
+        out.ent_drop[idx_slot],
+        out.need_snapshot[idx_need],
+        state.ring_term[idx_ring],
+        state.ring_cc[idx_ring],
+    )
 
 
 @jax.jit
@@ -227,15 +239,10 @@ class VectorStepEngine(IStepEngine):
             idx = self._put(jnp.zeros((b,), jnp.int32))
             sub = _gather_rows(st, idx)
             _scatter_rows(st, idx, sub)
-            if b <= 4:
-                for arr in (
-                    out.buf,
-                    out.slot_base,
-                    out.ent_drop,
-                    out.need_snapshot,
-                    st.ring_term,
-                ):
-                    _gather_tree(arr, idx)
+            if b <= 8:
+                _gather_detail(
+                    st, out, self._put(jnp.zeros((4, b), jnp.int32))
+                )
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
@@ -604,21 +611,38 @@ class VectorStepEngine(IStepEngine):
         self._state = new_state
         esc_set = {g for _, g, _ in esc_rows}
 
-        # ---- gather detail for affected rows -------------------------
+        # ---- gather detail for affected rows (ONE fused dispatch: the
+        # per-step latency floor is dispatch round-trips, which on remote
+        # device links cost far more than the extra padded bytes) -------
         live = [(node, g, si) for node, g, si, plan in batch if g not in esc_set]
         buf_rows = [g for _, g, _ in live if summary[_R_COUNT, g] > 0]
         append_rows = [
             g for _, g, _ in live if summary[_R_APPEND_LO, g] != APPEND_LO_NONE
         ]
         slot_rows = [g for g in prop_rows if g not in esc_set]
-        buf_np = self._gather(out.buf, buf_rows)
-        ring_t = self._gather(new_state.ring_term, append_rows)
-        ring_c = self._gather(new_state.ring_cc, append_rows)
-        slot_base = self._gather(out.slot_base, slot_rows)
-        slot_term = self._gather(out.slot_term, slot_rows)
-        ent_drop = self._gather(out.ent_drop, slot_rows)
         need_rows = [g for _, g, _ in live if summary[_R_NEED_SS, g]]
-        need_np = self._gather(out.need_snapshot, need_rows)
+        if buf_rows or append_rows or slot_rows or need_rows:
+            # pad all four index sets to ONE bucket so the fused gather
+            # compiles per bucket size, not per size combination
+            b = _bucket(
+                max(len(buf_rows), len(append_rows), len(slot_rows), len(need_rows))
+            )
+            idx4 = np.zeros((4, b), np.int32)
+            for row_i, rows in enumerate(
+                (buf_rows, slot_rows, need_rows, append_rows)
+            ):
+                if rows:
+                    idx4[row_i, : len(rows)] = rows
+                    idx4[row_i, len(rows):] = rows[-1]
+            parts = _gather_detail(
+                new_state, out, self._put(jnp.asarray(idx4))
+            )
+            (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t, ring_c) = (
+                np.asarray(p) for p in parts
+            )
+        else:
+            buf_np = slot_base = slot_term = ent_drop = need_np = None
+            ring_t = ring_c = None
         buf_at = {g: k for k, g in enumerate(buf_rows)}
         ring_at = {g: k for k, g in enumerate(append_rows)}
         slot_at = {g: k for k, g in enumerate(slot_rows)}
@@ -709,11 +733,6 @@ class VectorStepEngine(IStepEngine):
                 self._put(jnp.asarray(_pad_idx([i for _, _, i in snapshot_sends]))),
             )
         return updates
-
-    def _gather(self, arr, rows: List[int]) -> Optional[np.ndarray]:
-        if not rows:
-            return None
-        return np.asarray(_gather_tree(arr, self._put(jnp.asarray(_pad_idx(rows)))))
 
     # -- append reconstruction -----------------------------------------
     def _merge_appends(

@@ -259,22 +259,32 @@ class TestWalCompression:
         db2.close()
 
     def test_incompressible_stays_raw(self, tmp_path):
+        """The adaptive guard (`len(z) < len(body)`) keeps genuinely
+        incompressible bodies raw — pinned at the _frame level, since any
+        record built through the public API carries compressible framing
+        around the payload."""
         import os as _os
 
-        d = str(tmp_path / "tan")
-        db = TanLogDB(d)
+        from dragonboat_tpu.storage.tan import (
+            K_COMPRESSED,
+            K_STATE_ENTRIES,
+            _REC_HEADER,
+        )
+
+        db = TanLogDB(str(tmp_path / "tan"))
+        body = _os.urandom(4000)  # zlib cannot shrink this
+        raw = db._frame([(K_STATE_ENTRIES, body)])
+        kind, length, _crc = _REC_HEADER.unpack(raw[: _REC_HEADER.size])
+        assert not (kind & K_COMPRESSED)
+        assert length == 4000 and raw[_REC_HEADER.size :] == body
+        # end-to-end: a random payload still round-trips regardless of
+        # whether the structured wrapper tipped the record into the
+        # compressed framing
+        payload = _os.urandom(4000)
         db.save_raft_state(
-            [mk_update(commit=1, entries=[ent(1, 1, _os.urandom(4000))])], 0
+            [mk_update(commit=1, entries=[ent(1, 1, payload)])], 0
         )
         db.close()
-        # the adaptive guard must store the body RAW (compression would
-        # only grow random bytes): on-disk size stays >= payload size
-        size = sum(
-            _os.path.getsize(_os.path.join(d, f))
-            for f in _os.listdir(d)
-            if f.endswith(".log")
-        )
-        assert size >= 4000, f"incompressible record was compressed: {size}B"
-        db2 = TanLogDB(d)
-        assert len(db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd) == 4000
+        db2 = TanLogDB(str(tmp_path / "tan"))
+        assert db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd == payload
         db2.close()
