@@ -55,6 +55,7 @@ from . import kernel as K
 from . import sync as S
 from .types import (
     APPEND_LO_NONE,
+    N_FIELDS as N_FIELDS_BUF,
     F_LOG_INDEX,
     F_MTYPE,
     F_N_ENTRIES,
@@ -132,11 +133,14 @@ def _summarize(state: DeviceState, out) -> jnp.ndarray:
 
 @jax.jit
 def _gather_detail(state, out, idx4):
-    """All post-step detail reads in one dispatch, with the four equal-
-    length index sets stacked into one [4, b] transfer (latency floor is
-    round-trips, not bytes)."""
+    """All post-step detail reads in ONE dispatch and ONE [b, K] readback
+    array: the four equal-length index sets travel as a stacked [4, b]
+    transfer, and the seven flattened results concatenate on axis 1 so the
+    host issues a single D2H copy (latency floor is round-trips, not
+    bytes)."""
     idx_buf, idx_slot, idx_need, idx_ring = idx4
-    return (
+    b = idx_buf.shape[0]
+    parts = (
         out.buf[idx_buf],
         out.slot_base[idx_slot],
         out.slot_term[idx_slot],
@@ -145,6 +149,20 @@ def _gather_detail(state, out, idx4):
         state.ring_term[idx_ring],
         state.ring_cc[idx_ring],
     )
+    return jnp.concatenate([p.reshape(b, -1) for p in parts], axis=1)
+
+
+def _split_detail(flat: np.ndarray, O: int, M: int, E: int, P: int, W: int):
+    """Host-side inverse of _gather_detail's packing."""
+    b = flat.shape[0]
+    sizes = (O * N_FIELDS_BUF, M, M, M * E, P, W, W)
+    shapes = ((b, O, N_FIELDS_BUF), (b, M), (b, M), (b, M, E), (b, P), (b, W), (b, W))
+    outs = []
+    pos = 0
+    for size, shape in zip(sizes, shapes):
+        outs.append(flat[:, pos : pos + size].reshape(shape))
+        pos += size
+    return tuple(outs)
 
 
 @jax.jit
@@ -239,10 +257,7 @@ class VectorStepEngine(IStepEngine):
             idx = self._put(jnp.zeros((b,), jnp.int32))
             sub = _gather_rows(st, idx)
             _scatter_rows(st, idx, sub)
-            if b <= 8:
-                _gather_detail(
-                    st, out, self._put(jnp.zeros((4, b), jnp.int32))
-                )
+            _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
@@ -634,11 +649,11 @@ class VectorStepEngine(IStepEngine):
                 if rows:
                     idx4[row_i, : len(rows)] = rows
                     idx4[row_i, len(rows):] = rows[-1]
-            parts = _gather_detail(
-                new_state, out, self._put(jnp.asarray(idx4))
-            )
+            flat = np.asarray(
+                _gather_detail(new_state, out, self._put(jnp.asarray(idx4)))
+            )  # ONE device dispatch, ONE D2H copy
             (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t, ring_c) = (
-                np.asarray(p) for p in parts
+                _split_detail(flat, self.O, self.M, self.E, self.P, self.W)
             )
         else:
             buf_np = slot_base = slot_term = ent_drop = need_np = None

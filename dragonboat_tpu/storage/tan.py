@@ -163,6 +163,11 @@ class TanLogDB(ILogDB):
         self._inflight = 0  # native appends running outside the lock
         self._idle = threading.Condition(self._lock)  # inflight == 0
         self._rotate_pending = False  # gate: new appends wait, inflight drains
+        # test-only fault injection (reference: vfs error-injection hooks
+        # [U]): called with the framed bytes before every write+fsync on
+        # BOTH writer paths (python and native group-commit); raising
+        # simulates an I/O failure at that point
+        self.fault_hook = None
         os.makedirs(directory, exist_ok=True)
         self._replay()
         self._open_active()
@@ -327,6 +332,8 @@ class TanLogDB(ILogDB):
     ) -> None:
         """recs = [(kind, body)]; one write + one fsync for the batch."""
         raw = self._frame(recs)
+        if self.fault_hook is not None:
+            self.fault_hook(raw)
         if self._writer is not None:
             # native path: write+fsync on the group-commit thread, GIL
             # released; concurrent workers' batches share one fsync
@@ -430,6 +437,8 @@ class TanLogDB(ILogDB):
         # stepped by exactly one worker); locked mutators for the same
         # shard quiesce in-flight appends first.
         raw = self._frame(recs)
+        if self.fault_hook is not None:
+            self.fault_hook(raw)
         with self._lock:
             # a pending rotation blocks NEW appends so inflight can drain
             # — otherwise sustained load starves rotation (and GC) forever

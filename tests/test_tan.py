@@ -288,3 +288,46 @@ class TestWalCompression:
         db2 = TanLogDB(str(tmp_path / "tan"))
         assert db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd == payload
         db2.close()
+
+
+class TestFaultInjection:
+    def test_failed_save_never_publishes_to_readers(self, tmp_path):
+        """An I/O failure during save_raft_state must propagate AND leave
+        the read view untouched (no durable-but-unpublished or
+        published-but-undurable states) — on both writer paths."""
+        for use_native in (False, True):
+            d = str(tmp_path / f"tan-{use_native}")
+            try:
+                db = TanLogDB(d, use_native=use_native)
+            except OSError:
+                continue  # native toolchain unavailable
+            db.save_raft_state([mk_update(commit=1, entries=[ent(1)])], 0)
+
+            boom = {"n": 0}
+
+            def hook(raw):
+                boom["n"] += 1
+                raise OSError("injected disk failure")
+
+            db.fault_hook = hook
+            with pytest.raises(OSError):
+                db.save_raft_state(
+                    [mk_update(term=2, commit=2, entries=[ent(2, 2)])], 0
+                )
+            assert boom["n"] == 1
+            # the failed batch is invisible to readers
+            assert db.read_raft_state(1, 1, 0).state.term == 1
+            assert [e.index for e in db.iterate_entries(1, 1, 1, 10, 2**30)] == [1]
+            # clearing the fault restores service
+            db.fault_hook = None
+            db.save_raft_state(
+                [mk_update(term=3, commit=2, entries=[ent(2, 3)])], 0
+            )
+            db.close()
+            db2 = TanLogDB(d)
+            assert db2.read_raft_state(1, 1, 0).state.term == 3
+            assert [
+                (e.index, e.term)
+                for e in db2.iterate_entries(1, 1, 1, 10, 2**30)
+            ] == [(1, 1), (2, 3)]
+            db2.close()
