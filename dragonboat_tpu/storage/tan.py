@@ -28,12 +28,15 @@ from ..logger import get_logger
 from ..pb import Bootstrap, Entry, Snapshot, State, Update
 from ..raftio import ILogDB, NodeInfo, RaftState
 from ..transport.wire import (
+    MAX_PAYLOAD,
     WireError,
     _R,
     _r_entry,
     _r_snapshot,
     _w_entry,
     _w_snapshot,
+    bounded_decompress,
+    maybe_compress,
 )
 from .logdb import InMemLogDB
 
@@ -250,7 +253,7 @@ class TanLogDB(ILogDB):
             try:
                 if kind & K_COMPRESSED:
                     kind &= ~K_COMPRESSED
-                    body = zlib.decompress(body)
+                    body = bounded_decompress(body, MAX_PAYLOAD)
                 self._apply_record(kind, body)
             except (WireError, ValueError, struct.error, zlib.error) as e:
                 raise CorruptLogError(f"{path}: bad record at {pos}: {e}")
@@ -308,11 +311,10 @@ class TanLogDB(ILogDB):
     def _frame(self, recs: List[tuple]) -> bytes:
         buf = BytesIO()
         for kind, body in recs:
-            if self.compression and len(body) >= COMPRESS_THRESHOLD:
-                z = zlib.compress(body, 1)  # speed level: WAL hot path
-                if len(z) < len(body):
-                    kind |= K_COMPRESSED
-                    body = z
+            if self.compression:
+                kind, body = maybe_compress(
+                    kind, body, K_COMPRESSED, COMPRESS_THRESHOLD
+                )
             buf.write(_REC_HEADER.pack(kind, len(body), zlib.crc32(body)))
             buf.write(body)
         return buf.getvalue()

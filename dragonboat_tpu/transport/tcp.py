@@ -29,11 +29,14 @@ from ..raftio import (
     ITransport,
     MessageHandler,
 )
+from . import wire as wire_mod
 from .wire import (
     KIND_BATCH,
     KIND_CHUNK,
+    KIND_COMPRESSED,
     MAGIC,
     MAX_PAYLOAD,
+    WIRE_COMPRESS_THRESHOLD,
     WireError,
     decode_batch,
     decode_chunk,
@@ -52,6 +55,9 @@ def parse_address(addr: str) -> tuple:
 
 
 def _write_frame(sock, kind: int, payload: bytes) -> None:
+    kind, payload = wire_mod.maybe_compress(
+        kind, payload, KIND_COMPRESSED, WIRE_COMPRESS_THRESHOLD
+    )
     hdr = _header.pack(MAGIC, kind, len(payload), zlib.crc32(payload))
     sock.sendall(hdr + payload)
 
@@ -80,6 +86,9 @@ def _read_frame(sock) -> Optional[tuple]:
         return None
     if zlib.crc32(payload) != crc:
         raise WireError("crc mismatch")
+    if kind & KIND_COMPRESSED:
+        kind &= ~KIND_COMPRESSED
+        payload = wire_mod.bounded_decompress(payload, MAX_PAYLOAD)
     return kind, payload
 
 

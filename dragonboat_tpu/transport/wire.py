@@ -8,7 +8,9 @@ never be able to execute code or allocate unboundedly on decode.
 
 Frame layout (transport level, see tcp.py):
     magic  u32  = 0x54524654 ("TRFT")
-    kind   u8   (1 = MessageBatch, 2 = Chunk)
+    kind   u8   (1 = MessageBatch, 2 = Chunk; the 0x80 bit flags a
+                 zlib-compressed payload — crc/length cover the bytes
+                 as sent, i.e. the compressed form)
     length u32  payload byte length
     crc    u32  zlib.crc32 of payload
     payload
@@ -35,6 +37,10 @@ from ..pb import (
 MAGIC = 0x54524654
 KIND_BATCH = 1
 KIND_CHUNK = 2
+# frame-kind flag: payload is zlib-compressed (wire entry compression —
+# reference: EntryCompression on replicated batches [U]; ours is adaptive)
+KIND_COMPRESSED = 0x80
+WIRE_COMPRESS_THRESHOLD = 1024
 
 # decode-side sanity bounds (wire input is untrusted)
 MAX_PAYLOAD = 256 * 1024 * 1024
@@ -47,6 +53,36 @@ _u8 = struct.Struct("<B")
 
 class WireError(Exception):
     """Malformed or out-of-bounds wire data."""
+
+
+def maybe_compress(kind: int, payload: bytes, flag: int, threshold: int):
+    """Adaptive compression shared by the TCP framing and the tan WAL:
+    payloads over ``threshold`` that actually shrink get ``flag`` OR'd
+    into the kind byte (reference: EntryCompression [U])."""
+    import zlib
+
+    if len(payload) >= threshold:
+        z = zlib.compress(payload, 1)  # speed level: hot paths
+        if len(z) < len(payload):
+            return kind | flag, z
+    return kind, payload
+
+
+def bounded_decompress(payload: bytes, max_out: int) -> bytes:
+    """Strict inverse of maybe_compress's compressed arm: bounded
+    allocation (zlib-bomb safe) and no trailing bytes tolerated."""
+    import zlib
+
+    try:
+        d = zlib.decompressobj()
+        out = d.decompress(payload, max_out + 1)
+    except zlib.error as e:
+        raise WireError(f"bad compressed payload: {e}")
+    if len(out) > max_out or not d.eof:
+        raise WireError("decompressed payload too large")
+    if d.unused_data:
+        raise WireError("trailing bytes after compressed payload")
+    return out
 
 
 # ---------------------------------------------------------------------------
