@@ -120,13 +120,26 @@ class Node:
         self._pending_ticks = 0
 
         # --- pending futures --------------------------------------------
-        key_base = config.replica_id << 48
+        # keys must be unique across NODE INCARNATIONS, not just within
+        # one: a restarted replica re-applies its whole log, and if an old
+        # in-log entry's key collided with a freshly allocated one, the
+        # replayed apply would complete the NEW future — a false ack for a
+        # proposal that may never commit (observed as acked-write loss in
+        # chaos).  The reference seeds its key generator randomly per
+        # start [U]; 47 random bits leave the counter ~2^47 of headroom.
+        import random as _random
+
+        _rand = _random.SystemRandom()
+
+        def key_base() -> int:
+            return (config.replica_id << 48) | _rand.getrandbits(47)
+
         self.pending_proposal = PendingProposal()
-        self.pending_proposal._next_key = key_base
+        self.pending_proposal._next_key = key_base()
         self.pending_read_index = PendingReadIndex()
-        self.pending_read_index._next_key = key_base
+        self.pending_read_index._next_key = key_base()
         self.pending_config_change = PendingConfigChange()
-        self.pending_config_change._next_key = key_base
+        self.pending_config_change._next_key = key_base()
         self.pending_snapshot = PendingSnapshot()
         self.pending_leader_transfer = PendingLeaderTransfer()
 

@@ -18,6 +18,7 @@ Frame layout (transport level, see tcp.py):
 from __future__ import annotations
 
 import struct
+import zlib
 from io import BytesIO
 from typing import Tuple
 
@@ -59,8 +60,6 @@ def maybe_compress(kind: int, payload: bytes, flag: int, threshold: int):
     """Adaptive compression shared by the TCP framing and the tan WAL:
     payloads over ``threshold`` that actually shrink get ``flag`` OR'd
     into the kind byte (reference: EntryCompression [U])."""
-    import zlib
-
     if len(payload) >= threshold:
         z = zlib.compress(payload, 1)  # speed level: hot paths
         if len(z) < len(payload):
@@ -71,8 +70,6 @@ def maybe_compress(kind: int, payload: bytes, flag: int, threshold: int):
 def bounded_decompress(payload: bytes, max_out: int) -> bytes:
     """Strict inverse of maybe_compress's compressed arm: bounded
     allocation (zlib-bomb safe) and no trailing bytes tolerated."""
-    import zlib
-
     try:
         d = zlib.decompressobj()
         out = d.decompress(payload, max_out + 1)

@@ -317,7 +317,12 @@ class TestWireCompression:
 
         a, b = _socket.socketpair()
         try:
-            bomb = zlib.compress(b"\x00" * (300 * 1024 * 1024), 9)
+            # build the bomb incrementally: only the ~290KB compressed
+            # output is ever resident (CI memory limits)
+            co = zlib.compressobj(9)
+            parts = [co.compress(b"\x00" * (1024 * 1024)) for _ in range(300)]
+            parts.append(co.flush())
+            bomb = b"".join(parts)
             hdr = _struct.pack(
                 "<IBII", MAGIC, KIND_BATCH | KIND_COMPRESSED, len(bomb),
                 zlib.crc32(bomb),
