@@ -325,7 +325,7 @@ class NodeHost:
                 # first contact deadlocks (it cannot ack, so the leader
                 # never resends)
                 if batch.source_address and m.from_:
-                    self.registry.add(
+                    self.registry.learn(
                         m.shard_id, m.from_, batch.source_address
                     )
                 node.enqueue_received(m)

@@ -173,6 +173,18 @@ def _set_remote_snapshot(state: DeviceState, g_idx, p_idx, snap_idx):
     )
 
 
+def _tick_bookkeeping(node, ticks: int) -> None:
+    """Advance the node's logical clock and GC timed-out futures — the
+    device path's mirror of the tick tail of ``Node.step_with_inputs``."""
+    for _ in range(ticks):
+        node.tick_count += 1
+        node.pending_proposal.gc(node.tick_count)
+        node.pending_read_index.gc(node.tick_count)
+        node.pending_config_change.gc(node.tick_count)
+        node.pending_snapshot.gc(node.tick_count)
+        node.pending_leader_transfer.gc(node.tick_count)
+
+
 class _RowMeta:
     __slots__ = ("node", "dirty")
 
@@ -508,7 +520,11 @@ class VectorStepEngine(IStepEngine):
                     host_rows.append((node, si))
                     continue
                 if not plan and not self._meta[g].dirty:
-                    continue  # nothing to do for this row
+                    # nothing for the device, but the logical clock still
+                    # advanced: a quiesced row's swallowed ticks must GC
+                    # pending futures exactly like the scalar loop does
+                    _tick_bookkeeping(node, si.ticks)
+                    continue
                 batch.append((node, g, si, plan))
 
             # cold rows leave the device before their scalar step
@@ -675,13 +691,7 @@ class VectorStepEngine(IStepEngine):
             ).any() or summary[_R_COUNT, g] > 0
             appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
             # tick bookkeeping (mirrors Node.step_with_inputs)
-            for _ in range(si.ticks):
-                node.tick_count += 1
-                node.pending_proposal.gc(node.tick_count)
-                node.pending_read_index.gc(node.tick_count)
-                node.pending_config_change.gc(node.tick_count)
-                node.pending_snapshot.gc(node.tick_count)
-                node.pending_leader_transfer.gc(node.tick_count)
+            _tick_bookkeeping(node, si.ticks)
             if not (
                 changed
                 or appended

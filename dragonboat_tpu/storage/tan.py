@@ -311,7 +311,11 @@ class TanLogDB(ILogDB):
     def _frame(self, recs: List[tuple]) -> bytes:
         buf = BytesIO()
         for kind, body in recs:
-            if self.compression:
+            # Never compress a body larger than the replay-side decompress
+            # bound: replay rejects records that inflate past MAX_PAYLOAD,
+            # so a compressed oversize record would write fine and then make
+            # the WAL permanently unopenable. Stored raw it replays fine.
+            if self.compression and len(body) <= MAX_PAYLOAD:
                 kind, body = maybe_compress(
                     kind, body, K_COMPRESSED, COMPRESS_THRESHOLD
                 )

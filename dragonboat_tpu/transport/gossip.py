@@ -33,19 +33,46 @@ _u32 = struct.Struct("<I")
 _u64 = struct.Struct("<Q")
 
 MAX_PACKET = 60 * 1024
+MAX_ROWS = 4096  # per-packet row cap, enforced symmetrically encode/decode
+# per-string bound (ids are ~36B uuids, addrs host:port): keeps any single
+# accepted row far below MAX_PACKET so _encode_packets' per-packet size
+# invariant can't be broken by a hostile row that got merged into the table
+MAX_ROW_STR = 512
 
 
-def _encode_table(table: Dict[str, Tuple[str, int]]) -> bytes:
+def _encode_row(nhid: str, addr: str, ver: int) -> bytes:
     b = BytesIO()
-    b.write(_u32.pack(_MAGIC))
-    b.write(_u32.pack(len(table)))
-    for nhid, (addr, ver) in table.items():
-        for s in (nhid, addr):
-            raw = s.encode("utf-8")
-            b.write(_u32.pack(len(raw)))
-            b.write(raw)
-        b.write(_u64.pack(ver))
+    for s in (nhid, addr):
+        raw = s.encode("utf-8")
+        b.write(_u32.pack(len(raw)))
+        b.write(raw)
+    b.write(_u64.pack(ver))
     return b.getvalue()
+
+
+def _encode_packets(
+    table: Dict[str, Tuple[str, int]], sender: str
+) -> List[bytes]:
+    """Shard the full table into UDP-safe packets (each under MAX_PACKET
+    and under the decoder's 4096-row cap).  Every packet carries the
+    ``__sender__`` row so receivers learn the peer address from any
+    fragment; merge is per-row, so fragments need no reassembly."""
+    sender_row = _encode_row("__sender__", sender, 0)
+    rows: List[List[bytes]] = [[sender_row]]
+    size = 8 + len(sender_row)
+    for nhid, (addr, ver) in table.items():
+        if len(nhid.encode()) > MAX_ROW_STR or len(addr.encode()) > MAX_ROW_STR:
+            continue  # decoder would reject it anyway; don't waste a packet
+        rb = _encode_row(nhid, addr, ver)
+        if size + len(rb) > MAX_PACKET or len(rows[-1]) >= MAX_ROWS:
+            rows.append([sender_row])
+            size = 8 + len(sender_row)
+        rows[-1].append(rb)
+        size += len(rb)
+    return [
+        _u32.pack(_MAGIC) + _u32.pack(len(chunk)) + b"".join(chunk)
+        for chunk in rows
+    ]
 
 
 def _decode_table(data: bytes) -> Optional[Dict[str, Tuple[str, int]]]:
@@ -63,12 +90,18 @@ def _decode_table(data: bytes) -> Optional[Dict[str, Tuple[str, int]]]:
         if _u32.unpack(take(4))[0] != _MAGIC:
             return None
         count = _u32.unpack(take(4))[0]
-        if count > 4096:
+        if count > MAX_ROWS:
             return None
         table = {}
         for _ in range(count):
-            nhid = take(_u32.unpack(take(4))[0]).decode("utf-8")
-            addr = take(_u32.unpack(take(4))[0]).decode("utf-8")
+            n1 = _u32.unpack(take(4))[0]
+            if n1 > MAX_ROW_STR:
+                return None
+            nhid = take(n1).decode("utf-8")
+            n2 = _u32.unpack(take(4))[0]
+            if n2 > MAX_ROW_STR:
+                return None
+            addr = take(n2).decode("utf-8")
             ver = _u64.unpack(take(8))[0]
             table[nhid] = (addr, ver)
         return table
@@ -104,6 +137,7 @@ class GossipManager:
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._send_err_logged = False
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -189,8 +223,7 @@ class GossipManager:
             with self._lock:
                 table = dict(self._table)
                 peers = list(self._peers)
-            table["__sender__"] = (self.advertise_address, 0)
-            pkt = _encode_table(table)
+            pkts = _encode_packets(table, self.advertise_address)
             random.shuffle(peers)
             targets = peers[: self.fanout]
             for seed in self.seeds:
@@ -199,10 +232,16 @@ class GossipManager:
             for t in targets:
                 if t == self.advertise_address:
                     continue
-                try:
-                    self._sock.sendto(pkt, parse_address(t))
-                except OSError:
-                    pass
+                for pkt in pkts:
+                    try:
+                        self._sock.sendto(pkt, parse_address(t))
+                    except OSError as e:
+                        if not self._send_err_logged:
+                            self._send_err_logged = True
+                            _log.warning(
+                                "gossip sendto %s failed (%s); "
+                                "further send errors suppressed", t, e
+                            )
 
 
 class GossipRegistry(Registry):

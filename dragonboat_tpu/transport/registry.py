@@ -9,6 +9,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
+from ..id import is_nodehost_id
+
 
 class Registry:
     def __init__(self):
@@ -17,6 +19,17 @@ class Registry:
 
     def add(self, shard_id: int, replica_id: int, address: str) -> None:
         with self._lock:
+            self._addr[(shard_id, replica_id)] = address
+
+    def learn(self, shard_id: int, replica_id: int, address: str) -> None:
+        """Learn a sender's return address from observed traffic.  Unlike
+        ``add`` this never replaces a NodeHostID mapping with a literal
+        raft address — doing so would pin the peer to its current host
+        and defeat the gossip indirection until the next membership sync."""
+        with self._lock:
+            cur = self._addr.get((shard_id, replica_id))
+            if cur is not None and is_nodehost_id(cur):
+                return
             self._addr[(shard_id, replica_id)] = address
 
     def remove(self, shard_id: int, replica_id: int) -> None:

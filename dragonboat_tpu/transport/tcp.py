@@ -232,8 +232,12 @@ class TCPTransport(ITransport):
                     sock = self._ssl_server_ctx.wrap_socket(
                         sock, server_side=True
                     )
-                except ssl.SSLError as e:
+                except (ssl.SSLError, OSError) as e:
                     _log.warning("tls handshake failed: %s", e)
+                    try:
+                        sock.close()  # else each failed handshake leaks a fd
+                    except OSError:
+                        pass
                     continue
             with self._conn_lock:
                 self._inbound.add(sock)

@@ -205,6 +205,51 @@ class TestGossip:
         finally:
             mgr.close()
 
+    def test_learn_never_clobbers_nodehost_id(self):
+        """Learning a sender address from traffic must not replace a
+        NodeHostID mapping — that would pin the peer to its current host
+        and defeat the gossip indirection (advisor finding)."""
+        import tempfile
+
+        from dragonboat_tpu.transport.registry import Registry
+
+        with tempfile.TemporaryDirectory() as d:
+            nhid = get_nodehost_id(d)
+        reg = Registry()
+        reg.add(1, 1, nhid)
+        reg.learn(1, 1, "10.0.0.9:900")
+        assert reg.resolve(1, 1) == nhid  # untouched
+        reg.add(1, 2, "10.0.0.2:200")
+        reg.learn(1, 2, "10.0.0.9:900")  # plain addr: updated
+        assert reg.resolve(1, 2) == "10.0.0.9:900"
+        reg.learn(1, 3, "10.0.0.3:300")  # unknown: learned
+        assert reg.resolve(1, 3) == "10.0.0.3:300"
+
+    def test_push_packets_shard_large_tables(self):
+        """The full-table push must stay under the UDP packet bound by
+        sharding rows across packets, each independently decodable and
+        carrying the sender row (advisor finding)."""
+        from dragonboat_tpu.transport.gossip import (
+            MAX_PACKET,
+            _decode_table,
+            _encode_packets,
+        )
+
+        table = {
+            f"nhid-{i:05d}" + "x" * 40: (f"10.0.{i // 256}.{i % 256}:7000", i)
+            for i in range(2000)
+        }
+        pkts = _encode_packets(table, "1.2.3.4:99")
+        assert len(pkts) > 1
+        merged = {}
+        for p in pkts:
+            assert len(p) <= MAX_PACKET
+            t = _decode_table(p)
+            assert t is not None
+            assert t.pop("__sender__") == ("1.2.3.4:99", 0)
+            merged.update(t)
+        assert merged == table
+
 
 # ---------------------------------------------------------------------------
 # nodehost-id addressing end to end (TCP + gossip)

@@ -258,6 +258,25 @@ class TestWalCompression:
         assert db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd == b"B" * 4000
         db2.close()
 
+    def test_oversize_body_stays_raw_and_replays(self, tmp_path, monkeypatch):
+        """A body larger than the replay-side decompress bound must be
+        stored raw: compressed it would write fine but fail
+        bounded_decompress on the next open, bricking the WAL (advisor
+        finding).  Raw oversize records replay without the bound."""
+        import dragonboat_tpu.storage.tan as tan_mod
+
+        monkeypatch.setattr(tan_mod, "MAX_PAYLOAD", 1000)
+        d = str(tmp_path / "tan")
+        db = TanLogDB(d)
+        payload = b"C" * 4000  # compressible and over the (shrunk) bound
+        db.save_raft_state(
+            [mk_update(commit=1, entries=[ent(1, 1, payload)])], 0
+        )
+        db.close()
+        db2 = TanLogDB(d)  # must NOT raise CorruptLogError
+        assert db2.iterate_entries(1, 1, 1, 2, 2**30)[0].cmd == payload
+        db2.close()
+
     def test_incompressible_stays_raw(self, tmp_path):
         """The adaptive guard (`len(z) < len(body)`) keeps genuinely
         incompressible bodies raw — pinned at the _frame level, since any

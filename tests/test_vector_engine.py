@@ -249,6 +249,13 @@ class TestVectorQuiesce:
                 raise AssertionError(
                     f"no quiet window while quiesced: {sent0} -> {sent1}"
                 )
+            # the logical clock still advances for a quiesced device row
+            # (future GC depends on it — advisor finding): ticks are
+            # swallowed before the device, but bookkeeping must run
+            tc0 = {r: nh._nodes[1].tick_count for r, nh in nhs.items()}
+            time.sleep(0.5)
+            tc1 = {r: nh._nodes[1].tick_count for r, nh in nhs.items()}
+            assert all(tc1[r] > tc0[r] for r in nhs), (tc0, tc1)
             # a proposal wakes the shard and commits
             propose_r(nhs[2], s, set_cmd("q1", b"w"), deadline=15.0)
             assert read_r(nhs[3], 1, "q1") == b"w"
