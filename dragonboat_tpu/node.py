@@ -284,6 +284,8 @@ class Node:
         return rs
 
     def enqueue_received(self, m: Message) -> None:
+        if self.stopped:
+            return  # a stopped replica drains nothing; don't grow the queue
         with self._qlock:
             self._received.append(m)
 

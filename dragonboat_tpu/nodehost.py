@@ -36,7 +36,6 @@ from .request import (
     SystemBusy,
 )
 from .statemachine import Result
-from .storage.logdb import InMemLogDB
 from .storage.snapshotter import FileSnapshotStorage
 from .transport import InProcTransport, Registry, Transport
 from .transport.chunk import ChunkSink
@@ -96,9 +95,15 @@ class NodeHost:
         try:
 
             expert = config.expert
-            self.logdb = (
-                expert.logdb_factory(config) if expert.logdb_factory else InMemLogDB()
-            )
+            if expert.logdb_factory:
+                self.logdb = expert.logdb_factory(config)
+            else:
+                # durable by default, like the reference (tan is its v4
+                # default LogDB [U]); volatile storage is opt-in via
+                # storage.logdb.in_mem_logdb_factory
+                from .storage.tan import tan_logdb_factory
+
+                self.logdb = tan_logdb_factory(config)
             if expert.snapshot_storage_factory:
                 self.snapshot_storage = expert.snapshot_storage_factory(config)
             else:

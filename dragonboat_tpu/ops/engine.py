@@ -240,6 +240,7 @@ class VectorStepEngine(IStepEngine):
             "device_rows_stepped": 0,
             "host_rows_stepped": 0,
             "escalations": 0,
+            "divergence_halts": 0,
         }
         self._warm()
 
@@ -284,6 +285,21 @@ class VectorStepEngine(IStepEngine):
             if g is not None:
                 self._meta.pop(g, None)
                 self._free.append(g)
+
+    def _halt_replica(self, g: int) -> None:
+        """Fail-stop a diverged replica (caller holds the engine lock).
+
+        ``node.stop()`` drops every pending future and closes the SM —
+        without it, enqueued traffic and registered futures would leak
+        forever on a node nothing will ever step again.  The row slot is
+        freed so other shards can use it.  Safe under the engine lock:
+        apply workers never call back into the step engine."""
+        node = self._meta[g].node
+        self.stats["divergence_halts"] += 1
+        self._row_of.pop(node.shard_id, None)
+        self._meta.pop(g, None)
+        self._free.append(g)
+        node.stop()
 
     def _static_host_only(self, node) -> bool:
         """Shards that can never (currently) be device-resident — checked
@@ -477,14 +493,21 @@ class VectorStepEngine(IStepEngine):
             dev_last = int(sub.last_index[k])
             host_last = r.log.last_index()
             if dev_last != host_last:
-                _log.error(
-                    "[%d:%d] device/host log divergence: device last=%d "
-                    "host last=%d",
+                # the reconstruction invariant broke: the host log no
+                # longer mirrors the rows the device stepped, so any
+                # further ack could be for an entry the WAL never saw.
+                # Halt the replica loudly, like the snapshot-recovery
+                # failure path in node.py (reference: dragonboat panics
+                # on unrecoverable state [U]).
+                _log.critical(
+                    "[%d:%d] FATAL: device/host log divergence: device "
+                    "last=%d host last=%d; halting replica",
                     r.shard_id,
                     r.replica_id,
                     dev_last,
                     host_last,
                 )
+                self._halt_replica(g)
 
     # ------------------------------------------------------------------
     # the step
@@ -538,6 +561,8 @@ class VectorStepEngine(IStepEngine):
 
         # ---- host path (cold rows; engine lock released) -------------
         for node, si in host_rows:
+            if node.stopped:  # e.g. halted by a divergence fail-stop
+                continue
             u = node.step_with_inputs(si)
             self.stats["host_rows_stepped"] += 1
             if u is not None:
@@ -631,7 +656,10 @@ class VectorStepEngine(IStepEngine):
             )
             self._materialize_rows([g for _, g, _ in esc_rows], old_state)
             for node, g, si in esc_rows:
-                self._meta[g].dirty = True
+                meta = self._meta.get(g)
+                if meta is None:  # halted + detached during materialize
+                    continue
+                meta.dirty = True
                 # quiesce note: _plan_device already consumed this step's
                 # quiesce ticks; the replay re-ticks the manager, which can
                 # only make the shard quiesce EARLIER — benign for a perf

@@ -30,6 +30,14 @@ class _NodeStore:
         self.bootstrap: Optional[Bootstrap] = None
 
 
+def in_mem_logdb_factory(config) -> "InMemLogDB":
+    """NodeHostConfig.expert.logdb_factory hook for the volatile backend.
+
+    The default backend is the durable tan WAL; opting into process-memory
+    storage must be explicit because a crash loses every acked write."""
+    return InMemLogDB()
+
+
 class InMemLogDB(ILogDB):
     def __init__(self):
         self._lock = threading.RLock()

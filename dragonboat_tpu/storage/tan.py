@@ -56,7 +56,7 @@ K_REMOVE_NODE = 5
 K_COMPRESSED = 0x80
 COMPRESS_THRESHOLD = 512
 
-_i64 = struct.Struct("<q")
+_u64 = struct.Struct("<Q")
 
 SEGMENT_PREFIX = "SEGMENT-"
 DEFAULT_MAX_SEGMENT_BYTES = 64 * 1024 * 1024
@@ -67,8 +67,8 @@ class CorruptLogError(Exception):
     """Mid-log corruption (not a clean torn tail)."""
 
 
-def _wi(b: BytesIO, v: int) -> None:
-    b.write(_i64.pack(v))
+def _wu64(b: BytesIO, v: int) -> None:
+    b.write(_u64.pack(v))
 
 
 def _wb(b: BytesIO, v: bytes) -> None:
@@ -82,11 +82,11 @@ def _ws(b: BytesIO, v: str) -> None:
 
 def _encode_state_entries(u: Update) -> bytes:
     b = BytesIO()
-    _wi(b, u.shard_id)
-    _wi(b, u.replica_id)
-    _wi(b, u.state.term)
-    _wi(b, u.state.vote)
-    _wi(b, u.state.commit)
+    _wu64(b, u.shard_id)
+    _wu64(b, u.replica_id)
+    _wu64(b, u.state.term)
+    _wu64(b, u.state.vote)
+    _wu64(b, u.state.commit)
     b.write(struct.pack("<I", len(u.entries_to_save)))
     for e in u.entries_to_save:
         _w_entry(b, e)
@@ -99,19 +99,19 @@ def _encode_state_entries(u: Update) -> bytes:
 
 def _encode_snapshot(shard_id: int, replica_id: int, ss: Snapshot) -> bytes:
     b = BytesIO()
-    _wi(b, shard_id)
-    _wi(b, replica_id)
+    _wu64(b, shard_id)
+    _wu64(b, replica_id)
     _w_snapshot(b, ss)
     return b.getvalue()
 
 
 def _encode_bootstrap(shard_id: int, replica_id: int, bs: Bootstrap) -> bytes:
     b = BytesIO()
-    _wi(b, shard_id)
-    _wi(b, replica_id)
+    _wu64(b, shard_id)
+    _wu64(b, replica_id)
     b.write(struct.pack("<I", len(bs.addresses)))
     for rid in sorted(bs.addresses):
-        _wi(b, rid)
+        _wu64(b, rid)
         _ws(b, bs.addresses[rid])
     b.write(struct.pack("<B", int(bs.join)))
     return b.getvalue()
@@ -119,16 +119,16 @@ def _encode_bootstrap(shard_id: int, replica_id: int, bs: Bootstrap) -> bytes:
 
 def _encode_pair_index(shard_id: int, replica_id: int, index: int) -> bytes:
     b = BytesIO()
-    _wi(b, shard_id)
-    _wi(b, replica_id)
-    _wi(b, index)
+    _wu64(b, shard_id)
+    _wu64(b, replica_id)
+    _wu64(b, index)
     return b.getvalue()
 
 
 def _encode_pair(shard_id: int, replica_id: int) -> bytes:
     b = BytesIO()
-    _wi(b, shard_id)
-    _wi(b, replica_id)
+    _wu64(b, shard_id)
+    _wu64(b, replica_id)
     return b.getvalue()
 
 
@@ -273,8 +273,8 @@ class TanLogDB(ILogDB):
     def _apply_record(self, kind: int, body: bytes) -> None:
         r = _R(body)
         if kind == K_STATE_ENTRIES:
-            shard_id, replica_id = r.i64(), r.i64()
-            state = State(term=r.i64(), vote=r.i64(), commit=r.i64())
+            shard_id, replica_id = r.u64(), r.u64()
+            state = State(term=r.u64(), vote=r.u64(), commit=r.u64())
             entries = tuple(_r_entry(r) for _ in range(r.count()))
             ss = _r_snapshot(r) if r.u8() else Snapshot()
             u = Update(shard_id=shard_id, replica_id=replica_id)
@@ -283,26 +283,26 @@ class TanLogDB(ILogDB):
             u.snapshot = ss
             self._mirror.save_raft_state([u], 0)
         elif kind == K_SNAPSHOT:
-            shard_id, replica_id = r.i64(), r.i64()
+            shard_id, replica_id = r.u64(), r.u64()
             ss = _r_snapshot(r)
             u = Update(shard_id=shard_id, replica_id=replica_id)
             u.snapshot = ss
             self._mirror.save_snapshots([u])
         elif kind == K_BOOTSTRAP:
-            shard_id, replica_id = r.i64(), r.i64()
+            shard_id, replica_id = r.u64(), r.u64()
             addresses = {}
             for _ in range(r.count()):
-                rid = r.i64()
+                rid = r.u64()
                 addresses[rid] = r.s()
             join = bool(r.u8())
             self._mirror.save_bootstrap_info(
                 shard_id, replica_id, Bootstrap(addresses=addresses, join=join)
             )
         elif kind == K_REMOVE_TO:
-            shard_id, replica_id, index = r.i64(), r.i64(), r.i64()
+            shard_id, replica_id, index = r.u64(), r.u64(), r.u64()
             self._mirror.remove_entries_to(shard_id, replica_id, index)
         elif kind == K_REMOVE_NODE:
-            shard_id, replica_id = r.i64(), r.i64()
+            shard_id, replica_id = r.u64(), r.u64()
             self._mirror.remove_node_data(shard_id, replica_id)
         else:
             raise WireError(f"unknown record kind {kind}")
@@ -311,13 +311,13 @@ class TanLogDB(ILogDB):
     def _frame(self, recs: List[tuple]) -> bytes:
         buf = BytesIO()
         for kind, body in recs:
-            # Never compress a body larger than the replay-side decompress
-            # bound: replay rejects records that inflate past MAX_PAYLOAD,
-            # so a compressed oversize record would write fine and then make
-            # the WAL permanently unopenable. Stored raw it replays fine.
-            if self.compression and len(body) <= MAX_PAYLOAD:
+            if self.compression:
+                # max_out = the replay-side decompress bound: a compressed
+                # oversize record would write fine and then make the WAL
+                # permanently unopenable; stored raw it replays fine
                 kind, body = maybe_compress(
-                    kind, body, K_COMPRESSED, COMPRESS_THRESHOLD
+                    kind, body, K_COMPRESSED, COMPRESS_THRESHOLD,
+                    max_out=MAX_PAYLOAD,
                 )
             buf.write(_REC_HEADER.pack(kind, len(body), zlib.crc32(body)))
             buf.write(body)

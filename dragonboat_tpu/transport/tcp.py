@@ -55,6 +55,11 @@ def parse_address(addr: str) -> tuple:
 
 
 def _write_frame(sock, kind: int, payload: bytes) -> None:
+    if len(payload) > MAX_PAYLOAD:
+        # fail locally: the receiver would reject the frame (raw) or the
+        # decompressed payload (compressed) and tear the connection down,
+        # and the raft layer would retry the same batch forever
+        raise WireError(f"payload too large to send: {len(payload)}")
     kind, payload = wire_mod.maybe_compress(
         kind, payload, KIND_COMPRESSED, WIRE_COMPRESS_THRESHOLD
     )

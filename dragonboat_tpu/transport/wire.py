@@ -47,7 +47,9 @@ WIRE_COMPRESS_THRESHOLD = 1024
 MAX_PAYLOAD = 256 * 1024 * 1024
 MAX_ITEMS = 1 << 20
 
-_i64 = struct.Struct("<q")
+# all protocol integers are uint64, like the reference's raftpb (session
+# series ids use the top of the range, e.g. SERIES_ID_REGISTER)
+_u64 = struct.Struct("<Q")
 _u32 = struct.Struct("<I")
 _u8 = struct.Struct("<B")
 
@@ -56,11 +58,21 @@ class WireError(Exception):
     """Malformed or out-of-bounds wire data."""
 
 
-def maybe_compress(kind: int, payload: bytes, flag: int, threshold: int):
+def maybe_compress(
+    kind: int,
+    payload: bytes,
+    flag: int,
+    threshold: int,
+    max_out: int = MAX_PAYLOAD,
+):
     """Adaptive compression shared by the TCP framing and the tan WAL:
     payloads over ``threshold`` that actually shrink get ``flag`` OR'd
-    into the kind byte (reference: EntryCompression [U])."""
-    if len(payload) >= threshold:
+    into the kind byte (reference: EntryCompression [U]).
+
+    Never compresses past ``max_out``, the decode side's
+    bounded_decompress limit — a compressed payload that inflates beyond
+    it would encode fine and then fail on every decode."""
+    if threshold <= len(payload) <= max_out:
         z = zlib.compress(payload, 1)  # speed level: hot paths
         if len(z) < len(payload):
             return kind | flag, z
@@ -85,8 +97,8 @@ def bounded_decompress(payload: bytes, max_out: int) -> bytes:
 # ---------------------------------------------------------------------------
 # primitives
 # ---------------------------------------------------------------------------
-def _wi(b: BytesIO, v: int) -> None:
-    b.write(_i64.pack(v))
+def _wu64(b: BytesIO, v: int) -> None:
+    b.write(_u64.pack(v))
 
 
 def _wu32(b: BytesIO, v: int) -> None:
@@ -120,8 +132,8 @@ class _R:
         self.pos += n
         return out
 
-    def i64(self) -> int:
-        return _i64.unpack(self.take(8))[0]
+    def u64(self) -> int:
+        return _u64.unpack(self.take(8))[0]
 
     def u32(self) -> int:
         return _u32.unpack(self.take(4))[0]
@@ -149,24 +161,24 @@ class _R:
 # entries / membership / snapshots
 # ---------------------------------------------------------------------------
 def _w_entry(b: BytesIO, e: Entry) -> None:
-    _wi(b, e.term)
-    _wi(b, e.index)
+    _wu64(b, e.term)
+    _wu64(b, e.index)
     _wu8(b, int(e.type))
-    _wi(b, e.key)
-    _wi(b, e.client_id)
-    _wi(b, e.series_id)
-    _wi(b, e.responded_to)
+    _wu64(b, e.key)
+    _wu64(b, e.client_id)
+    _wu64(b, e.series_id)
+    _wu64(b, e.responded_to)
     _wb(b, e.cmd)
 
 
 def _r_entry(r: _R) -> Entry:
-    term = r.i64()
-    index = r.i64()
+    term = r.u64()
+    index = r.u64()
     etype = EntryType(r.u8())
-    key = r.i64()
-    client_id = r.i64()
-    series_id = r.i64()
-    responded_to = r.i64()
+    key = r.u64()
+    client_id = r.u64()
+    series_id = r.u64()
+    responded_to = r.u64()
     cmd = r.blob()
     return Entry(
         term=term,
@@ -183,30 +195,30 @@ def _r_entry(r: _R) -> Entry:
 def _w_addr_map(b: BytesIO, m: dict) -> None:
     _wu32(b, len(m))
     for rid in sorted(m):
-        _wi(b, rid)
+        _wu64(b, rid)
         _ws(b, m[rid])
 
 
 def _r_addr_map(r: _R) -> dict:
-    return {r.i64(): r.s() for _ in range(r.count())}
+    return {r.u64(): r.s() for _ in range(r.count())}
 
 
 def _w_membership(b: BytesIO, m: Membership) -> None:
-    _wi(b, m.config_change_id)
+    _wu64(b, m.config_change_id)
     _w_addr_map(b, m.addresses)
     _w_addr_map(b, m.non_votings)
     _w_addr_map(b, m.witnesses)
     _wu32(b, len(m.removed))
     for rid in sorted(m.removed):
-        _wi(b, rid)
+        _wu64(b, rid)
 
 
 def _r_membership(r: _R) -> Membership:
-    ccid = r.i64()
+    ccid = r.u64()
     addresses = _r_addr_map(r)
     non_votings = _r_addr_map(r)
     witnesses = _r_addr_map(r)
-    removed = {r.i64(): True for _ in range(r.count())}
+    removed = {r.u64(): True for _ in range(r.count())}
     return Membership(
         config_change_id=ccid,
         addresses=addresses,
@@ -218,21 +230,21 @@ def _r_membership(r: _R) -> Membership:
 
 def _w_snapshot(b: BytesIO, s: Snapshot) -> None:
     _ws(b, s.filepath)
-    _wi(b, s.file_size)
-    _wi(b, s.index)
-    _wi(b, s.term)
+    _wu64(b, s.file_size)
+    _wu64(b, s.index)
+    _wu64(b, s.term)
     _w_membership(b, s.membership)
     _wu32(b, len(s.files))
     for f in s.files:
-        _wi(b, f.file_id)
+        _wu64(b, f.file_id)
         _ws(b, f.filepath)
-        _wi(b, f.file_size)
+        _wu64(b, f.file_size)
         _wb(b, f.metadata)
     _wb(b, s.checksum)
     _wu8(b, int(s.dummy))
-    _wi(b, s.shard_id)
-    _wi(b, s.replica_id)
-    _wi(b, s.on_disk_index)
+    _wu64(b, s.shard_id)
+    _wu64(b, s.replica_id)
+    _wu64(b, s.on_disk_index)
     _wu8(b, int(s.witness))
     _wu8(b, int(s.imported))
     _wu8(b, s.type)
@@ -241,24 +253,24 @@ def _w_snapshot(b: BytesIO, s: Snapshot) -> None:
 
 def _r_snapshot(r: _R) -> Snapshot:
     filepath = r.s()
-    file_size = r.i64()
-    index = r.i64()
-    term = r.i64()
+    file_size = r.u64()
+    index = r.u64()
+    term = r.u64()
     membership = _r_membership(r)
     files = tuple(
         SnapshotFile(
-            file_id=r.i64(),
+            file_id=r.u64(),
             filepath=r.s(),
-            file_size=r.i64(),
+            file_size=r.u64(),
             metadata=r.blob(),
         )
         for _ in range(r.count())
     )
     checksum = r.blob()
     dummy = bool(r.u8())
-    shard_id = r.i64()
-    replica_id = r.i64()
-    on_disk_index = r.i64()
+    shard_id = r.u64()
+    replica_id = r.u64()
+    on_disk_index = r.u64()
     witness = bool(r.u8())
     imported = bool(r.u8())
     stype = r.u8()
@@ -299,7 +311,7 @@ def _w_message(b: BytesIO, m: Message) -> None:
         m.hint,
         m.hint_high,
     ):
-        _wi(b, v)
+        _wu64(b, v)
     _wu32(b, len(m.entries))
     for e in m.entries:
         _w_entry(b, e)
@@ -313,7 +325,7 @@ def _r_message(r: _R) -> Message:
     mtype = MessageType(r.u8())
     reject = bool(r.u8())
     to, from_, shard_id, term, log_term, log_index, commit, hint, hint_high = (
-        r.i64() for _ in range(9)
+        r.u64() for _ in range(9)
     )
     entries = tuple(_r_entry(r) for _ in range(r.count()))
     snapshot = _r_snapshot(r) if r.u8() else Snapshot()
@@ -340,7 +352,7 @@ def _r_message(r: _R) -> Message:
 def encode_batch(batch: MessageBatch) -> bytes:
     b = BytesIO()
     _ws(b, batch.source_address)
-    _wi(b, batch.deployment_id)
+    _wu64(b, batch.deployment_id)
     _wu32(b, batch.bin_ver)
     _wu32(b, len(batch.messages))
     for m in batch.messages:
@@ -351,7 +363,7 @@ def encode_batch(batch: MessageBatch) -> bytes:
 def decode_batch(data: bytes) -> MessageBatch:
     r = _R(data)
     source_address = r.s()
-    deployment_id = r.i64()
+    deployment_id = r.u64()
     bin_ver = r.u32()
     messages = tuple(_r_message(r) for _ in range(r.count()))
     if r.pos != len(data):
@@ -392,7 +404,7 @@ def encode_chunk(c: Chunk) -> bytes:
         c.term,
         c.message_term,
     ):
-        _wi(b, v)
+        _wu64(b, v)
     _wb(b, c.data)
     _w_membership(b, c.membership)
     return b.getvalue()
@@ -410,7 +422,7 @@ def decode_chunk(data: bytes) -> Chunk:
         index,
         term,
         message_term,
-    ) = (r.i64() for _ in range(9))
+    ) = (r.u64() for _ in range(9))
     payload = r.blob()
     membership = _r_membership(r)
     if r.pos != len(data):

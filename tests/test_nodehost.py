@@ -266,20 +266,19 @@ class TestSnapshotAndRestart:
         s = nh.get_noop_session(1)
         for i in range(5):
             nh.sync_propose(s, set_cmd(f"r{i}", b"v"))
-        # crash replica 3's nodehost, keep its "disk" (logdb instance)
-        logdb3 = cluster[3].logdb
+        # crash replica 3's nodehost; its "disk" is the real default tan
+        # WAL under /tmp/nh-3 (durable by default, like the reference)
         cluster[3].close()
         # cluster continues with quorum 2 (retry: the dead replica may have
         # been the leader, so the first attempts can land on a dead forward)
         propose_r(nh, s, set_cmd("while-down", b"v"))
-        # restart replica 3 on the same logdb
+        # restart replica 3 on the same dir: the WAL replays
         cfg = NodeHostConfig(
             nodehost_dir="/tmp/nh-3",
             rtt_millisecond=2,
             raft_address=ADDRS[3],
             expert=ExpertConfig(
                 engine=EngineConfig(exec_shards=2, apply_shards=2),
-                logdb_factory=lambda c: logdb3,
             ),
         )
         nh3 = NodeHost(cfg)
@@ -302,16 +301,15 @@ class TestSnapshotAndRestart:
         s = nh.get_noop_session(1)
         for i in range(20):
             nh.sync_propose(s, set_cmd(f"z{i}", b"v"))
-        logdb1 = cluster[1].logdb
         nh.sync_request_snapshot(1, compaction_overhead=2)
         cluster[1].close()
+        # restart on the same dir: default tan WAL + snapshot dir recover
         cfg = NodeHostConfig(
             nodehost_dir="/tmp/nh-1",
             rtt_millisecond=2,
             raft_address=ADDRS[1],
             expert=ExpertConfig(
                 engine=EngineConfig(exec_shards=2, apply_shards=2),
-                logdb_factory=lambda c: logdb1,
             ),
         )
         nh1 = NodeHost(cfg)
@@ -365,6 +363,52 @@ class TestSnapshotCatchUp:
             assert nhf.stale_read(1, "post4") == b"v"  # via tail replication
         finally:
             cluster[fid] = nhf
+
+
+class TestDurableByDefault:
+    def test_default_logdb_survives_process_restart(self):
+        """A NodeHost built with a default ExpertConfig must be durable
+        (the reference's default LogDB is tan): acked writes survive a
+        full close + fresh NodeHost over the same dir.  Volatile storage
+        is opt-in via in_mem_logdb_factory."""
+        import shutil
+
+        reset_inproc_network()
+        shutil.rmtree("/tmp/nh-durable", ignore_errors=True)
+
+        def mk():
+            return NodeHost(
+                NodeHostConfig(
+                    nodehost_dir="/tmp/nh-durable",
+                    rtt_millisecond=2,
+                    raft_address="nh-durable",
+                    expert=ExpertConfig(
+                        engine=EngineConfig(exec_shards=1, apply_shards=1)
+                    ),
+                )
+            )
+
+        members = {1: "nh-durable"}
+        nh = mk()
+        try:
+            nh.start_replica(members, False, KVStore, shard_config(1))
+            wait_for_leader({1: nh})
+            s = nh.get_noop_session(1)
+            propose_r(nh, s, set_cmd("persist-me", b"yes"))
+        finally:
+            nh.close()
+        nh2 = mk()
+        try:
+            nh2.start_replica(members, False, KVStore, shard_config(1))
+            wait_for_leader({1: nh2})
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if nh2.stale_read(1, "persist-me") == b"yes":
+                    break
+                time.sleep(0.02)
+            assert nh2.stale_read(1, "persist-me") == b"yes"
+        finally:
+            nh2.close()
 
 
 class TestLeaderTransfer:

@@ -49,10 +49,13 @@ def sample_message(**kw):
         log_index=11,
         commit=10,
         hint=123456789012345,
-        hint_high=-42,
+        hint_high=0xFFFFFFFFFFFFFFFD,  # top of the u64 range (SERIES_ID_REGISTER-like)
         entries=(
             Entry(term=3, index=12, cmd=b"hello", key=99, client_id=5, series_id=1),
-            Entry(term=3, index=13, type=EntryType.CONFIG_CHANGE, cmd=b"\x00\x01"),
+            # session-register entries carry u64-max-range series ids; the
+            # codec must be unsigned end to end or these overflow
+            Entry(term=3, index=13, client_id=7, series_id=0xFFFFFFFFFFFFFFFD),
+            Entry(term=3, index=14, type=EntryType.CONFIG_CHANGE, cmd=b"\x00\x01"),
         ),
         **kw,
     )

@@ -203,6 +203,38 @@ class TestVectorCluster:
             raise AssertionError("restarted replica never caught up")
 
 
+class TestDivergenceFailStop:
+    def test_device_host_divergence_halts_replica(self, vcluster):
+        """If a materialized device row's last_index disagrees with the
+        host log, the reconstruction invariant broke — the replica must
+        fail-stop (like snapshot-recovery failure), not keep acking."""
+        wait_for_leader(vcluster)
+        nh = vcluster[1]
+        s = nh.get_noop_session(1)
+        propose_r(nh, s, set_cmd("pre", b"1"))
+        eng = nh.engine.step_engine
+        node = nh._nodes[1]
+        # wait until the row is device-resident (clean)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            with eng._lock:
+                g = eng._row_of.get(1)
+                if g is not None and not eng._meta[g].dirty:
+                    break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("row never became device-resident")
+        # corrupt the host log's view out from under the device row (lie
+        # about last_index), then force a materialization
+        with eng._lock:
+            real_last = node.peer.raft.log.last_index()
+            node.peer.raft.log.last_index = lambda: real_last + 7
+            eng._meta[g].dirty = True
+            eng._materialize_rows([g])
+        assert node.stopped, "divergence did not halt the replica"
+        assert eng.stats["divergence_halts"] >= 1
+
+
 class TestVectorQuiesce:
     def test_idle_shard_quiesces_on_device(self):
         """Quiesce-enabled rows stay device-resident: after the idle
