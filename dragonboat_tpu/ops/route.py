@@ -1,0 +1,340 @@
+"""Device-side message routing: outbox -> co-located peer inboxes.
+
+The reference's step workers hand every outbound message to the
+transport, even when the destination replica lives in the same process
+(reference: engine.go stepWorkerMain -> transport.Send [U]; the in-proc
+loopback only short-circuits the socket).  On TPU that host detour is
+the scaling bottleneck: at 100k groups x 3 replicas every row's traffic
+would round-trip device->host->device each step.
+
+``route`` keeps intra-device traffic ON the device: messages in a
+``DeviceOut`` buffer whose destination replica is resident on the same
+chip are scattered straight into the next step's ``Inbox``.  Combined
+with ``ops/kernel.step`` this closes the loop — elections, replication
+and commit advance run entirely device-side, which is what the
+consensus benchmark (bench.py) measures.
+
+Routing is **best-effort**: anything the router cannot deliver (peer
+off-device, per-sender slot budget exhausted, REPLICATE entries no
+longer reconstructible from the sender's ring) is DROPPED and counted.
+Raft tolerates arbitrary message loss — drops cost retries, never
+safety — so the fast path needs no overflow side-channel.
+
+Slot assignment is direct-mapped, not sorted: the inbox is laid out as
+
+    [0, base)                      host/injected slots (ticks, proposals)
+    [base + r*budget, +budget)     messages from the sender holding slot
+                                   r in the DESTINATION row's peer table
+
+so a message's target slot is a pure per-message computation (one
+cumulative count per sender), with no cross-row sort.  Per-sender
+in-order delivery is preserved; ``base + P*budget <= M`` must hold.
+
+Static tables (host-precomputed, see ``build_route_tables``):
+  dest_row[g, p]      device row hosting (shard_id[g], peer_id[g, p]),
+                      -1 when that replica is not on this device/shard
+  rank_in_dest[g, p]  the slot index row g's replica occupies in THAT
+                      row's peer table (the region selector above)
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import (
+    DeviceOut,
+    DeviceState,
+    F_COMMIT,
+    F_HINT,
+    F_HINT_HIGH,
+    F_LOG_INDEX,
+    F_LOG_TERM,
+    F_MTYPE,
+    F_N_ENTRIES,
+    F_REJECT,
+    F_TERM,
+    F_TO,
+    I32,
+    Inbox,
+    MT_PROPOSE,
+    MT_REPLICATE,
+    MT_TICK,
+    ROLE_LEADER,
+)
+
+
+class RouteStats(NamedTuple):
+    """Per-call routing outcome counters (all scalars)."""
+
+    delivered: jnp.ndarray
+    dropped_off_device: jnp.ndarray   # destination replica not resident
+    dropped_budget: jnp.ndarray       # per-sender region full
+    dropped_ring: jnp.ndarray         # REPLICATE entries aged out of ring
+    suppressed: jnp.ndarray           # messages of escalated source rows
+
+    def __add__(self, other: "RouteStats") -> "RouteStats":
+        return RouteStats(*(a + b for a, b in zip(self, other)))
+
+
+def build_route_tables(
+    shard_ids: np.ndarray,
+    replica_ids: np.ndarray,
+    peer_ids: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side precompute of (dest_row, rank_in_dest) for a row layout.
+
+    Rows are identified by (shard, replica); a peer slot whose replica is
+    not hosted in this layout routes to -1 (off-device -> transport).
+    """
+    G, P = peer_ids.shape
+    row_of: Dict[Tuple[int, int], int] = {
+        (int(s), int(r)): g
+        for g, (s, r) in enumerate(zip(shard_ids, replica_ids))
+    }
+    # per-row {pid: slot} so rank lookup is O(1), not a nonzero scan
+    slot_of = [
+        {int(pid): p for p, pid in enumerate(row) if pid}
+        for row in peer_ids
+    ]
+    dest_row = np.full((G, P), -1, np.int32)
+    rank_in_dest = np.zeros((G, P), np.int32)
+    for g in range(G):
+        shard = int(shard_ids[g])
+        me = int(replica_ids[g])
+        for p in range(P):
+            pid = int(peer_ids[g, p])
+            if pid == 0:
+                continue
+            d = row_of.get((shard, pid))
+            if d is None:
+                continue
+            mine = slot_of[d].get(me)
+            if mine is None:
+                # destination doesn't know us (mid-membership-change):
+                # no slot region is ours, and borrowing rank 0 would
+                # silently collide with the real rank-0 sender — leave
+                # it off-device so the drop is counted (or the host
+                # transport carries it)
+                continue
+            dest_row[g, p] = d
+            rank_in_dest[g, p] = mine
+    return dest_row, rank_in_dest
+
+
+def route(
+    state: DeviceState,
+    out: DeviceOut,
+    dest_row: jnp.ndarray,
+    rank_in_dest: jnp.ndarray,
+    *,
+    M: int,
+    E: int,
+    budget: int,
+    base: int,
+    base_inbox: Optional[Inbox] = None,
+    suppress: Optional[jnp.ndarray] = None,
+) -> Tuple[Inbox, RouteStats]:
+    """Scatter ``out``'s messages into a fresh (or prefilled) Inbox.
+
+    ``state`` must be the POST-step state of the sending rows: REPLICATE
+    payloads are reconstructed from the sender's log-term ring, which
+    holds the entries appended in the step that emitted the message.
+    ``suppress`` masks source rows whose device effects were discarded
+    (escalations): their messages must not be delivered.
+    """
+    G, O, _ = out.buf.shape
+    P = state.P
+    W = state.W
+    if base + P * budget > M:
+        raise ValueError(
+            f"inbox too small: base={base} + P={P} * budget={budget} > M={M}"
+        )
+
+    buf = out.buf
+    mtype = buf[:, :, F_MTYPE]
+    to = buf[:, :, F_TO]
+    n_ent = buf[:, :, F_N_ENTRIES]
+    log_index = buf[:, :, F_LOG_INDEX]
+
+    valid = jnp.arange(O)[None, :] < out.count[:, None]
+    n_suppressed = jnp.zeros((), I32)
+    if suppress is not None:
+        n_suppressed = jnp.sum(
+            valid & suppress[:, None], dtype=I32
+        )
+        valid = valid & ~suppress[:, None]
+
+    # destination peer slot in the SENDER's table
+    hits = (
+        (state.peer_id[:, None, :] == to[:, :, None])
+        & (to[:, :, None] != 0)
+        & (state.peer_id[:, None, :] != 0)
+    )  # [G, O, P]
+    found = jnp.any(hits, axis=2)
+    p_star = jnp.argmax(hits, axis=2).astype(I32)  # [G, O]
+
+    dest = jnp.take_along_axis(dest_row, p_star, axis=1)      # [G, O]
+    rank = jnp.take_along_axis(rank_in_dest, p_star, axis=1)  # [G, O]
+
+    routable = valid & found
+    on_device = routable & (dest >= 0)
+
+    # per-sender emission index toward each peer slot (exclusive count)
+    oh = (hits & valid[:, :, None]).astype(I32)               # [G, O, P]
+    k_excl = jnp.cumsum(oh, axis=1) - oh
+    k = jnp.take_along_axis(k_excl, p_star[:, :, None], axis=2)[:, :, 0]
+    in_budget = k < budget
+
+    # REPLICATE entry reconstruction from the sender's ring
+    is_repl = mtype == MT_REPLICATE
+    carries = is_repl & (n_ent > 0)
+    win_lo = jnp.maximum(state.first_index, state.last_index - (W - 1))
+    ring_ok = ~carries | (
+        (log_index + 1 >= win_lo[:, None])
+        & (log_index + n_ent <= state.last_index[:, None])
+    )
+
+    keep = on_device & in_budget & ring_ok
+    slot_final = base + rank * budget + k                     # [G, O]
+    didx = jnp.where(keep, dest, G)  # G = out-of-bounds -> mode='drop'
+
+    if base_inbox is None:
+        zm = jnp.zeros((G, M), I32)
+        base_inbox = Inbox(
+            mtype=zm, from_id=zm, term=zm, log_term=zm, log_index=zm,
+            commit=zm, reject=zm, hint=zm, hint_high=zm, n_entries=zm,
+            ent_term=jnp.zeros((G, M, E), I32),
+            ent_cc=jnp.zeros((G, M, E), I32),
+        )
+
+    def put(dst, val):
+        return dst.at[didx, slot_final].set(val, mode="drop")
+
+    # gather the sender's ring terms/cc for carried entries
+    idxs = log_index[:, :, None] + 1 + jnp.arange(E)[None, None, :]
+    pos = (jnp.clip(idxs, 0, None) & (W - 1)).reshape(G, O * E)
+    ent_term = jnp.take_along_axis(state.ring_term, pos, axis=1).reshape(
+        G, O, E
+    )
+    ent_cc = jnp.take_along_axis(state.ring_cc, pos, axis=1).reshape(G, O, E)
+    ent_mask = carries[:, :, None] & (
+        jnp.arange(E)[None, None, :] < n_ent[:, :, None]
+    )
+    ent_term = jnp.where(ent_mask, ent_term, 0)
+    ent_cc = jnp.where(ent_mask, ent_cc, 0)
+
+    inbox = Inbox(
+        mtype=put(base_inbox.mtype, mtype),
+        from_id=put(
+            base_inbox.from_id,
+            jnp.broadcast_to(state.replica_id[:, None], (G, O)),
+        ),
+        term=put(base_inbox.term, buf[:, :, F_TERM]),
+        log_term=put(base_inbox.log_term, buf[:, :, F_LOG_TERM]),
+        log_index=put(base_inbox.log_index, log_index),
+        commit=put(base_inbox.commit, buf[:, :, F_COMMIT]),
+        reject=put(base_inbox.reject, buf[:, :, F_REJECT]),
+        hint=put(base_inbox.hint, buf[:, :, F_HINT]),
+        hint_high=put(base_inbox.hint_high, buf[:, :, F_HINT_HIGH]),
+        n_entries=put(base_inbox.n_entries, n_ent),
+        ent_term=base_inbox.ent_term.at[didx, slot_final].set(
+            ent_term, mode="drop"
+        ),
+        ent_cc=base_inbox.ent_cc.at[didx, slot_final].set(
+            ent_cc, mode="drop"
+        ),
+    )
+    stats = RouteStats(
+        delivered=jnp.sum(keep, dtype=I32),
+        dropped_off_device=jnp.sum(routable & (dest < 0), dtype=I32),
+        dropped_budget=jnp.sum(on_device & ~in_budget, dtype=I32),
+        dropped_ring=jnp.sum(
+            on_device & in_budget & ~ring_ok, dtype=I32
+        ),
+        suppressed=n_suppressed,
+    )
+    return inbox, stats
+
+
+def make_prefill(
+    state: DeviceState,
+    M: int,
+    E: int,
+    *,
+    tick: bool = True,
+    propose_leaders: bool = False,
+    propose_n: int = 1,
+) -> Inbox:
+    """Injected inbox prefix: slot 0 = LOCAL_TICK for every row, slot 1 =
+    a ``propose_n``-entry PROPOSE on rows currently leading (the bench's
+    load generator; empty slots stay NO_OP and cost nothing)."""
+    G = state.G
+
+    def zm():
+        # distinct buffers per field: aliased zeros break donate_argnums
+        # (XLA rejects donating the same buffer twice)
+        return jnp.zeros((G, M), I32)
+
+    mtype = zm()
+    n_entries = zm()
+    if tick:
+        mtype = mtype.at[:, 0].set(MT_TICK)
+    if propose_leaders:
+        lead = state.role == ROLE_LEADER
+        mtype = mtype.at[:, 1].set(jnp.where(lead, MT_PROPOSE, 0))
+        n_entries = n_entries.at[:, 1].set(jnp.where(lead, propose_n, 0))
+    return Inbox(
+        mtype=mtype, from_id=zm(), term=zm(), log_term=zm(),
+        log_index=zm(), commit=zm(), reject=zm(), hint=zm(),
+        hint_high=zm(), n_entries=n_entries,
+        ent_term=jnp.zeros((G, M, E), I32),
+        ent_cc=jnp.zeros((G, M, E), I32),
+    )
+
+
+def routed_round(
+    state: DeviceState,
+    inbox: Inbox,
+    dest_row: jnp.ndarray,
+    rank_in_dest: jnp.ndarray,
+    *,
+    out_capacity: int,
+    budget: int,
+    base: int,
+    propose_leaders: bool = False,
+    propose_n: int = 1,
+) -> Tuple[DeviceState, Inbox, RouteStats, jnp.ndarray]:
+    """One full consensus round: step every row through ``inbox``, undo
+    escalated rows (their device effects are discarded, exactly the
+    host-replay contract minus the replay — dropping the inputs is
+    raft-safe message loss), then route the outboxes into the next
+    round's inbox on top of a fresh tick/proposal prefill.
+
+    Returns (state', inbox', stats, escalated_row_count).
+    """
+    from . import kernel as K
+
+    M, E = inbox.M, inbox.E
+    new_state, out = K.step(state, inbox, out_capacity=out_capacity)
+    esc = out.escalate != 0
+    n_esc = jnp.sum(esc, dtype=I32)
+    keep = ~esc
+
+    def sel(a, b):
+        m = keep.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, b, a)
+
+    state = jax.tree.map(sel, state, new_state)
+    prefill = make_prefill(
+        state, M, E,
+        propose_leaders=propose_leaders, propose_n=propose_n,
+    )
+    inbox, stats = route(
+        state, out, dest_row, rank_in_dest,
+        M=M, E=E, budget=budget, base=base,
+        base_inbox=prefill, suppress=esc,
+    )
+    return state, inbox, stats, n_esc
