@@ -39,6 +39,7 @@ from ..transport.wire import (
     maybe_compress,
 )
 from .logdb import InMemLogDB
+from .vfs import DEFAULT as OS_VFS, IVFS, OSVFS
 
 _log = get_logger("logdb")
 
@@ -143,15 +144,23 @@ class TanLogDB(ILogDB):
         gc_segments: int = DEFAULT_GC_SEGMENTS,
         use_native: Optional[bool] = None,
         compression: bool = True,
+        fs: Optional[IVFS] = None,
     ):
         self.dir = directory
         self.max_segment_bytes = max_segment_bytes
         self.gc_segments = gc_segments
         self.compression = compression
+        self.fs = fs if fs is not None else OS_VFS
         self._mirror = InMemLogDB()
         self._lock = threading.Lock()
         self._fh = None
         self._writer = None  # native group-commit writer (when available)
+        if not isinstance(self.fs, OSVFS):
+            # the native group-commit writer writes real files; a virtual
+            # fs (crash simulation) must stay on the python writer
+            if use_native:
+                raise OSError("native walwriter needs the OS filesystem")
+            use_native = False
         if use_native is None or use_native:
             from ..native import load_walwriter
 
@@ -171,14 +180,14 @@ class TanLogDB(ILogDB):
         # BOTH writer paths (python and native group-commit); raising
         # simulates an I/O failure at that point
         self.fault_hook = None
-        os.makedirs(directory, exist_ok=True)
+        self.fs.makedirs(directory)
         self._replay()
         self._open_active()
 
     # -- segment plumbing -------------------------------------------------
     def _segments(self) -> List[int]:
         out = []
-        for name in os.listdir(self.dir):
+        for name in self.fs.listdir(self.dir):
             if name.startswith(SEGMENT_PREFIX) and name.endswith(".log"):
                 try:
                     out.append(int(name[len(SEGMENT_PREFIX) : -4]))
@@ -199,7 +208,7 @@ class TanLogDB(ILogDB):
             self._writer = NativeWalWriter(path)
             self._active_bytes = self._writer.size()
         else:
-            self._fh = open(path, "ab")
+            self._fh = self.fs.open_append(path)
             self._active_bytes = self._fh.tell()
         self._sync_dir()
 
@@ -210,17 +219,11 @@ class TanLogDB(ILogDB):
             w, self._writer = self._writer, None
             w.close()
         if self._fh is not None:
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
-            self._fh.close()
-            self._fh = None
+            fh, self._fh = self._fh, None
+            fh.close()
 
     def _sync_dir(self) -> None:
-        dfd = os.open(self.dir, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        self.fs.sync_dir(self.dir)
 
     # -- replay -----------------------------------------------------------
     def _replay(self) -> None:
@@ -230,8 +233,7 @@ class TanLogDB(ILogDB):
             self._replay_segment(self._segment_path(seq), torn_ok=last)
 
     def _replay_segment(self, path: str, torn_ok: bool) -> None:
-        with open(path, "rb") as f:
-            data = f.read()
+        data = self.fs.read_file(path)
         pos = 0
         n = len(data)
         while pos < n:
@@ -264,10 +266,7 @@ class TanLogDB(ILogDB):
         replays this segment as a non-last segment (torn_ok=False) and the
         WAL becomes permanently unopenable."""
         _log.warning("%s: truncating torn tail at %d", path, pos)
-        with open(path, "r+b") as f:
-            f.truncate(pos)
-            f.flush()
-            os.fsync(f.fileno())
+        self.fs.truncate(path, pos)
         self._sync_dir()
 
     def _apply_record(self, kind: int, body: bytes) -> None:
@@ -333,10 +332,15 @@ class TanLogDB(ILogDB):
         while self._inflight:
             self._idle.wait()
 
-    def _append_records(
-        self, recs: List[tuple], sync: bool = True, rotate: bool = True
-    ) -> None:
-        """recs = [(kind, body)]; one write + one fsync for the batch."""
+    def _append_records(self, recs: List[tuple], sync: bool = True) -> None:
+        """recs = [(kind, body)]; one write + one fsync for the batch.
+
+        NEVER rotates: rotation may checkpoint-GC, which re-serializes
+        the MIRROR — callers must publish the batch to the mirror first
+        and then call ``_maybe_rotate``.  (Rotating in here once lost an
+        acked batch: the checkpoint lacked it and GC deleted the segment
+        holding its only durable copy — caught by the power-loss fuzz.)
+        """
         raw = self._frame(recs)
         if self.fault_hook is not None:
             self.fault_hook(raw)
@@ -346,13 +350,16 @@ class TanLogDB(ILogDB):
             self._writer.append(raw, sync=sync)
         else:
             self._fh.write(raw)
-            self._fh.flush()
             if sync:
-                os.fsync(self._fh.fileno())
+                self._fh.sync()
         self._active_bytes += len(raw)
+
+    def _maybe_rotate(self) -> None:
+        """Rotate once the active segment is full.  Only call with the
+        mirror already reflecting every appended record (checkpoint GC
+        serializes the mirror), and never under an in-flight append."""
         if (
-            rotate
-            and self._inflight == 0  # never swap the writer under an append
+            self._inflight == 0  # never swap the writer under an append
             and self._active_bytes >= self.max_segment_bytes
         ):
             self._rotate()
@@ -394,13 +401,13 @@ class TanLogDB(ILogDB):
                             ),
                         )
                     )
-        # a checkpoint may itself exceed the segment cap; it must never
-        # re-trigger rotation (that would recurse into another checkpoint)
-        self._append_records(recs, sync=True, rotate=False)
+        # a checkpoint may itself exceed the segment cap; _append_records
+        # never rotates, so it cannot recurse into another checkpoint
+        self._append_records(recs, sync=True)
         self._sync_dir()
         for seq in old:
             try:
-                os.unlink(self._segment_path(seq))
+                self.fs.unlink(self._segment_path(seq))
             except OSError:
                 pass
         self._sync_dir()
@@ -424,6 +431,7 @@ class TanLogDB(ILogDB):
                 [(K_BOOTSTRAP, _encode_bootstrap(shard_id, replica_id, bootstrap))]
             )
             self._mirror.save_bootstrap_info(shard_id, replica_id, bootstrap)
+            self._maybe_rotate()
 
     def get_bootstrap_info(self, shard_id, replica_id):
         return self._mirror.get_bootstrap_info(shard_id, replica_id)
@@ -436,6 +444,7 @@ class TanLogDB(ILogDB):
             with self._lock:
                 self._append_records(recs)  # ONE fsync for the whole batch
                 self._mirror.save_raft_state(updates, worker_id)
+                self._maybe_rotate()  # AFTER the mirror has the batch
             return
         # native path: the blocking (durable) append runs OUTSIDE the
         # lock so concurrent workers' batches group-commit into shared
@@ -498,6 +507,7 @@ class TanLogDB(ILogDB):
                 sync=False,  # compaction is advisory; replay just keeps more
             )
             self._mirror.remove_entries_to(shard_id, replica_id, index)
+            self._maybe_rotate()
 
     def compact_entries_to(self, shard_id, replica_id, index) -> None:
         self.remove_entries_to(shard_id, replica_id, index)
@@ -514,6 +524,7 @@ class TanLogDB(ILogDB):
             self._quiesce_appends_locked()
             self._append_records(recs)
             self._mirror.save_snapshots(updates)
+            self._maybe_rotate()
 
     def get_snapshot(self, shard_id, replica_id) -> Snapshot:
         return self._mirror.get_snapshot(shard_id, replica_id)
@@ -525,6 +536,7 @@ class TanLogDB(ILogDB):
                 [(K_REMOVE_NODE, _encode_pair(shard_id, replica_id))]
             )
             self._mirror.remove_node_data(shard_id, replica_id)
+            self._maybe_rotate()
 
     def import_snapshot(self, snapshot: Snapshot, replica_id: int) -> None:
         with self._lock:
@@ -545,6 +557,7 @@ class TanLogDB(ILogDB):
                     ),
                 ]
             )
+            self._maybe_rotate()
 
 
 def tan_logdb_factory(config) -> TanLogDB:

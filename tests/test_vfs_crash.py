@@ -1,0 +1,390 @@
+"""Power-loss crash tests: tan WAL over StrictMemFS.
+
+reference: internal/vfs MemFS strict mode [U] — the reference's storage
+suites simulate power loss by discarding everything not explicitly
+fsynced.  The fuzz here kills the WAL at EVERY kind of I/O boundary
+(create/write/sync/truncate/unlink/sync_dir, counted across segment
+rotation and checkpoint GC), tears the unsynced tail at a random byte,
+randomly keeps or discards unsynced file creates, reopens, and checks
+the durability contract:
+
+    every save that RETURNED before the crash must replay exactly;
+    the one in-flight operation may surface fully or not at all;
+    nothing else may appear.
+"""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from dragonboat_tpu.pb import Bootstrap, Entry, EntryType, Snapshot, State, Update
+from dragonboat_tpu.storage.tan import TanLogDB
+from dragonboat_tpu.storage.vfs import StrictMemFS
+
+
+class Boom(Exception):
+    """The simulated power cut."""
+
+
+# ---------------------------------------------------------------------------
+# StrictMemFS semantics
+# ---------------------------------------------------------------------------
+class TestStrictMemFS:
+    def test_unsynced_writes_can_vanish(self):
+        fs = StrictMemFS()
+        fs.makedirs("/w")
+        f = fs.open_append("/w/a")
+        f.write(b"hello")
+        f.sync()
+        f.write(b" world")  # never synced
+        fs.sync_dir("/w")
+        fs.crash(random.Random(0))
+        data = fs.read_file("/w/a")
+        assert data.startswith(b"hello")
+        assert len(data) <= len(b"hello world")
+
+    def test_synced_data_survives_any_crash(self):
+        for seed in range(20):
+            fs = StrictMemFS()
+            fs.makedirs("/w")
+            f = fs.open_append("/w/a")
+            f.write(b"durable")
+            f.sync()
+            fs.sync_dir("/w")
+            fs.crash(random.Random(seed))
+            assert fs.read_file("/w/a").startswith(b"durable")
+
+    def test_unsynced_create_never_survives_when_rng_drops(self):
+        fs = StrictMemFS()
+        fs.makedirs("/w")
+        f = fs.open_append("/w/ghost")
+        f.write(b"x")
+        f.sync()  # file data synced, but the DIRECTORY was not
+        # rng.random() >= 0.5 -> unsynced create is dropped
+        class DropAll(random.Random):
+            def random(self):
+                return 0.9
+        fs.crash(DropAll())
+        assert not fs.exists("/w/ghost")
+
+    def test_unsynced_unlink_rolls_back(self):
+        fs = StrictMemFS()
+        fs.makedirs("/w")
+        f = fs.open_append("/w/a")
+        f.write(b"keep")
+        f.sync()
+        fs.sync_dir("/w")
+        fs.unlink("/w/a")  # no sync_dir afterwards
+        fs.crash(random.Random(1))
+        assert fs.exists("/w/a")
+        assert fs.read_file("/w/a") == b"keep"
+
+    def test_synced_unlink_is_final(self):
+        fs = StrictMemFS()
+        fs.makedirs("/w")
+        f = fs.open_append("/w/a")
+        f.write(b"gone")
+        f.close()
+        fs.sync_dir("/w")
+        fs.unlink("/w/a")
+        fs.sync_dir("/w")
+        fs.crash(random.Random(2))
+        assert not fs.exists("/w/a")
+
+    def test_unsynced_rename_rolls_back(self):
+        fs = StrictMemFS()
+        fs.makedirs("/w")
+        f = fs.open_append("/w/a")
+        f.write(b"v")
+        f.close()
+        fs.sync_dir("/w")
+        fs.rename("/w/a", "/w/b")
+
+        class DropAll(random.Random):
+            def random(self):
+                return 0.9
+
+        fs.crash(DropAll())
+        assert fs.exists("/w/a") and not fs.exists("/w/b")
+
+    def test_fault_hook_fires_per_op(self):
+        fs = StrictMemFS()
+        fs.makedirs("/w")
+        ops = []
+        fs.fault_hook = lambda op, path: ops.append(op)
+        f = fs.open_append("/w/a")
+        f.write(b"x")
+        f.sync()
+        fs.sync_dir("/w")
+        assert ops == ["create", "write", "sync", "sync_dir"]
+
+
+# ---------------------------------------------------------------------------
+# tan over StrictMemFS: basic replay
+# ---------------------------------------------------------------------------
+def up(shard, replica, term, entries=(), commit=0, vote=0, snapshot=None):
+    u = Update(shard_id=shard, replica_id=replica)
+    u.state = State(term=term, vote=vote, commit=commit)
+    u.entries_to_save = list(entries)
+    if snapshot is not None:
+        u.snapshot = snapshot
+    return u
+
+
+def ent(index, term, cmd=b""):
+    return Entry(term=term, index=index, type=EntryType.APPLICATION, cmd=cmd)
+
+
+def test_tan_on_memfs_roundtrip():
+    fs = StrictMemFS()
+    db = TanLogDB("/wal", fs=fs, use_native=False)
+    db.save_bootstrap_info(1, 1, Bootstrap(addresses={1: "a1"}))
+    db.save_raft_state([up(1, 1, 2, [ent(1, 2), ent(2, 2)], commit=1)], 0)
+    db.close()
+    db2 = TanLogDB("/wal", fs=fs, use_native=False)
+    rs = db2.read_raft_state(1, 1, 0)
+    assert rs.state.term == 2 and rs.state.commit == 1
+    ents = db2.iterate_entries(1, 1, 1, 3, 2**30)
+    assert [e.index for e in ents] == [1, 2]
+    db2.close()
+
+
+def test_tan_acked_survives_torn_tail():
+    """Synced batch survives; a torn unsynced batch disappears cleanly."""
+    fs = StrictMemFS()
+    db = TanLogDB("/wal", fs=fs, use_native=False)
+    db.save_raft_state([up(1, 1, 1, [ent(1, 1)])], 0)
+    # simulate a batch whose fsync never completed: write bytes directly
+    f = fs.open_append(db._segment_path(db._active_seq))
+    f.write(b"\x01\xff\xff\xff\x7f")  # torn garbage header
+    fs.crash(random.Random(3))
+    db2 = TanLogDB("/wal", fs=fs, use_native=False)
+    rs = db2.read_raft_state(1, 1, 0)
+    assert rs.state.term == 1
+    assert [e.index for e in db2.iterate_entries(1, 1, 1, 2, 2**30)] == [1]
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# the kill-at-any-boundary fuzz
+# ---------------------------------------------------------------------------
+class Model:
+    """What the application believes is durable."""
+
+    def __init__(self):
+        self.acked = {}  # (shard, replica) -> dict(state=, entries={i: t}, compacted=, snap=)
+
+    def key(self, s, r):
+        return self.acked.setdefault(
+            (s, r),
+            {"state": State(), "entries": {}, "compacted": 0, "snap": 0},
+        )
+
+    def apply_save(self, u: Update):
+        k = self.key(u.shard_id, u.replica_id)
+        k["state"] = u.state
+        if u.entries_to_save:
+            first = u.entries_to_save[0].index
+            # conflicting tail overwrite, like the mirror
+            k["entries"] = {
+                i: t for i, t in k["entries"].items() if i < first
+            }
+            for e in u.entries_to_save:
+                k["entries"][e.index] = e.term
+        if not u.snapshot.is_empty():
+            k["snap"] = max(k["snap"], u.snapshot.index)
+
+    def apply_snap(self, u: Update):
+        # save_snapshots persists ONLY the snapshot meta, never State
+        k = self.key(u.shard_id, u.replica_id)
+        if not u.snapshot.is_empty():
+            k["snap"] = max(k["snap"], u.snapshot.index)
+
+    def apply_compact(self, s, r, index):
+        k = self.key(s, r)
+        k["compacted"] = max(k["compacted"], index)
+        k["entries"] = {
+            i: t for i, t in k["entries"].items() if i > index
+        }
+
+
+def check_against(db: TanLogDB, model_variants):
+    """The reopened WAL must match ONE of the candidate models (last
+    acked, or last acked + the in-flight op)."""
+    errors = []
+    for model in model_variants:
+        errs = []
+        for (s, r), k in model.acked.items():
+            rs = db.read_raft_state(s, r, 0)
+            if rs is None:
+                if k["state"] != State() or k["entries"]:
+                    errs.append(f"({s},{r}): missing entirely")
+                continue
+            if rs.state != k["state"]:
+                errs.append(f"({s},{r}): state {rs.state} != {k['state']}")
+            for i, t in k["entries"].items():
+                try:
+                    got = db.term(s, r, i)
+                except Exception as e:
+                    errs.append(f"({s},{r}) idx {i}: {e}")
+                    continue
+                if got != t:
+                    errs.append(f"({s},{r}) idx {i}: term {got} != {t}")
+        if not errs:
+            return  # this variant matches
+        errors.append(errs)
+    raise AssertionError(
+        "no model variant matches the replayed WAL:\n"
+        + "\n---\n".join("\n".join(e) for e in errors)
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_tan_powerloss_fuzz(seed):
+    rng = random.Random(seed)
+    fs = StrictMemFS()
+    # tiny segments force rotation + checkpoint GC under the fuzz
+    def open_db():
+        return TanLogDB(
+            "/wal", fs=fs, use_native=False,
+            max_segment_bytes=700, gc_segments=2,
+        )
+
+    db = open_db()
+    model = Model()
+    next_index = {(s, r): 1 for s in (1, 2) for r in (1,)}
+    terms = {k: 1 for k in next_index}
+
+    def random_op():
+        s, r = rng.choice(list(next_index))
+        kind = rng.randrange(10)
+        if kind < 7:
+            n = rng.randrange(1, 4)
+            if rng.random() < 0.1:
+                # term bump + conflicting tail rewrite
+                terms[(s, r)] += 1
+                base = max(
+                    model.key(s, r)["compacted"] + 1,
+                    rng.randrange(
+                        max(1, next_index[(s, r)] - 3),
+                        next_index[(s, r)] + 1,
+                    ),
+                )
+            else:
+                base = next_index[(s, r)]
+            ents = [
+                ent(base + j, terms[(s, r)], bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40))))
+                for j in range(n)
+            ]
+            next_index[(s, r)] = base + n
+            u = up(
+                s, r, terms[(s, r)], ents,
+                commit=rng.randrange(0, next_index[(s, r)]),
+                vote=r,
+            )
+            return ("save", u)
+        elif kind < 8:
+            hi = max(
+                model.key(s, r)["compacted"],
+                next_index[(s, r)] - rng.randrange(1, 5),
+            )
+            return ("compact", (s, r, hi))
+        elif kind < 9:
+            idx = next_index[(s, r)] - 1
+            if idx < 1:
+                return None
+            ss = Snapshot(index=idx, term=terms[(s, r)], shard_id=s)
+            u = up(s, r, terms[(s, r)], [], snapshot=ss)
+            return ("snap", u)
+        else:
+            return ("bootstrap", (s, r))
+
+    crashes = 0
+    ops_done = 0
+    while crashes < 6 and ops_done < 300:
+        fuse = rng.randrange(1, 25)
+        state = {"left": fuse}
+
+        def hook(op, path):
+            state["left"] -= 1
+            if state["left"] <= 0:
+                raise Boom()
+
+        fs.fault_hook = hook
+        in_flight = None
+        try:
+            while True:
+                op = random_op()
+                if op is None:
+                    continue
+                in_flight = op
+                kind, payload = op
+                if kind == "save":
+                    db.save_raft_state([payload], 0)
+                    model.apply_save(payload)
+                elif kind == "compact":
+                    db.remove_entries_to(*payload)
+                    model.apply_compact(*payload)
+                elif kind == "snap":
+                    db.save_snapshots([payload])
+                    model.apply_snap(payload)
+                else:
+                    db.save_bootstrap_info(
+                        payload[0], payload[1], Bootstrap(addresses={1: "x"})
+                    )
+                in_flight = None
+                ops_done += 1
+        except Boom:
+            crashes += 1
+            fs.fault_hook = None
+            fs.crash(rng)
+            # reopen; a double-crash during replay/repair is also legal
+            for _ in range(3):
+                try:
+                    db = open_db()
+                    break
+                except Boom:
+                    fs.crash(rng)
+            # accept: exactly-acked, or acked + the in-flight op
+            variants = [model]
+            if in_flight is not None:
+                import copy
+
+                m2 = copy.deepcopy(model)
+                kind, payload = in_flight
+                if kind == "save":
+                    m2.apply_save(payload)
+                elif kind == "snap":
+                    m2.apply_snap(payload)
+                elif kind == "compact":
+                    m2.apply_compact(*payload)
+                variants.append(m2)
+                # the in-flight op is now in neither-or-both state;
+                # adopt whichever the disk shows by re-syncing the model
+                # to the DB for entries (state check below decides)
+            check_against(db, variants)
+            # resync the model FROM the reopened db: whatever survived is
+            # now the acked baseline (in-flight adoption by heuristics is
+            # ambiguous and poisons the model; the db is ground truth,
+            # and the acked-loss invariant was already checked above)
+            model = Model()
+            for (s, r) in list(next_index):
+                rs = db.read_raft_state(s, r, 0)
+                if rs is None:
+                    next_index[(s, r)] = 1
+                    continue
+                k = model.key(s, r)
+                k["state"] = rs.state
+                first = max(rs.first_index, 1)
+                ents = db.iterate_entries(s, r, first, 1 << 40, 1 << 60)
+                k["entries"] = {e.index: e.term for e in ents}
+                ss = db.get_snapshot(s, r)
+                k["snap"] = ss.index
+                terms[(s, r)] = max(terms[(s, r)], rs.state.term)
+                # the floor below which nothing may ever be written again
+                k["compacted"] = max(first - 1, ss.index)
+                last = max(k["entries"]) if k["entries"] else k["compacted"]
+                next_index[(s, r)] = max(last, k["compacted"]) + 1
+    assert crashes >= 1, "fuzz never crashed — fuse too long?"
+    db.close()
