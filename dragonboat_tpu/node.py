@@ -8,7 +8,7 @@ only the thread-safe queues and pending tables.
 """
 from __future__ import annotations
 
-import pickle
+import os
 import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
@@ -157,6 +157,10 @@ class Node:
         # serializes apply() against stop() so the user SM is never closed
         # mid-update
         self._apply_lock = threading.Lock()
+        # held for the duration of a streamed snapshot save; stop() takes
+        # it before closing the user SM so a save never races the close
+        # (applies do NOT take it — saves must not stall the apply path)
+        self._sm_close_lock = threading.Lock()
         # set by the engine at registration; wakes the owning step worker
         self.notify_work: Optional[Callable[[], None]] = None
 
@@ -193,10 +197,7 @@ class Node:
         membership: Optional[Membership] = None
         if not ss.is_empty():
             if not ss.dummy and not config.is_witness:
-                payload = self.decompress_snapshot(
-                    ss, snapshot_storage.load(ss.filepath)
-                )
-                self.sm.recover_from_snapshot_data(payload)
+                self._recover_sm_from_storage(ss)
             else:
                 self.sm.last_applied = max(self.sm.last_applied, ss.index)
             membership = ss.membership
@@ -577,14 +578,44 @@ class Node:
             elif e.key:
                 self.pending_proposal.applied(e.key, r.result, r.rejected)
 
+    def _recover_sm_from_storage(self, ss: Snapshot) -> None:
+        """Open the v2 container and restore the SM + sessions +
+        membership through it, resolving external files to absolute
+        paths in the snapshot dir (reference: rsm recover +
+        ISnapshotFileCollection restore [U])."""
+        import dataclasses
+
+        from .storage.snapshotio import SnapshotReader
+
+        f = self.snapshot_storage.open_read(ss.filepath)
+        try:
+            reader = SnapshotReader(f)
+            files = [
+                dataclasses.replace(
+                    sf,
+                    filepath=self.snapshot_storage.external_path(
+                        ss.filepath, sf.filepath
+                    ),
+                )
+                for sf in reader.external_files
+            ]
+            for sf in files:
+                if not os.path.exists(sf.filepath):
+                    raise IOError(
+                        f"snapshot external file missing: {sf.filepath}"
+                    )
+            self.sm.recover_from_snapshot_stream(reader, files)
+        finally:
+            f.close()
+
     def _recover_from_snapshot(self, ss: Snapshot) -> None:
         if ss.dummy or self.config.is_witness:
             self.sm.last_applied = max(self.sm.last_applied, ss.index)
             self.sm.members.restore(ss.membership)
             return
         try:
-            payload = self.snapshot_storage.load(ss.filepath)
-        except (FileNotFoundError, IOError) as e:
+            self._recover_sm_from_storage(ss)
+        except Exception as e:  # noqa: BLE001 — any load/decode failure
             # the raft log was already reset to ss.index; applying anything
             # past it without this state would silently diverge — halt the
             # replica loudly instead (reference: dragonboat panics on
@@ -598,19 +629,6 @@ class Node:
             )
             self.stopped = True
             raise
-        try:
-            payload = self.decompress_snapshot(ss, payload)
-        except Exception as e:  # noqa: BLE001 — same contract as load failure
-            _log.critical(
-                "[%d:%d] FATAL: snapshot %d undecodable (%s); halting replica",
-                self.shard_id,
-                self.replica_id,
-                ss.index,
-                e,
-            )
-            self.stopped = True
-            raise
-        self.sm.recover_from_snapshot_data(payload)
         self._sync_registry(ss.membership)
         if self.events is not None:
             from .raftio import SnapshotInfo
@@ -622,40 +640,21 @@ class Node:
     # ------------------------------------------------------------------
     # snapshotting (step-worker context for now; dedicated workers later)
     # ------------------------------------------------------------------
-    def _compress_snapshot(self, payload: bytes):
-        """-> (bytes, CompressionType actually used).  reference: the
-        SnapshotCompression config + snappy option in snapshotio [U]."""
+    def _snapshot_compression(self):
+        """The per-block codec recorded in the container AND in the
+        Snapshot meta (reference: SnapshotCompression config [U]).
+        Compression now lives INSIDE the v2 container (per block, self-
+        describing), so cross-host recovery never depends on out-of-band
+        metadata surviving the chunk lane."""
         from .pb import CompressionType as CT
 
         want = CT(self.config.snapshot_compression)
-        if want == CT.NO_COMPRESSION:
-            return payload, CT.NO_COMPRESSION
         if want == CT.SNAPPY:
-            try:
-                import snappy  # type: ignore
+            from .storage.snapshotio import _try_snappy
 
-                return snappy.compress(payload), CT.SNAPPY
-            except ImportError:
-                pass  # record what we actually used below
-        import zlib
-
-        return zlib.compress(payload, 6), CT.ZLIB
-
-    @staticmethod
-    def decompress_snapshot(ss: Snapshot, payload: bytes) -> bytes:
-        """Inverse of _compress_snapshot, keyed by the recorded type."""
-        from .pb import CompressionType as CT
-
-        ct = CT(ss.compression)
-        if ct == CT.NO_COMPRESSION:
-            return payload
-        if ct == CT.SNAPPY:
-            import snappy  # type: ignore
-
-            return snappy.decompress(payload)
-        import zlib
-
-        return zlib.decompress(payload)
+            if _try_snappy() is None:
+                return CT.ZLIB  # meta records what is actually used
+        return want
 
     def _save_snapshot_request(self, key: int, overhead: int) -> None:
         """Save a snapshot of the current applied state and compact the log
@@ -666,30 +665,58 @@ class Node:
             return
         self._snapshotting = True
         try:
-            # _apply_lock serializes against stop(): the user SM must not be
-            # closed mid-save (stop_shard can race a step worker)
             with self._apply_lock:
                 if self.stopped:
                     if key:
                         self.pending_snapshot.done(key, 0, failed=True)
                     return
-                payload, index, term = self.sm.save_snapshot_data()
-            if index == 0:
-                if key:
-                    self.pending_snapshot.done(key, 0, failed=True)
-                return
-            prev = self.logdb.get_snapshot(self.shard_id, self.replica_id)
-            if prev.index >= index:
-                if key:
-                    self.pending_snapshot.done(key, 0, failed=True)
-                return
-            payload, compression = self._compress_snapshot(payload)
-            filepath = self.snapshot_storage.save(
-                self.shard_id, self.replica_id, index, payload
-            )
+                index = self.sm.last_applied
+                prev = self.logdb.get_snapshot(self.shard_id, self.replica_id)
+                if index == 0 or prev.index >= index:
+                    if key:
+                        self.pending_snapshot.done(key, 0, failed=True)
+                    return
+                compression = self._snapshot_compression()
+
+            def build(fileobj, copy_fn):
+                from .rsm.statemachine import SnapshotFileCollection
+
+                coll = SnapshotFileCollection(copy_fn)
+                # the SM streams through the v2 block writer with
+                # bounded memory (storage/snapshotio.py); external
+                # files are staged beside the container by copy_fn
+                return self.sm.save_snapshot_stream(
+                    fileobj,
+                    coll,
+                    compression=int(compression),
+                )
+
+            # the streamed save runs OUTSIDE _apply_lock so a long
+            # disk write never stalls the apply pipeline: regular SMs
+            # serialize under rsm._mu anyway, concurrent/on-disk SMs
+            # prepare under it and stream concurrently (reference: rsm
+            # concurrent snapshot [U]).  _sm_close_lock only excludes
+            # stop() closing the user SM mid-save.  The container's
+            # index is captured under rsm._mu inside build; the dir is
+            # named from that result, so name and content agree even
+            # when applies advance past the pre-check index.
+            with self._sm_close_lock:
+                if self.stopped:
+                    if key:
+                        self.pending_snapshot.done(key, 0, failed=True)
+                    return
+                filepath, (index, term, _files) = (
+                    self.snapshot_storage.save_stream(
+                        self.shard_id,
+                        self.replica_id,
+                        index,
+                        build,
+                        index_from_result=lambda res: res[0],
+                    )
+                )
             ss = Snapshot(
                 filepath=filepath,
-                file_size=len(payload),
+                file_size=self.snapshot_storage.file_size(filepath),
                 index=index,
                 term=term,
                 membership=self.sm.get_membership(),
@@ -762,5 +789,5 @@ class Node:
             self.snapshot_storage.remove(p)
         self._retired_snapshots = []
         # wait for any in-flight apply before closing the user SM
-        with self._apply_lock:
+        with self._apply_lock, self._sm_close_lock:
             self.sm.managed.close()

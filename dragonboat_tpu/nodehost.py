@@ -139,8 +139,8 @@ class NodeHost:
             # index must never clobber a file a queued recover task still wants
             self._rx_snapshot_seq = itertools.count(1)
             self._chunk_sink = ChunkSink(
-                save_fn=lambda s, r, i, p: self.snapshot_storage.save(
-                    s, r, i, p, suffix=f"rx{next(self._rx_snapshot_seq)}"
+                begin_fn=lambda s, r, i: self.snapshot_storage.begin_receive(
+                    s, r, i, suffix=f"rx{next(self._rx_snapshot_seq)}"
                 ),
                 deliver_fn=self._deliver_received_snapshot,
                 confirm_fn=self._confirm_received_snapshot,
@@ -162,7 +162,7 @@ class NodeHost:
                 config.raft_address,
                 config.deployment_id,
                 unreachable_cb=self._report_unreachable,
-                snapshot_payload_loader=self._load_snapshot_payload,
+                snapshot_source_opener=self._open_snapshot_source,
                 snapshot_status_cb=self._report_snapshot_status,
                 max_snapshot_send_bytes_per_second=(
                     config.max_snapshot_send_bytes_per_second
@@ -339,8 +339,10 @@ class NodeHost:
             self.engine.notify_many(touched)
 
     # -- snapshot streaming plumbing -----------------------------------
-    def _load_snapshot_payload(self, ss) -> bytes:
-        return self.snapshot_storage.load(ss.filepath)
+    def _open_snapshot_source(self, ss):
+        from .storage.snapshotter import SnapshotSource
+
+        return SnapshotSource(self.snapshot_storage, ss)
 
     def _deliver_received_snapshot(self, m: Message) -> None:
         """A fully-reassembled snapshot enters the raft path like any other

@@ -28,7 +28,9 @@ Slot assignment is direct-mapped, not sorted: the inbox is laid out as
 
 so a message's target slot is a pure per-message computation (one
 cumulative count per sender), with no cross-row sort.  Per-sender
-in-order delivery is preserved; ``base + P*budget <= M`` must hold.
+in-order delivery is preserved.  ``base + P*budget == M`` must hold
+exactly: the inbox IS the concatenation of the prefill columns and the
+per-sender regions (route() assembles it by reshape, not scatter).
 
 Static tables (host-precomputed, see ``build_route_tables``):
   dest_row[g, p]      device row hosting (shard_id[g], peer_id[g, p]),
@@ -148,10 +150,19 @@ def route(
     G, O, _ = out.buf.shape
     P = state.P
     W = state.W
-    if base + P * budget > M:
+    B = budget
+    if base + P * B != M:
         raise ValueError(
-            f"inbox too small: base={base} + P={P} * budget={budget} > M={M}"
+            f"inbox layout mismatch: base={base} + P={P} * budget={B} "
+            f"must equal M={M} (the inbox IS the region layout)"
         )
+
+    # NOTE on lowering: everything here is gathers, reductions and a
+    # reshape-concat — deliberately NO arbitrary-index scatter.  TPU
+    # lowers scatters with data-dependent indices to a serial loop (a
+    # measured ~20x slowdown of this routine at 300k rows); gathers
+    # vectorize.  The direct-mapped slot layout makes the inbox exactly
+    # ``concat([prefill, region(r=0), ..., region(r=P-1)], axis=1)``.
 
     buf = out.buf
     mtype = buf[:, :, F_MTYPE]
@@ -162,9 +173,7 @@ def route(
     valid = jnp.arange(O)[None, :] < out.count[:, None]
     n_suppressed = jnp.zeros((), I32)
     if suppress is not None:
-        n_suppressed = jnp.sum(
-            valid & suppress[:, None], dtype=I32
-        )
+        n_suppressed = jnp.sum(valid & suppress[:, None], dtype=I32)
         valid = valid & ~suppress[:, None]
 
     # destination peer slot in the SENDER's table
@@ -175,20 +184,16 @@ def route(
     )  # [G, O, P]
     found = jnp.any(hits, axis=2)
     p_star = jnp.argmax(hits, axis=2).astype(I32)  # [G, O]
-
-    dest = jnp.take_along_axis(dest_row, p_star, axis=1)      # [G, O]
-    rank = jnp.take_along_axis(rank_in_dest, p_star, axis=1)  # [G, O]
-
+    dest = jnp.take_along_axis(dest_row, p_star, axis=1)  # [G, O]
     routable = valid & found
     on_device = routable & (dest >= 0)
 
     # per-sender emission index toward each peer slot (exclusive count)
-    oh = (hits & valid[:, :, None]).astype(I32)               # [G, O, P]
+    oh = (hits & valid[:, :, None]).astype(I32)  # [G, O, P]
     k_excl = jnp.cumsum(oh, axis=1) - oh
     k = jnp.take_along_axis(k_excl, p_star[:, :, None], axis=2)[:, :, 0]
-    in_budget = k < budget
 
-    # REPLICATE entry reconstruction from the sender's ring
+    # deliverability per MESSAGE (sender side; used for selection + stats)
     is_repl = mtype == MT_REPLICATE
     carries = is_repl & (n_ent > 0)
     win_lo = jnp.maximum(state.first_index, state.last_index - (W - 1))
@@ -197,63 +202,97 @@ def route(
         & (log_index + n_ent <= state.last_index[:, None])
     )
 
-    keep = on_device & in_budget & ring_ok
-    slot_final = base + rank * budget + k                     # [G, O]
-    didx = jnp.where(keep, dest, G)  # G = out-of-bounds -> mode='drop'
+    # o_sel[g, p, b] = outbox index of g's b-th deliverable message to
+    # peer slot p (selection is pure argmax over one-hot masks, no scatter)
+    sendable = hits & (valid & ring_ok)[:, :, None]  # [G, O, P]
+    o_cols = []
+    f_cols = []
+    for b in range(B):
+        m_b = sendable & (k_excl == b)  # at most one o per (g, p)
+        f_cols.append(jnp.any(m_b, axis=1))          # [G, P]
+        o_cols.append(jnp.argmax(m_b, axis=1))       # [G, P]
+    o_sel = jnp.stack(o_cols, axis=2).astype(I32)    # [G, P, B]
+    o_found = jnp.stack(f_cols, axis=2)              # [G, P, B]
+
+    # dest-side assembly: for dest d, region r is fed by the replica in
+    # d's peer slot r; in THAT sender's table, d occupies slot
+    # rank_in_dest[d, r] (the mapping is symmetric by construction)
+    src = dest_row                                   # [G, P] (as dest view)
+    src_ok = src >= 0
+    src_c = jnp.clip(src, 0, G - 1)
+    p_back = rank_in_dest                            # [G, P]
+
+    sel_o = o_sel[src_c, p_back]                     # [G, P, B]
+    sel_found = o_found[src_c, p_back] & src_ok[:, :, None]
+    # region r of row d must not be fed by d itself (its own slot)
+    not_self = src_c != jnp.arange(G)[:, None]
+    sel_found = sel_found & not_self[:, :, None]
+
+    src_rb = jnp.broadcast_to(src_c[:, :, None], (G, P, B))
+
+    def field(col):  # [G, P, B] gather of one outbox field
+        v = buf[src_rb, sel_o, col]
+        return jnp.where(sel_found, v, 0).reshape(G, P * B)
 
     if base_inbox is None:
-        zm = jnp.zeros((G, M), I32)
-        base_inbox = Inbox(
-            mtype=zm, from_id=zm, term=zm, log_term=zm, log_index=zm,
-            commit=zm, reject=zm, hint=zm, hint_high=zm, n_entries=zm,
-            ent_term=jnp.zeros((G, M, E), I32),
-            ent_cc=jnp.zeros((G, M, E), I32),
-        )
+        base_inbox = make_prefill(state, M, E, tick=False)
+    pre = {k_: getattr(base_inbox, k_)[:, :base] for k_ in (
+        "mtype", "from_id", "term", "log_term", "log_index", "commit",
+        "reject", "hint", "hint_high", "n_entries",
+    )}
 
-    def put(dst, val):
-        return dst.at[didx, slot_final].set(val, mode="drop")
+    def asm(name, col):
+        return jnp.concatenate([pre[name], field(col)], axis=1)
 
-    # gather the sender's ring terms/cc for carried entries
-    idxs = log_index[:, :, None] + 1 + jnp.arange(E)[None, None, :]
-    pos = (jnp.clip(idxs, 0, None) & (W - 1)).reshape(G, O * E)
-    ent_term = jnp.take_along_axis(state.ring_term, pos, axis=1).reshape(
-        G, O, E
-    )
-    ent_cc = jnp.take_along_axis(state.ring_cc, pos, axis=1).reshape(G, O, E)
-    ent_mask = carries[:, :, None] & (
-        jnp.arange(E)[None, None, :] < n_ent[:, :, None]
-    )
-    ent_term = jnp.where(ent_mask, ent_term, 0)
-    ent_cc = jnp.where(ent_mask, ent_cc, 0)
+    li_rb = buf[src_rb, sel_o, F_LOG_INDEX]
+    n_rb = buf[src_rb, sel_o, F_N_ENTRIES]
+    mt_rb = buf[src_rb, sel_o, F_MTYPE]
+    # REPLICATE payload: the sender's ring terms/cc at [li+1, li+n]
+    idxs = li_rb[:, :, :, None] + 1 + jnp.arange(E)[None, None, None, :]
+    # per-element gather ring_term[src, pos] (gathers vectorize on TPU)
+    flat_src = jnp.broadcast_to(
+        src_rb[:, :, :, None], (G, P, B, E)
+    ).reshape(-1)
+    flat_pos = (jnp.clip(idxs, 0, None) & (W - 1)).reshape(-1)
+    ent_term = state.ring_term[flat_src, flat_pos].reshape(G, P, B, E)
+    ent_cc = state.ring_cc[flat_src, flat_pos].reshape(G, P, B, E)
+    ent_mask = (
+        sel_found
+        & (mt_rb == MT_REPLICATE)
+    )[:, :, :, None] & (jnp.arange(E)[None, None, None, :] < n_rb[:, :, :, None])
+    ent_term = jnp.where(ent_mask, ent_term, 0).reshape(G, P * B, E)
+    ent_cc = jnp.where(ent_mask, ent_cc, 0).reshape(G, P * B, E)
+
+    from_rb = jnp.where(
+        sel_found, state.replica_id[src_c][:, :, None], 0
+    ).reshape(G, P * B)
 
     inbox = Inbox(
-        mtype=put(base_inbox.mtype, mtype),
-        from_id=put(
-            base_inbox.from_id,
-            jnp.broadcast_to(state.replica_id[:, None], (G, O)),
+        mtype=asm("mtype", F_MTYPE),
+        from_id=jnp.concatenate([pre["from_id"], from_rb], axis=1),
+        term=asm("term", F_TERM),
+        log_term=asm("log_term", F_LOG_TERM),
+        log_index=asm("log_index", F_LOG_INDEX),
+        commit=asm("commit", F_COMMIT),
+        reject=asm("reject", F_REJECT),
+        hint=asm("hint", F_HINT),
+        hint_high=asm("hint_high", F_HINT_HIGH),
+        n_entries=asm("n_entries", F_N_ENTRIES),
+        ent_term=jnp.concatenate(
+            [base_inbox.ent_term[:, :base], ent_term], axis=1
         ),
-        term=put(base_inbox.term, buf[:, :, F_TERM]),
-        log_term=put(base_inbox.log_term, buf[:, :, F_LOG_TERM]),
-        log_index=put(base_inbox.log_index, log_index),
-        commit=put(base_inbox.commit, buf[:, :, F_COMMIT]),
-        reject=put(base_inbox.reject, buf[:, :, F_REJECT]),
-        hint=put(base_inbox.hint, buf[:, :, F_HINT]),
-        hint_high=put(base_inbox.hint_high, buf[:, :, F_HINT_HIGH]),
-        n_entries=put(base_inbox.n_entries, n_ent),
-        ent_term=base_inbox.ent_term.at[didx, slot_final].set(
-            ent_term, mode="drop"
-        ),
-        ent_cc=base_inbox.ent_cc.at[didx, slot_final].set(
-            ent_cc, mode="drop"
+        ent_cc=jnp.concatenate(
+            [base_inbox.ent_cc[:, :base], ent_cc], axis=1
         ),
     )
+    in_budget = k < B
     stats = RouteStats(
-        delivered=jnp.sum(keep, dtype=I32),
+        delivered=jnp.sum(sel_found, dtype=I32),
         dropped_off_device=jnp.sum(routable & (dest < 0), dtype=I32),
-        dropped_budget=jnp.sum(on_device & ~in_budget, dtype=I32),
-        dropped_ring=jnp.sum(
-            on_device & in_budget & ~ring_ok, dtype=I32
+        dropped_budget=jnp.sum(
+            on_device & ring_ok & ~in_budget, dtype=I32
         ),
+        dropped_ring=jnp.sum(on_device & ~ring_ok, dtype=I32),
         suppressed=n_suppressed,
     )
     return inbox, stats

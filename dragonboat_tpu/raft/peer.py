@@ -84,9 +84,11 @@ class Peer:
         )
 
     def propose_config_change(self, cc: ConfigChange, key: int) -> None:
-        import pickle
+        # positional binary, never pickle: this cmd replicates to every
+        # peer and is decoded from the wire (transport/wire.py)
+        from ..transport.wire import encode_config_change
 
-        payload = pickle.dumps(cc)
+        payload = encode_config_change(cc)
         self.raft.handle(
             Message(
                 type=MessageType.PROPOSE,

@@ -7,7 +7,6 @@ identical on every replica, and it is serialized into every snapshot.
 """
 from __future__ import annotations
 
-import pickle
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
@@ -73,18 +72,23 @@ class SessionManager:
         return len(self._lru)
 
     # -- snapshot (de)serialization --------------------------------------
+    # session tables ship inside snapshot payloads over the chunk lane,
+    # i.e. they are decoded from untrusted network bytes — positional
+    # binary via the wire codec, never pickle
     def serialize(self) -> bytes:
-        return pickle.dumps(
-            [
-                (s.client_id, s.responded_to, dict(s.history))
-                for s in self._lru.values()
-            ]
+        from ..transport.wire import encode_session_table
+
+        return encode_session_table(
+            (s.client_id, s.responded_to, s.history)
+            for s in self._lru.values()
         )
 
     @classmethod
     def deserialize(cls, data: bytes, max_sessions: Optional[int] = None):
+        from ..transport.wire import decode_session_table
+
         sm = cls(max_sessions)
-        for client_id, responded_to, history in pickle.loads(data):
+        for client_id, responded_to, history in decode_session_table(data):
             sm._lru[client_id] = Session(
                 client_id=client_id,
                 responded_to=responded_to,

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import enum
 import io
-import pickle
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -24,11 +23,40 @@ from ..client import (
 from ..logger import get_logger
 from ..pb import ConfigChange, Entry, EntryType, Membership, Snapshot
 from ..statemachine import Result, SMEntry
+from ..transport.wire import WireError, decode_config_change
 from .managed import ManagedStateMachine
 from .membership import MembershipManager
 from .session import SessionManager
 
 _log = get_logger("rsm")
+
+
+class SnapshotFileCollection:
+    """Concrete ISnapshotFileCollection: stages each added file via the
+    storage-provided ``copy_fn`` (into the snapshot dir) at add time —
+    the user contract is that the file exists until save returns
+    (reference: statemachine.ISnapshotFileCollection [U])."""
+
+    def __init__(self, copy_fn=None):
+        self._copy = copy_fn
+        self.files = []  # List[SnapshotFile]
+
+    def add_file(self, file_id: int, path: str, metadata: bytes = b"") -> None:
+        import os
+
+        from ..pb import SnapshotFile
+
+        if self._copy is not None:
+            self.files.append(self._copy(file_id, path, metadata))
+        else:
+            self.files.append(
+                SnapshotFile(
+                    file_id=file_id,
+                    filepath=path,
+                    file_size=os.path.getsize(path),
+                    metadata=metadata,
+                )
+            )
 
 
 class TaskType(enum.IntEnum):
@@ -211,8 +239,8 @@ class StateMachine:
 
     def _handle_config_change(self, e: Entry) -> ApplyResult:
         try:
-            cc: ConfigChange = pickle.loads(e.cmd)
-        except Exception:
+            cc: ConfigChange = decode_config_change(e.cmd)
+        except (WireError, ValueError):
             self._advance(e)
             return ApplyResult(entry=e, result=Result(), rejected=True)
         accepted = self.members.handle(cc, e.index)
@@ -242,50 +270,76 @@ class StateMachine:
         self.managed.sync()
 
     # -- snapshot ---------------------------------------------------------
-    def save_snapshot_data(self, files=None, done=None) -> Tuple[bytes, int, int]:
-        """Serialize (header, sessions, SM data); returns (blob, index, term).
+    def save_snapshot_stream(
+        self,
+        fileobj,
+        collection=None,
+        done=None,
+        *,
+        compression: int = 0,
+        block_size: Optional[int] = None,
+    ) -> Tuple[int, int, list]:
+        """Stream a v2 container (storage/snapshotio.py) into ``fileobj``.
 
-        The versioned on-disk container lives in storage/snapshotio.py;
-        this produces the inner payload (reference: rsm.SaveSnapshot [U]).
+        The SM's data flows through the block writer with bounded
+        memory — a 10GB on-disk SM never materializes its payload
+        (reference: rsm streamed save for IOnDiskStateMachine [U]).
+        Returns (index, term, external_files).
         """
-        buf = io.BytesIO()
+        from ..storage.snapshotio import DEFAULT_BLOCK_SIZE, SnapshotWriter
+
         done = done or threading.Event()
         with self._mu:
             index, term = self.last_applied, self.applied_term
             membership = self.members.membership.copy()
             sessions_blob = self.sessions.serialize()
             ctx = self.managed.prepare_snapshot()
+            w = SnapshotWriter(
+                fileobj,
+                index=index,
+                term=term,
+                membership=membership,
+                sessions=sessions_blob,
+                on_disk=self.managed.on_disk,
+                compression=compression,
+                block_size=block_size or DEFAULT_BLOCK_SIZE,
+            )
             if not self.managed.concurrent_snapshot:
                 # regular SM: serialize inside the apply-exclusive section so
                 # the payload cannot contain entries newer than `index`
-                self.managed.save_snapshot(ctx, buf, files, done)
+                self.managed.save_snapshot(ctx, w, collection, done)
         if self.managed.concurrent_snapshot:
             # concurrent/on-disk SMs captured a consistent view in
             # prepare_snapshot; the slow serialization runs outside the lock
-            self.managed.save_snapshot(ctx, buf, files, done)
-        payload = pickle.dumps(
-            {
-                "version": 1,
-                "index": index,
-                "term": term,
-                "membership": membership,
-                "sessions": sessions_blob,
-                "sm_data": buf.getvalue(),
-                "on_disk": self.managed.on_disk,
-            }
-        )
-        return payload, index, term
+            self.managed.save_snapshot(ctx, w, collection, done)
+        if collection is not None:
+            for sf in collection.files:
+                w.add_external_file(sf)
+        w.close()
+        return index, term, (collection.files if collection else [])
+
+    def recover_from_snapshot_stream(self, reader, files, done=None) -> int:
+        """Restore from a SnapshotReader; ``files`` are the resolved
+        external SnapshotFile records (absolute paths)."""
+        with self._mu:
+            self.managed.recover_from_snapshot(
+                reader.sm_stream(), files, done or threading.Event()
+            )
+            self.sessions = SessionManager.deserialize(reader.sessions)
+            self.members.restore(reader.membership)
+            self.last_applied = reader.index
+            self.applied_term = reader.term
+        return reader.index
+
+    # bytes-level convenience (tests, in-mem flows) over the same container
+    def save_snapshot_data(self, files=None, done=None) -> Tuple[bytes, int, int]:
+        buf = io.BytesIO()
+        index, term, _ = self.save_snapshot_stream(buf, files, done)
+        return buf.getvalue(), index, term
 
     def recover_from_snapshot_data(self, payload: bytes, done=None) -> int:
-        d = pickle.loads(payload)
-        with self._mu:
-            if d["sm_data"] is not None:
-                r = io.BytesIO(d["sm_data"])
-                self.managed.recover_from_snapshot(
-                    r, [], done or threading.Event()
-                )
-            self.sessions = SessionManager.deserialize(d["sessions"])
-            self.members.restore(d["membership"])
-            self.last_applied = d["index"]
-            self.applied_term = d["term"]
-        return d["index"]
+        from ..storage.snapshotio import SnapshotReader
+
+        return self.recover_from_snapshot_stream(
+            SnapshotReader(io.BytesIO(payload)), [], done
+        )

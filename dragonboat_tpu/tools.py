@@ -29,12 +29,27 @@ def export_snapshot(nodehost, shard_id: int, export_dir: str) -> Snapshot:
     Call ``nodehost.sync_request_snapshot(shard_id)`` first if the shard
     has never snapshotted.
     """
+    import io as _io
+
+    from .storage.snapshotio import SnapshotReader
+
     replica_id = nodehost._get_node(shard_id).replica_id
     ss = nodehost.logdb.get_snapshot(shard_id, replica_id)
     if ss.is_empty():
         raise ValueError(f"shard {shard_id} has no snapshot to export")
     os.makedirs(export_dir, exist_ok=True)
-    shutil.copyfile(ss.filepath, os.path.join(export_dir, PAYLOAD_FILENAME))
+    storage = nodehost.snapshot_storage
+    # lease: snapshot GC must not delete the dir mid-copy; external files
+    # (ISnapshotFileCollection) are part of the snapshot and must travel
+    with storage.lease(ss.filepath):
+        payload = storage.load(ss.filepath)
+        with open(os.path.join(export_dir, PAYLOAD_FILENAME), "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        for sf in SnapshotReader(_io.BytesIO(payload)).external_files:
+            src = storage.external_path(ss.filepath, sf.filepath)
+            shutil.copyfile(src, os.path.join(export_dir, sf.filepath))
     with open(os.path.join(export_dir, META_FILENAME), "wb") as f:
         f.write(encode_snapshot_meta(ss))
         f.flush()
@@ -67,14 +82,34 @@ def import_snapshot(
         )
     with open(os.path.join(export_dir, PAYLOAD_FILENAME), "rb") as f:
         raw = f.read()
-    payload = raw[4:]
-    from .storage.snapshotter import _checksum
+    payload = raw
+    # the v2 container self-validates per section; walk every block so
+    # a corrupt export fails HERE, not at replica recovery
+    import io as _io
 
-    if _checksum(payload) != raw[:4]:
-        raise IOError(f"corrupt snapshot export in {export_dir}")
+    from .storage.snapshotio import SnapshotCorruptError, SnapshotReader
+
+    try:
+        reader = SnapshotReader(_io.BytesIO(payload))
+        reader.validate()
+    except SnapshotCorruptError as e:
+        raise IOError(f"corrupt snapshot export in {export_dir}: {e}")
+    # external files must be present in the export — importing without
+    # them would fail-stop the replica at recovery
+    for sf in reader.external_files:
+        if not os.path.exists(os.path.join(export_dir, sf.filepath)):
+            raise IOError(
+                f"export in {export_dir} is missing external file "
+                f"{sf.filepath}"
+            )
     path = nodehost.snapshot_storage.save(
         shard_id, replica_id, meta.index, payload, suffix="imported"
     )
+    for sf in reader.external_files:
+        shutil.copyfile(
+            os.path.join(export_dir, sf.filepath),
+            nodehost.snapshot_storage.external_path(path, sf.filepath),
+        )
     new_membership = Membership(
         config_change_id=meta.membership.config_change_id + 1,
         addresses=dict(members),

@@ -1,68 +1,146 @@
 """Snapshot chunking: split on the sender, reassemble on the receiver.
 
 reference: internal/transport/chunk.go (splitSnapshotMessage, Chunk.Add)
-[U].  A snapshot never travels as one message: the sender reads the
-snapshot payload ONCE (synchronously, while the file is guaranteed live)
-and streams fixed-size chunks over the snapshot lane; the receiver
-reassembles them into its OWN local snapshot storage and only then
-injects the InstallSnapshot message into the raft path.  Replicas never
-share snapshot files by path — each host owns its copy, exactly as the
-reference's chunk protocol guarantees.
+[U].  A snapshot never travels as one message: the sender's stream job
+reads the container INCREMENTALLY (one chunk in memory at a time, under
+a storage GC lease) and streams fixed-size chunks over the snapshot
+lane; the receiver writes each chunk to its OWN local snapshot storage
+as it lands (bounded memory on both sides) and only then injects the
+InstallSnapshot message into the raft path.  External files
+(ISnapshotFileCollection) travel as additional chunk sequences tagged
+with ``has_file_info``, exactly like the reference's file chunks.
+Replicas never share snapshot files by path — each host owns its copy.
 """
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from .. import settings
 from ..logger import get_logger
-from ..pb import Chunk, Message, MessageType, Snapshot
+from ..pb import Chunk, Message, MessageType, Snapshot, SnapshotFile
 
 _log = get_logger("transport")
 
 
-def split_snapshot_message(
-    m: Message, payload: bytes, chunk_size: Optional[int] = None
-) -> List[Chunk]:
-    """Split an InstallSnapshot message + its payload into wire chunks
-    (reference: splitSnapshotMessage [U])."""
+def iter_snapshot_chunks(
+    m: Message, source, chunk_size: Optional[int] = None
+) -> Iterator[Chunk]:
+    """Lazily yield the wire chunks for an InstallSnapshot message.
+
+    ``source`` is a ``SnapshotSource`` (storage/snapshotter.py): main
+    container + external files, read incrementally so only one chunk is
+    ever materialized (reference: splitSnapshotMessage + job.go
+    incremental reads [U]).  ``source`` must stay open for the duration.
+    """
     ss = m.snapshot
     size = chunk_size or settings.Soft.snapshot_chunk_size
-    if ss.dummy or not payload:
-        pieces = [b""]
+
+    def n_chunks(nbytes: int) -> int:
+        return max(1, -(-nbytes // size))
+
+    if ss.dummy:
+        files: List[Tuple[SnapshotFile, str]] = []
+        total = 1
+        main_size = 0
     else:
-        pieces = [payload[i : i + size] for i in range(0, len(payload), size)]
-    count = len(pieces)
-    return [
-        Chunk(
+        files = source.externals
+        main_size = source.main_size
+        total = n_chunks(main_size) + sum(
+            n_chunks(sf.file_size) for sf, _ in files
+        )
+
+    def base(i: int, piece: bytes, **kw) -> Chunk:
+        return Chunk(
             shard_id=m.shard_id,
             replica_id=m.to,
             from_=m.from_,
             chunk_id=i,
             chunk_size=len(piece),
-            chunk_count=count,
+            chunk_count=total,
             index=ss.index,
             term=ss.term,
             message_term=m.term,
             data=piece,
             membership=ss.membership,
             filepath=ss.filepath,
-            file_size=len(payload),
+            file_size=main_size,
             witness=ss.witness,
             dummy=ss.dummy,
             on_disk_index=ss.on_disk_index,
+            **kw,
         )
-        for i, piece in enumerate(pieces)
-    ]
+
+    if ss.dummy:
+        yield base(0, b"")
+        return
+
+    cid = 0
+    with source.open_main() as f:
+        sent = 0
+        while True:
+            piece = f.read(size)
+            if not piece and sent > 0:
+                break
+            yield base(cid, piece)
+            cid += 1
+            sent += len(piece)
+            if not piece:
+                break
+    for sf, path in files:
+        with source.open_external(path) as f:
+            fcount = n_chunks(sf.file_size)
+            fcid = 0
+            while True:
+                piece = f.read(size)
+                if not piece and fcid > 0:
+                    break
+                yield base(
+                    cid,
+                    piece,
+                    has_file_info=True,
+                    file_info=sf,
+                    file_chunk_id=fcid,
+                    file_chunk_count=fcount,
+                )
+                cid += 1
+                fcid += 1
+                if not piece:
+                    break
+
+
+def split_snapshot_message(
+    m: Message, payload: bytes, chunk_size: Optional[int] = None
+) -> List[Chunk]:
+    """Split an in-memory payload into wire chunks (tests and the
+    in-proc fast path; the production sender uses iter_snapshot_chunks)."""
+
+    class _BytesSource:
+        main_size = len(payload)
+        externals: List[Tuple[SnapshotFile, str]] = []
+
+        def open_main(self):
+            import io
+
+            return io.BytesIO(payload)
+
+        def open_external(self, path):  # pragma: no cover - no externals
+            raise FileNotFoundError(path)
+
+    return list(iter_snapshot_chunks(m, _BytesSource(), chunk_size))
 
 
 class _InFlight:
-    __slots__ = ("pieces", "next_chunk", "count", "ident")
+    __slots__ = (
+        "sink", "next_chunk", "count", "ident", "cur_file", "pending_open",
+    )
 
-    def __init__(self, count: int, ident: tuple):
-        self.pieces: List[bytes] = []
+    def __init__(self, count: int, ident: tuple, sink):
+        self.sink = sink  # None for dummy snapshots
+        self.pending_open = False
         self.next_chunk = 0
         self.count = count
+        self.cur_file = None  # file_id currently being written
         # stream identity: every chunk of one stream must agree on these,
         # otherwise two interleaved streams from the same sender could
         # splice into one corrupted payload (reference: Chunk.Add validates
@@ -78,20 +156,21 @@ class ChunkSink:
     """Receiver-side reassembly, one in-flight snapshot per (shard, sender)
     (reference: transport.Chunk tracking in-flight state per key [U]).
 
-    ``save_fn(shard_id, replica_id, index, payload) -> filepath`` persists
-    into the receiver's local snapshot storage; ``deliver_fn(message)``
-    hands the reconstituted InstallSnapshot to the raft path;
-    ``confirm_fn(shard_id, from_replica, to_replica)`` sends
-    SnapshotReceived back to the sender.
+    ``begin_fn(shard_id, replica_id, index) -> sink`` opens an
+    incremental receive sink in local snapshot storage (``write``,
+    ``begin_external``, ``finalize() -> filepath``, ``abort``);
+    ``deliver_fn(message)`` hands the reconstituted InstallSnapshot to
+    the raft path; ``confirm_fn(shard_id, from_replica, to_replica)``
+    sends SnapshotReceived back to the sender.
     """
 
     def __init__(
         self,
-        save_fn: Callable[[int, int, int, bytes], str],
+        begin_fn: Callable[[int, int, int], object],
         deliver_fn: Callable[[Message], None],
         confirm_fn: Optional[Callable[[int, int, int], None]] = None,
     ):
-        self.save_fn = save_fn
+        self.begin_fn = begin_fn
         self.deliver_fn = deliver_fn
         self.confirm_fn = confirm_fn
         self._lock = threading.Lock()
@@ -99,42 +178,78 @@ class ChunkSink:
 
     def add(self, c: Chunk) -> bool:
         """Accept one chunk; returns False to make the sender abort the
-        stream (out-of-order / mismatched chunk)."""
+        stream (out-of-order / mismatched chunk).
+
+        The lock only guards the in-flight MAP: all disk I/O (sink open,
+        writes, the per-file fsync at external boundaries) runs outside
+        it, so concurrent inbound streams from different senders never
+        queue behind each other's fsyncs.  Per-stream fields of one
+        ``_InFlight`` are touched only by its delivering connection
+        thread; a superseding chunk 0 swaps the map entry under the lock
+        and aborts the old sink outside it.
+        """
         key = (c.shard_id, c.from_)
+        stale = None
         with self._lock:
             fl = self._inflight.get(key)
             if c.chunk_id == 0:
-                fl = _InFlight(c.chunk_count, _chunk_ident(c))
+                stale = fl
+                fl = _InFlight(c.chunk_count, _chunk_ident(c), None)
+                fl.pending_open = not c.dummy
                 self._inflight[key] = fl
             elif (
                 fl is None
                 or c.chunk_id != fl.next_chunk
                 or _chunk_ident(c) != fl.ident
             ):
-                _log.warning(
-                    "out-of-order/mismatched chunk %d for shard %d from %d",
-                    c.chunk_id,
-                    c.shard_id,
-                    c.from_,
-                )
-                self._inflight.pop(key, None)
-                return False
-            fl.pieces.append(c.data)
-            fl.next_chunk = c.chunk_id + 1
-            done = fl.next_chunk == fl.count
-            if done:
-                self._inflight.pop(key, None)
+                stale = self._inflight.pop(key, None)
+                fl = None
+        if stale is not None and stale.sink is not None:
+            stale.sink.abort()
+        if fl is None:
+            _log.warning(
+                "out-of-order/mismatched chunk %d for shard %d from %d",
+                c.chunk_id,
+                c.shard_id,
+                c.from_,
+            )
+            return False
+        try:
+            if fl.pending_open:
+                fl.pending_open = False
+                fl.sink = self.begin_fn(c.shard_id, c.replica_id, c.index)
+            if fl.sink is not None:
+                if c.has_file_info and fl.cur_file != c.file_info.file_id:
+                    fl.cur_file = c.file_info.file_id
+                    fl.sink.begin_external(c.file_info.filepath)
+                fl.sink.write(c.data)
+        except Exception as e:  # noqa: BLE001 - disk trouble
+            _log.warning("receive sink failed: %s", e)
+            if fl.sink is not None:
+                fl.sink.abort()
+            with self._lock:
+                if self._inflight.get(key) is fl:
+                    del self._inflight[key]
+            return False
+        fl.next_chunk = c.chunk_id + 1
+        done = fl.next_chunk == fl.count
         if done:
-            self._complete(c, b"".join(fl.pieces))
+            with self._lock:
+                if self._inflight.get(key) is fl:
+                    del self._inflight[key]
+            self._complete(c, fl)
         return True
 
-    def _complete(self, last: Chunk, payload: bytes) -> None:
-        if last.dummy:
+    def _complete(self, last: Chunk, fl: _InFlight) -> None:
+        if fl.sink is None:
             filepath = ""
         else:
-            filepath = self.save_fn(
-                last.shard_id, last.replica_id, last.index, payload
-            )
+            try:
+                filepath = fl.sink.finalize()
+            except Exception as e:  # noqa: BLE001 - disk trouble
+                _log.warning("receive sink finalize failed: %s", e)
+                fl.sink.abort()
+                return
         ss = Snapshot(
             filepath=filepath,
             file_size=last.file_size,

@@ -65,7 +65,7 @@ class Transport:
         source_address: str,
         deployment_id: int = 0,
         unreachable_cb: Optional[Callable[[Message], None]] = None,
-        snapshot_payload_loader: Optional[Callable[[object], bytes]] = None,
+        snapshot_source_opener: Optional[Callable[[object], object]] = None,
         snapshot_status_cb: Optional[Callable[[int, int, bool], None]] = None,
         max_snapshot_send_bytes_per_second: int = 0,
     ):
@@ -74,9 +74,10 @@ class Transport:
         self.source_address = source_address
         self.deployment_id = deployment_id
         self.unreachable_cb = unreachable_cb
-        # reads the snapshot payload at send time, while the caller (the
-        # shard's step worker) still guarantees the file exists
-        self.snapshot_payload_loader = snapshot_payload_loader
+        # opens a leased incremental reader over the snapshot dir; ALL
+        # payload reads happen on the stream-job thread, never on the
+        # step worker (storage/snapshotter.SnapshotSource)
+        self.snapshot_source_opener = snapshot_source_opener
         # (shard_id, to_replica, failed) -> report to the sending raft peer
         self.snapshot_status_cb = snapshot_status_cb
         self.max_snapshot_send_rate = max_snapshot_send_bytes_per_second
@@ -181,17 +182,13 @@ class Transport:
         """Stream a snapshot to the target over the chunk lane
         (reference: Transport.SendSnapshot -> stream job [U]).
 
-        The payload is read synchronously — the calling step worker is the
-        only thread that garbage-collects this shard's snapshot files, so
-        the file cannot disappear underneath us; chunking + delivery then
-        run on a dedicated job thread like the reference's stream jobs.
-
-        TODO(perf): for very large snapshots this blocks the step worker
-        for the duration of one file read; move to incremental reads inside
-        the job under a file lease once on-disk SM streaming lands.
+        NOTHING is read on the calling step worker: the job thread opens
+        a ``SnapshotSource`` (which takes a storage GC lease) and reads
+        the container incrementally, one chunk in memory at a time — a
+        snapshot far larger than RAM streams fine and the step worker's
+        stall is bounded by a thread spawn (reference: job.go incremental
+        chunk reads [U]).
         """
-        from .chunk import split_snapshot_message
-
         if self._stopped:
             return False
         target = self.resolver(m.shard_id, m.to)
@@ -203,29 +200,22 @@ class Transport:
                 self._snapshot_failed(m)
                 return False
             self._stream_jobs += 1
-        try:
-            if m.snapshot.dummy or self.snapshot_payload_loader is None:
-                payload = b""
-            else:
-                payload = self.snapshot_payload_loader(m.snapshot)
-        except Exception as e:  # noqa: BLE001 — missing/corrupt local file
-            _log.warning("snapshot payload read failed: %s", e)
-            with self._stream_lock:
-                self._stream_jobs -= 1
-            self._snapshot_failed(m)
-            return False
-        chunks = split_snapshot_message(m, payload)
         t = threading.Thread(
             target=self._stream_job,
-            args=(m, target, chunks),
+            args=(m, target),
             daemon=True,
             name=f"tpu-raft-snapshot-{target}",
         )
         t.start()
         return True
 
-    def _stream_job(self, m: Message, target: str, chunks) -> None:
+    def _stream_job(self, m: Message, target: str) -> None:
+        from .chunk import iter_snapshot_chunks
+
+        source = None
         try:
+            if not m.snapshot.dummy and self.snapshot_source_opener is not None:
+                source = self.snapshot_source_opener(m.snapshot)
             conn = self.raw.get_snapshot_connection(target)
             try:
                 # deficit pacing against MaxSnapshotSendBytesPerSecond
@@ -235,16 +225,15 @@ class Transport:
                 # Debt is never forgiven (chunks larger than one second
                 # of budget still average out correctly) and idle time
                 # banks no burst credit.  Sleeps are sliced so close()
-                # interrupts promptly; the final chunk pays no sleep.
+                # interrupts promptly.
                 rate = self.max_snapshot_send_rate
                 deficit = 0.0
                 last = time.monotonic()
-                chunk_list = list(chunks)
-                for k, c in enumerate(chunk_list):
+                for c in iter_snapshot_chunks(m, source):
                     if self._stopped:
                         raise ConnectionError("transport stopped")
                     conn.send_chunk(c)
-                    if rate <= 0 or k == len(chunk_list) - 1:
+                    if rate <= 0:
                         continue
                     now = time.monotonic()
                     deficit = max(0.0, deficit - (now - last) * rate)
@@ -264,6 +253,8 @@ class Transport:
             if self.unreachable_cb is not None:
                 self.unreachable_cb(m)
         finally:
+            if source is not None:
+                source.close()  # releases the storage GC lease
             with self._stream_lock:
                 self._stream_jobs -= 1
 

@@ -25,6 +25,8 @@ from typing import Tuple
 from ..pb import (
     Chunk,
     CompressionType,
+    ConfigChange,
+    ConfigChangeType,
     Entry,
     EntryType,
     Membership,
@@ -391,6 +393,11 @@ def decode_snapshot_meta(data: bytes) -> Snapshot:
     return s
 
 
+_CF_WITNESS = 1
+_CF_DUMMY = 2
+_CF_FILE_INFO = 4
+
+
 def encode_chunk(c: Chunk) -> bytes:
     b = BytesIO()
     for v in (
@@ -403,10 +410,26 @@ def encode_chunk(c: Chunk) -> bytes:
         c.index,
         c.term,
         c.message_term,
+        c.file_size,
+        c.on_disk_index,
     ):
         _wu64(b, v)
+    flags = (
+        (_CF_WITNESS if c.witness else 0)
+        | (_CF_DUMMY if c.dummy else 0)
+        | (_CF_FILE_INFO if c.has_file_info else 0)
+    )
+    _wu8(b, flags)
+    _ws(b, c.filepath)
     _wb(b, c.data)
     _w_membership(b, c.membership)
+    if c.has_file_info:
+        _wu64(b, c.file_info.file_id)
+        _ws(b, c.file_info.filepath)
+        _wu64(b, c.file_info.file_size)
+        _wb(b, c.file_info.metadata)
+        _wu64(b, c.file_chunk_id)
+        _wu64(b, c.file_chunk_count)
     return b.getvalue()
 
 
@@ -422,9 +445,24 @@ def decode_chunk(data: bytes) -> Chunk:
         index,
         term,
         message_term,
-    ) = (r.u64() for _ in range(9))
+        file_size,
+        on_disk_index,
+    ) = (r.u64() for _ in range(11))
+    flags = r.u8()
+    filepath = r.s()
     payload = r.blob()
     membership = _r_membership(r)
+    file_info = SnapshotFile()
+    file_chunk_id = file_chunk_count = 0
+    if flags & _CF_FILE_INFO:
+        file_info = SnapshotFile(
+            file_id=r.u64(),
+            filepath=r.s(),
+            file_size=r.u64(),
+            metadata=r.blob(),
+        )
+        file_chunk_id = r.u64()
+        file_chunk_count = r.u64()
     if r.pos != len(data):
         raise WireError(f"trailing bytes: {len(data) - r.pos}")
     return Chunk(
@@ -437,6 +475,141 @@ def decode_chunk(data: bytes) -> Chunk:
         index=index,
         term=term,
         message_term=message_term,
+        file_size=file_size,
+        on_disk_index=on_disk_index,
+        witness=bool(flags & _CF_WITNESS),
+        dummy=bool(flags & _CF_DUMMY),
+        has_file_info=bool(flags & _CF_FILE_INFO),
+        filepath=filepath,
         data=payload,
         membership=membership,
+        file_info=file_info,
+        file_chunk_id=file_chunk_id,
+        file_chunk_count=file_chunk_count,
     )
+
+
+# ---------------------------------------------------------------------------
+# rsm payload codecs
+# ---------------------------------------------------------------------------
+# These payloads ride INSIDE entries and snapshot chunks, so they arrive
+# from the network exactly like frames do: config-change cmds replicate
+# to every peer, session tables and rsm snapshot payloads ship through
+# the chunk lane.  The reference encodes them as protobufs
+# (raftpb/raft.proto -> ConfigChange, session state [U]); here they use
+# the same positional binary discipline as the rest of this module —
+# never pickle, which would be remote code execution on decode.
+
+def encode_config_change(cc: "ConfigChange") -> bytes:
+    b = BytesIO()
+    _wu64(b, cc.config_change_id)
+    _wu8(b, int(cc.type))
+    _wu64(b, cc.replica_id)
+    _ws(b, cc.address)
+    _wu8(b, int(cc.initialize))
+    return b.getvalue()
+
+
+def decode_config_change(data: bytes) -> "ConfigChange":
+    r = _R(data)
+    ccid = r.u64()
+    cctype = ConfigChangeType(r.u8())
+    replica_id = r.u64()
+    address = r.s()
+    initialize = bool(r.u8())
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return ConfigChange(
+        config_change_id=ccid,
+        type=cctype,
+        replica_id=replica_id,
+        address=address,
+        initialize=initialize,
+    )
+
+
+def encode_session_table(sessions) -> bytes:
+    """``sessions``: iterable of (client_id, responded_to,
+    {series_id: Result}) in LRU order (order is preserved)."""
+    b = BytesIO()
+    rows = list(sessions)
+    _wu32(b, len(rows))
+    for client_id, responded_to, history in rows:
+        _wu64(b, client_id)
+        _wu64(b, responded_to)
+        _wu32(b, len(history))
+        for sid in sorted(history):
+            res = history[sid]
+            _wu64(b, sid)
+            _wu64(b, res.value)
+            _wb(b, res.data)
+    return b.getvalue()
+
+
+def decode_session_table(data: bytes):
+    from ..statemachine import Result
+
+    r = _R(data)
+    out = []
+    for _ in range(r.count()):
+        client_id = r.u64()
+        responded_to = r.u64()
+        history = {}
+        for _ in range(r.count()):
+            sid = r.u64()
+            value = r.u64()
+            rdata = r.blob()
+            history[sid] = Result(value=value, data=rdata)
+        out.append((client_id, responded_to, history))
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return out
+
+
+RSM_SNAPSHOT_VERSION = 2
+
+
+def encode_rsm_snapshot(
+    *,
+    index: int,
+    term: int,
+    membership: Membership,
+    sessions: bytes,
+    sm_data,
+    on_disk: bool,
+) -> bytes:
+    b = BytesIO()
+    _wu8(b, RSM_SNAPSHOT_VERSION)
+    _wu8(b, int(on_disk))
+    _wu8(b, 0 if sm_data is None else 1)
+    _wu64(b, index)
+    _wu64(b, term)
+    _w_membership(b, membership)
+    _wb(b, sessions)
+    _wb(b, sm_data if sm_data is not None else b"")
+    return b.getvalue()
+
+
+def decode_rsm_snapshot(data: bytes) -> dict:
+    r = _R(data)
+    version = r.u8()
+    if version != RSM_SNAPSHOT_VERSION:
+        raise WireError(f"unsupported rsm snapshot version {version}")
+    on_disk = bool(r.u8())
+    has_sm_data = bool(r.u8())
+    index = r.u64()
+    term = r.u64()
+    membership = _r_membership(r)
+    sessions = r.blob()
+    sm_data = r.blob()
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return {
+        "version": version,
+        "index": index,
+        "term": term,
+        "membership": membership,
+        "sessions": sessions,
+        "sm_data": sm_data if has_sm_data else None,
+        "on_disk": on_disk,
+    }

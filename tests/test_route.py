@@ -217,7 +217,8 @@ def test_routed_drop_liveness():
     state = S.state_from_rafts(rafts, P, W)
     dest, rank = tables_for(rafts)
     dest, rank = jnp.asarray(dest), jnp.asarray(rank)
-    inbox = R.make_prefill(state, M, E)
+    m_small = BASE + P * 1  # budget=1 -> a 7-slot inbox layout
+    inbox = R.make_prefill(state, m_small, E)
     dropped = 0
     for _ in range(160):
         # escalations are allowed here: starved followers can fall past

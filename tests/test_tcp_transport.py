@@ -116,6 +116,43 @@ class TestWireCodec:
         )
         assert wire.decode_chunk(wire.encode_chunk(c)) == c
 
+    def test_chunk_round_trip_full_fields(self):
+        """dummy/witness flags, sizes and external-file info must survive
+        the TCP codec — the receiver reconstructs Snapshot meta and the
+        external-file layout purely from these fields."""
+        from dragonboat_tpu.pb import SnapshotFile
+
+        c = Chunk(
+            shard_id=7,
+            replica_id=2,
+            from_=1,
+            chunk_id=12,
+            chunk_size=5,
+            chunk_count=20,
+            index=100,
+            term=5,
+            message_term=6,
+            file_size=12345,
+            on_disk_index=77,
+            witness=True,
+            dummy=False,
+            filepath="/snap/snapshot.bin",
+            data=b"xx",
+            membership=Membership(addresses={1: "a:1"}),
+            has_file_info=True,
+            file_info=SnapshotFile(
+                file_id=3,
+                filepath="external-3-side.db",
+                file_size=999,
+                metadata=b"m",
+            ),
+            file_chunk_id=4,
+            file_chunk_count=8,
+        )
+        assert wire.decode_chunk(wire.encode_chunk(c)) == c
+        d = Chunk(shard_id=1, replica_id=2, from_=3, chunk_count=1, dummy=True)
+        assert wire.decode_chunk(wire.encode_chunk(d)).dummy is True
+
     def test_truncated_rejected(self):
         data = wire.encode_batch(MessageBatch(messages=(sample_message(),)))
         with pytest.raises(wire.WireError):

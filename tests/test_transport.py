@@ -14,6 +14,27 @@ from dragonboat_tpu.transport.chunk import ChunkSink, split_snapshot_message
 from dragonboat_tpu.transport.transport import Transport
 
 
+class BytesSource:
+    """Minimal SnapshotSource stand-in for transport-level tests."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.main_size = len(payload)
+        self.externals = []
+        self.closed = False
+
+    def open_main(self):
+        import io
+
+        return io.BytesIO(self.payload)
+
+    def open_external(self, path):
+        raise FileNotFoundError(path)
+
+    def close(self):
+        self.closed = True
+
+
 def make_install_msg(payload_size=0, term=5, dummy=False):
     ss = Snapshot(
         filepath="mem://src" if not dummy else "",
@@ -62,7 +83,9 @@ class TestChunkSink:
         delivered = []
         confirmed = []
         sink = ChunkSink(
-            save_fn=lambda s, r, i, p: storage.save(s, r, i, p, suffix="rx1"),
+            begin_fn=lambda s, r, i: storage.begin_receive(
+                s, r, i, suffix="rx1"
+            ),
             deliver_fn=delivered.append,
             confirm_fn=lambda s, f, t: confirmed.append((s, f, t)),
         )
@@ -221,7 +244,9 @@ class TestTransportCore:
             raw,
             lambda s, r: "t1",
             "src",
-            snapshot_payload_loader=lambda ss: storage.load(ss.filepath),
+            snapshot_source_opener=lambda ss: BytesSource(
+                storage.load(ss.filepath)
+            ),
             snapshot_status_cb=lambda s, to, failed: statuses.append(failed),
         )
         try:
@@ -247,17 +272,21 @@ class TestTransportCore:
     def test_missing_snapshot_file_reports_failure(self):
         raw = _ChanTransport()
         statuses = []
+
+        def opener(ss):
+            raise FileNotFoundError(ss.filepath)
+
         tr = Transport(
             raw,
             lambda s, r: "t1",
             "src",
-            snapshot_payload_loader=lambda ss: (_ for _ in ()).throw(
-                FileNotFoundError(ss.filepath)
-            ),
+            snapshot_source_opener=opener,
             snapshot_status_cb=lambda s, to, failed: statuses.append(failed),
         )
         try:
-            assert not tr.send(make_install_msg())
-            assert statuses == [True]
+            # reads happen on the job thread now: send succeeds, the
+            # failure surfaces asynchronously as a rejected status
+            assert tr.send(make_install_msg())
+            assert wait_until(lambda: statuses == [True])
         finally:
             tr.close()

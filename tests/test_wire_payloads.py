@@ -1,0 +1,162 @@
+"""Untrusted-payload codecs: round-trips, hostile bytes, no-pickle guard.
+
+Config-change cmds replicate inside entries, and session tables / rsm
+snapshot payloads ship through the snapshot chunk lane — all of it is
+network input from peers.  These tests feed the decoders hostile bytes
+(including actual pickle payloads carrying an exec payload) and assert
+they fail CLOSED with WireError, never by executing anything.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import pickletools
+import random
+
+import pytest
+
+from dragonboat_tpu.pb import ConfigChange, ConfigChangeType, Membership
+from dragonboat_tpu.statemachine import Result
+from dragonboat_tpu.transport.wire import (
+    WireError,
+    decode_config_change,
+    decode_rsm_snapshot,
+    decode_session_table,
+    encode_config_change,
+    encode_rsm_snapshot,
+    encode_session_table,
+)
+
+
+class TestRoundTrips:
+    def test_config_change(self):
+        cc = ConfigChange(
+            config_change_id=7,
+            type=ConfigChangeType.ADD_NON_VOTING,
+            replica_id=42,
+            address="host-9:7100",
+            initialize=True,
+        )
+        assert decode_config_change(encode_config_change(cc)) == cc
+
+    def test_session_table_preserves_lru_order(self):
+        rows = [
+            (11, 3, {1: Result(value=9, data=b"x"), 2: Result(value=8)}),
+            (5, 0, {}),
+            (99, 7, {7: Result(data=b"\x00" * 64)}),
+        ]
+        got = decode_session_table(encode_session_table(rows))
+        assert got == rows
+
+    def test_rsm_snapshot(self):
+        m = Membership(
+            config_change_id=3,
+            addresses={1: "a1", 2: "a2"},
+            non_votings={3: "a3"},
+            witnesses={4: "a4"},
+            removed={9: True},
+        )
+        blob = encode_rsm_snapshot(
+            index=100, term=7, membership=m,
+            sessions=b"sess", sm_data=b"smdata", on_disk=False,
+        )
+        d = decode_rsm_snapshot(blob)
+        assert d["index"] == 100 and d["term"] == 7
+        assert d["membership"] == m
+        assert d["sessions"] == b"sess" and d["sm_data"] == b"smdata"
+        assert d["on_disk"] is False
+
+    def test_rsm_snapshot_none_sm_data(self):
+        blob = encode_rsm_snapshot(
+            index=1, term=1, membership=Membership(),
+            sessions=b"", sm_data=None, on_disk=True,
+        )
+        d = decode_rsm_snapshot(blob)
+        assert d["sm_data"] is None and d["on_disk"] is True
+
+
+class _Evil:
+    """An object whose unpickling would mark the attack as successful."""
+
+    fired = False
+
+    def __reduce__(self):
+        return (setattr, (_Evil, "fired", True))
+
+
+HOSTILE = [
+    pickle.dumps(_Evil()),
+    pickle.dumps({"version": 1, "index": 1}),
+    b"",
+    b"\x00",
+    b"\xff" * 3,
+    b"\x80\x05.",  # minimal pickle frame
+]
+
+
+@pytest.mark.parametrize("decoder", [
+    decode_config_change,
+    decode_session_table,
+    decode_rsm_snapshot,
+])
+class TestHostileBytes:
+    def test_hostile_payloads_fail_closed(self, decoder):
+        for data in HOSTILE:
+            with pytest.raises((WireError, ValueError)):
+                decoder(data)
+        assert _Evil.fired is False, "a decoder executed pickled code"
+
+    def test_random_fuzz_never_crashes_hard(self, decoder):
+        rng = random.Random(1234)
+        for _ in range(200):
+            n = rng.randrange(0, 120)
+            data = bytes(rng.randrange(256) for _ in range(n))
+            try:
+                decoder(data)
+            except (WireError, ValueError):
+                pass  # fail-closed is the contract
+
+    def test_trailing_garbage_rejected(self, decoder):
+        if decoder is decode_config_change:
+            good = encode_config_change(ConfigChange(replica_id=1))
+        elif decoder is decode_session_table:
+            good = encode_session_table([(1, 0, {})])
+        else:
+            good = encode_rsm_snapshot(
+                index=1, term=1, membership=Membership(),
+                sessions=b"", sm_data=b"", on_disk=False,
+            )
+        with pytest.raises(WireError):
+            decoder(good + b"\x00")
+
+
+def test_no_pickle_in_library():
+    """Regression guard: pickle must never reappear in the library —
+    only user-SM example code may use it (examples/helloworld.py).
+    Pickle on wire-reachable payloads is remote code execution."""
+    root = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "dragonboat_tpu")
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    s = line.split("#", 1)[0]  # allow mentions in comments
+                    if "import pickle" in s or "pickle." in s:
+                        offenders.append(f"{path}:{i}")
+    assert not offenders, f"pickle usage in library: {offenders}"
+
+
+def test_pickletools_sanity():
+    """The hostile corpus really is valid pickle (the attack is real)."""
+    pickletools.dis(HOSTILE[0], out=open(os.devnull, "w"))
+    import io
+    with pytest.raises(Exception):
+        # and unpickling it WOULD have fired the payload
+        class _Block(pickle.Unpickler):
+            def find_class(self, module, name):
+                raise RuntimeError("blocked")
+        _Block(io.BytesIO(HOSTILE[0])).load()
