@@ -113,44 +113,55 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
     rank = jax.device_put(jnp.asarray(rank), dev)
     inbox = jax.device_put(R.make_prefill(st, M, E), dev)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-    def run_k(st, ib, acc, esc):
-        # stats accumulate ON DEVICE across launches: a per-launch host
-        # readback would force a sync bubble inside the timed window and
-        # bias the consensus numbers low vs phase A's methodology
-        def body(carry, _):
-            st, ib, acc, esc = carry
-            st, ib, s, n = R.routed_round(
-                st, ib, dest, rank,
-                out_capacity=O, budget=BUDGET, base=BASE,
-                propose_leaders=True,
-            )
-            return (st, ib, acc + jnp.stack(list(s)), esc + n), None
+    from dragonboat_tpu.ops.kernel import step as kernel_step
 
-        (st, ib, acc, esc), _ = jax.lax.scan(
-            body, (st, ib, acc, esc), None, length=K
+    # TWO jit units per round, NOT one fused program: XLA's compile time
+    # goes superlinear in program size on the TPU backend (measured:
+    # step 33s + route 148s separately, >25min fused).  Execution stays
+    # pipelined — async dispatch lets the host enqueue rounds ahead, so
+    # throughput is device time per round, not dispatch round-trips.
+    step_j = jax.jit(
+        lambda s, i: kernel_step(s, i, out_capacity=O), donate_argnums=(1,)
+    )
+
+    # dest/rank are ARGUMENTS, never closure constants: closed-over
+    # arrays become embedded XLA constants, and the [G,P,B,E] broadcasts
+    # derived from them constant-fold into tens of MB — compile time
+    # explodes superlinearly with G (measured: route compiled in 148s at
+    # 30k rows as-args, never finished at 300k as-constants)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def route_j(old_st, new_st, out, dest, rank):
+        st, ib, stats, n_esc = R.merge_and_route(
+            old_st, new_st, out, dest, rank,
+            M=M, E=E, budget=BUDGET, base=BASE, propose_leaders=True,
         )
-        return st, ib, acc, esc
+        return st, ib, jnp.stack(list(stats)), n_esc
 
-    acc = jax.device_put(jnp.zeros((5,), jnp.int32), dev)
-    esc = jax.device_put(jnp.zeros((), jnp.int32), dev)
-    for _ in range(warm_launches):  # compile + elections settle
-        st, inbox, acc, esc = run_k(st, inbox, acc, esc)
+    def one_round(st, ib):
+        new_st, out = step_j(st, ib)
+        return route_j(st, new_st, out, dest, rank)
+
+    stats_hist = []
+    for _ in range(warm_launches * K):  # compile + elections settle
+        st, inbox, s, n = one_round(st, inbox)
     jax.block_until_ready(st)
 
     commit0 = np.asarray(st.committed).reshape(GROUPS, REPLICAS).max(1)
-    acc0, esc0 = np.asarray(acc, np.int64), int(esc)
+    rounds = timed_launches * K
     t0 = time.perf_counter()
-    for _ in range(timed_launches):
-        st, inbox, acc, esc = run_k(st, inbox, acc, esc)
+    for _ in range(rounds):
+        st, inbox, s, n = one_round(st, inbox)
+        stats_hist.append((s, n))  # device arrays; summed after the clock
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
-    acc_t = np.asarray(acc, np.int64) - acc0
-    esc_t = int(esc) - esc0
+    acc_t = np.zeros(5, np.int64)
+    esc_t = 0
+    for s, n in stats_hist:
+        acc_t += np.asarray(s, np.int64)
+        esc_t += int(n)
 
     commit1 = np.asarray(st.committed).reshape(GROUPS, REPLICAS).max(1)
     role = np.asarray(st.role)
-    rounds = timed_launches * K
     committed = int((commit1 - commit0).sum())
     return {
         "groups": GROUPS,
@@ -172,6 +183,16 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
 
 def main() -> None:
     import jax
+
+    # persistent compile cache: the routed-consensus programs cost
+    # minutes of XLA compile on the TPU backend the first time and
+    # nothing afterwards
+    cache = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "jax"),
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     NORTH_STAR = 1e9  # group-ticks/sec
 

@@ -32,6 +32,7 @@ from .pb import (
 )
 from .raft.peer import Peer
 from .raft.quiesce import QuiesceManager
+from .raft.read_index import ReadIndex as _DeviceReadIndex
 from .request import (
     PendingConfigChange,
     PendingLeaderTransfer,
@@ -132,7 +133,9 @@ class Node:
         _rand = _random.SystemRandom()
 
         def key_base() -> int:
-            return (config.replica_id << 48) | _rand.getrandbits(47)
+            # 61 bits: read-index ctx keys must split into two sub-2^31
+            # halves for the device inbox (request.PendingReadIndex.read)
+            return ((config.replica_id & 0xFFF) << 48) | _rand.getrandbits(47)
 
         self.pending_proposal = PendingProposal()
         self.pending_proposal._next_key = key_base()
@@ -142,6 +145,11 @@ class Node:
         self.pending_config_change._next_key = key_base()
         self.pending_snapshot = PendingSnapshot()
         self.pending_leader_transfer = PendingLeaderTransfer()
+        # ctx/quorum table for DEVICE-resident reads (ops/engine.py): the
+        # kernel serves the protocol (gate + ctx heartbeats); the host
+        # tracks which voters echoed each ctx.  Scalar-path reads use
+        # peer.raft.read_index instead — the two never overlap.
+        self.device_reads = _DeviceReadIndex()
 
         self.tick_count = 0
         self.leader_id = 0
@@ -577,6 +585,44 @@ class Node:
                     )
             elif e.key:
                 self.pending_proposal.applied(e.key, r.result, r.rejected)
+
+    # ------------------------------------------------------------------
+    # device-resident reads (the engine's ReadIndex hot path)
+    # ------------------------------------------------------------------
+    def handle_device_read_resp(self, m: Message) -> None:
+        """Synthetic READ_INDEX_RESP-to-self emitted by the device kernel
+        (ops/kernel._handle_read_index): reject -> drop; log_index==0 ->
+        request recorded at index=m.commit; log_index==K -> voter K
+        confirmed the ctx.  Quorum tracking is host-side because the SoA
+        state has no per-ctx table; correctness only needs the count of
+        DISTINCT voters that echoed the ctx, which is what device_reads
+        accumulates (reference: internal/raft/readindex.go [U])."""
+        ctx = SystemCtx(low=m.hint, high=m.hint_high)
+        if m.reject:
+            self.device_reads.drop(ctx)
+            self.pending_read_index.dropped(ctx)
+            return
+        if m.log_index == 0:
+            if self.peer.raft.quorum() <= 1:
+                self.pending_read_index.confirmed(ctx, m.commit)
+                self.pending_read_index.applied(self.sm.last_applied)
+            else:
+                self.device_reads.add_request(m.commit, ctx, 0)
+            return
+        done = self.device_reads.confirm(
+            ctx, m.log_index, self.peer.raft.quorum()
+        )
+        if done:
+            for s in done:
+                self.pending_read_index.confirmed(s.ctx, s.index)
+            self.pending_read_index.applied(self.sm.last_applied)
+
+    def drop_device_reads(self) -> None:
+        """Leadership lost / row left the device: fail pending device
+        reads so clients retry (mirrors Raft.drop_pending_read_indexes)."""
+        for low, high in list(self.device_reads.queue):
+            self.pending_read_index.dropped(SystemCtx(low=low, high=high))
+        self.device_reads.clear()
 
     def _recover_sm_from_storage(self, ss: Snapshot) -> None:
         """Open the v2 container and restore the SM + sessions +

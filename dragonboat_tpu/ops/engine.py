@@ -241,6 +241,7 @@ class VectorStepEngine(IStepEngine):
             "host_rows_stepped": 0,
             "escalations": 0,
             "divergence_halts": 0,
+            "device_reads": 0,
         }
         self._warm()
 
@@ -335,11 +336,15 @@ class VectorStepEngine(IStepEngine):
     # ------------------------------------------------------------------
     # classification
     # ------------------------------------------------------------------
-    def _plan_device(self, node, si) -> Optional[List[Tuple]]:
+    def _plan_device(self, node, si, mirror_leader: bool) -> Optional[List[Tuple]]:
         """Return the ordered inbox slot plan, or None for the host path.
 
         Slot order mirrors the scalar replay order in
-        ``Node.step_with_inputs``: received messages, proposals, ticks.
+        ``Node.step_with_inputs``: received messages, proposals,
+        read-indexes, ticks.  Reads stay on the device only when the
+        row's mirror says LEADER (the kernel's ReadIndex hot path); a
+        stale mirror is safe — the kernel reject-resps and the client
+        retries.
 
         Quiesce (reference: quiesceManager [U]) runs host-side even for
         device rows: quiesced ticks simply produce no TICK slots, so an
@@ -352,8 +357,9 @@ class VectorStepEngine(IStepEngine):
             or si.cc_results
             or si.snapshot_reqs
             or si.transfers
-            or si.read_indexes
         ):
+            return None
+        if si.read_indexes and not mirror_leader:
             return None
         if node.quiesce.enabled and node.quiesce.is_quiesced() and (
             si.received or si.proposals
@@ -376,6 +382,12 @@ class VectorStepEngine(IStepEngine):
         for m in si.received:
             if int(m.type) not in _HOT_SET:
                 return None
+            if int(m.type) == int(MessageType.READ_INDEX):
+                # a follower-FORWARDED read: the kernel's hot path only
+                # answers to self, so the wire response to the origin
+                # must come from the scalar leader (host path) — device
+                # handling would silently swallow the follower's read
+                return None
             if len(m.entries) > self.E:
                 return None
             # the device inbox is int32; 64-bit fields (e.g. ReadIndex ctx
@@ -394,6 +406,8 @@ class VectorStepEngine(IStepEngine):
         props = si.proposals
         for i in range(0, len(props), E):
             slots.append(("prop", props[i : i + E]))
+        for ctx in si.read_indexes:
+            slots.append(("read", ctx))
         # conservative capacity check BEFORE consuming quiesce state so a
         # host fallback never double-processes ticks/activity
         if len(slots) + si.ticks > self.M:
@@ -461,7 +475,13 @@ class VectorStepEngine(IStepEngine):
         idx = self._put(jnp.asarray(_pad_idx(gs)))
         sub = jax.tree.map(np.asarray, _gather_rows(st, idx))
         for k, g in enumerate(gs):
-            r = self._meta[g].node.peer.raft
+            node = self._meta[g].node
+            if node.device_reads.has_pending():
+                # the scalar path takes over: device-read confirmations
+                # ride device steps and would never arrive — fail fast
+                # so clients retry on the host path
+                node.drop_device_reads()
+            r = node.peer.raft
             r.term = int(sub.term[k])
             r.vote = int(sub.vote[k])
             r.leader_id = int(sub.leader_id[k])
@@ -538,7 +558,11 @@ class VectorStepEngine(IStepEngine):
                 if g is None:
                     host_rows.append((node, si))
                     continue
-                plan = self._plan_device(node, si)
+                mirror_leader = (
+                    not self._meta[g].dirty
+                    and self._mirror[_R_ROLE, g] == int(RaftRole.LEADER)
+                )
+                plan = self._plan_device(node, si, mirror_leader)
                 if plan is None:
                     host_rows.append((node, si))
                     continue
@@ -620,8 +644,25 @@ class VectorStepEngine(IStepEngine):
                         )
                     )
                     stage[slot] = list(payload)
-                else:  # tick
-                    row_msgs.append(Message(type=MessageType.LOCAL_TICK))
+                elif kind == "read":
+                    self.stats["device_reads"] += 1
+                    row_msgs.append(
+                        Message(
+                            type=MessageType.READ_INDEX,
+                            hint=payload.low,
+                            hint_high=payload.high,
+                        )
+                    )
+                else:  # tick — carry the latest pending read ctx so lost
+                    # confirmations retry on the heartbeat cadence
+                    pc = node.device_reads.peek_ctx()
+                    row_msgs.append(
+                        Message(
+                            type=MessageType.LOCAL_TICK,
+                            hint=pc.low if pc else 0,
+                            hint_high=pc.high if pc else 0,
+                        )
+                    )
             if stage:
                 staging[g] = stage
             if any(k == "prop" for k, _ in plan) or any(
@@ -747,6 +788,12 @@ class VectorStepEngine(IStepEngine):
             r.role = RaftRole(role)
             if committed > r.log.committed:
                 r.log.commit_to(committed)
+            if (
+                role != int(RaftRole.LEADER)
+                and node.device_reads.has_pending()
+            ):
+                # leadership lost: confirmations will never arrive
+                node.drop_device_reads()
             # 3. outbox -> messages with payload attachment
             if g in buf_at:
                 self._attach_messages(
@@ -870,6 +917,14 @@ class VectorStepEngine(IStepEngine):
         for msg, n_ent, src_slot in S.decode_out_row(
             shim, 0, r.shard_id, r.replica_id
         ):
+            if (
+                msg.type == MessageType.READ_INDEX_RESP
+                and msg.to == r.replica_id
+            ):
+                # synthetic host-coordination message from the kernel's
+                # ReadIndex hot path — never hits the wire
+                node.handle_device_read_resp(msg)
+                continue
             if msg.type == MessageType.REPLICATE and n_ent > 0:
                 ents = self._replicate_payload(r, msg, n_ent)
                 if ents is None:

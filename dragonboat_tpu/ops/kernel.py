@@ -59,6 +59,7 @@ from .types import (
     MT_HEARTBEAT_RESP,
     MT_INSTALL_SNAPSHOT,
     MT_PROPOSE,
+    MT_READ_INDEX,
     MT_READ_INDEX_RESP,
     MT_REPLICATE,
     MT_REPLICATE_RESP,
@@ -497,9 +498,11 @@ def _broadcast_replicate(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
     return st, out
 
 
-def _broadcast_heartbeat(st, out, mask) -> DeviceOut:
-    """oracle: broadcast_heartbeat (device path carries no read-index ctx;
-    rows with pending reads are host-stepped — see engine routing)."""
+def _broadcast_heartbeat(st, out, mask, hint=0, hint_high=0) -> DeviceOut:
+    """oracle: broadcast_heartbeat.  ``hint``/``hint_high`` carry a
+    pending read-index ctx ([G] or scalar): tick slots get the host's
+    latest pending ctx, READ_INDEX slots their own (the device
+    ReadIndex hot path — see engine)."""
     for p in range(st.P):
         pm = mask & _valid(st)[:, p] & (st.self_slot != p)
         out = _emit(
@@ -509,6 +512,8 @@ def _broadcast_heartbeat(st, out, mask) -> DeviceOut:
             to=st.peer_id[:, p],
             term=st.term,
             commit=jnp.minimum(st.match[:, p], st.committed),
+            hint=hint,
+            hint_high=hint_high,
         )
     return out
 
@@ -617,7 +622,7 @@ def _check_quorum(st, mask) -> DeviceState:
 # ---------------------------------------------------------------------------
 # tick (oracle: Raft.tick)
 # ---------------------------------------------------------------------------
-def _tick(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
+def _tick(st, out, mask, E, hint=0, hint_high=0) -> Tuple[DeviceState, DeviceOut]:
     lead = mask & (st.role == ROLE_LEADER)
     non = mask & (st.role != ROLE_LEADER)
     # --- leader tick ---------------------------------------------------
@@ -636,7 +641,7 @@ def _tick(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
     )
     hb_fire = still & (st.heartbeat_tick >= st.heartbeat_timeout)
     st = st._replace(heartbeat_tick=_w(hb_fire, 0, st.heartbeat_tick))
-    out = _broadcast_heartbeat(st, out, hb_fire)
+    out = _broadcast_heartbeat(st, out, hb_fire, hint, hint_high)
     # --- non-leader tick ----------------------------------------------
     el2 = st.election_tick + 1
     time_up = el2 >= st.rand_timeout
@@ -976,7 +981,85 @@ def _handle_heartbeat_resp(st, out, msg, mask, E):
     )
     lag = m & (_col(st.match, slot) < st.last_index)
     st, out = _send_replicate(st, out, lag, slot, E)
+    # read-index ctx echo: surface the confirmation to the HOST as a
+    # synthetic READ_INDEX_RESP-to-self (log_index = confirming voter;
+    # the engine routes self-addressed resps to node.device_reads).
+    # Only VOTING members count — matching the oracle's quorum gate.
+    kind = _col(st.peer_kind, slot)
+    voter = (kind == KIND_VOTER) | (kind == KIND_WITNESS)
+    has_ctx = m & voter & ((msg["hint"] != 0) | (msg["hint_high"] != 0))
+    out = _emit(
+        out,
+        has_ctx,
+        mtype=MT_READ_INDEX_RESP,
+        to=st.replica_id,
+        term=st.term,
+        log_index=msg["from_id"],
+        hint=msg["hint"],
+        hint_high=msg["hint_high"],
+    )
     return st, out
+
+
+def _handle_read_index(st, out, msg, mask) -> DeviceOut:
+    """Device ReadIndex hot path (oracle: _handle_leader_read_index).
+
+    The ctx -> (index, acks) table lives on the HOST (node.device_reads);
+    the kernel only emits synthetic READ_INDEX_RESP-to-self messages the
+    engine intercepts:
+
+        reject=1                     -> drop the pending read (not
+                                        leader / current-term gate)
+        reject=0, log_index=0        -> request recorded at index=commit
+        reject=0, log_index=K>0      -> confirmation from voter K
+                                        (emitted by heartbeat-resp)
+
+    and broadcasts the quorum-confirming heartbeats with the ctx riding
+    the hint fields — so a read-heavy workload stays device-resident.
+    """
+    lead = mask & (st.role == ROLE_LEADER) & (_self_kind(st) != KIND_WITNESS)
+    non_lead = mask & ~lead
+    out = _emit(
+        out,
+        non_lead,
+        mtype=MT_READ_INDEX_RESP,
+        to=st.replica_id,
+        term=st.term,
+        reject=1,
+        hint=msg["hint"],
+        hint_high=msg["hint_high"],
+    )
+    # oracle: committed_entry_in_current_term — unsafe to serve before
+    # the leader's no-op barrier commits
+    ok, esc = _match_term(st, st.committed, st.term)
+    out = out._replace(
+        escalate=out.escalate | jnp.where(lead & esc, ESC_WINDOW, 0)
+    )
+    gate_fail = lead & ~ok & ~esc
+    out = _emit(
+        out,
+        gate_fail,
+        mtype=MT_READ_INDEX_RESP,
+        to=st.replica_id,
+        term=st.term,
+        reject=1,
+        hint=msg["hint"],
+        hint_high=msg["hint_high"],
+    )
+    serve = lead & ok
+    out = _emit(
+        out,
+        serve,
+        mtype=MT_READ_INDEX_RESP,
+        to=st.replica_id,
+        term=st.term,
+        commit=st.committed,
+        hint=msg["hint"],
+        hint_high=msg["hint_high"],
+    )
+    # single-voter groups confirm instantly host-side (quorum == 1)
+    multi = serve & (_num_voters(st) > 1)
+    return _broadcast_heartbeat(st, out, multi, msg["hint"], msg["hint_high"])
 
 
 def _handle_unreachable(st, msg, mask):
@@ -1098,7 +1181,9 @@ def _process_slot(st, out, msg, slot_i, E):
     mask = mask & _is_hot(mt)
 
     # LOCAL_TICK short-circuits the gate (oracle: handle)
-    st, out = _tick(st, out, mask & (mt == MT_TICK), E)
+    st, out = _tick(
+        st, out, mask & (mt == MT_TICK), E, msg["hint"], msg["hint_high"]
+    )
     rest = mask & (mt != MT_TICK)
     st, out, passed = _on_message_term(st, out, msg, rest)
 
@@ -1121,6 +1206,7 @@ def _process_slot(st, out, msg, slot_i, E):
     # ---- leader role --------------------------------------------------
     lead = role_routed & (st.role == ROLE_LEADER)
     st, out = _handle_propose(st, out, msg, role_routed & (mt == MT_PROPOSE), slot_i, E)
+    out = _handle_read_index(st, out, msg, role_routed & (mt == MT_READ_INDEX))
     st = _check_quorum(st, lead & (mt == MT_CHECK_QUORUM))
     st, out = _handle_replicate_resp(
         st, out, msg, lead & (mt == MT_REPLICATE_RESP), E

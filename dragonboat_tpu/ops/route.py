@@ -334,6 +334,50 @@ def make_prefill(
     )
 
 
+def merge_and_route(
+    old_state: DeviceState,
+    new_state: DeviceState,
+    out,
+    dest_row: jnp.ndarray,
+    rank_in_dest: jnp.ndarray,
+    *,
+    M: int,
+    E: int,
+    budget: int,
+    base: int,
+    propose_leaders: bool = False,
+    propose_n: int = 1,
+) -> Tuple[DeviceState, Inbox, RouteStats, jnp.ndarray]:
+    """The post-step tail of a consensus round: undo escalated rows
+    (their device effects are discarded — the host-replay contract minus
+    the replay; dropping the inputs is raft-safe message loss), then
+    route the outboxes into the next round's inbox on top of a fresh
+    tick/proposal prefill.  Shared by ``routed_round`` and callers that
+    jit step/route as SEPARATE programs for compile time (bench.py).
+
+    Returns (state', inbox', stats, escalated_row_count).
+    """
+    esc = out.escalate != 0
+    n_esc = jnp.sum(esc, dtype=I32)
+    keep = ~esc
+
+    def sel(a, b):
+        m = keep.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, b, a)
+
+    state = jax.tree.map(sel, old_state, new_state)
+    prefill = make_prefill(
+        state, M, E,
+        propose_leaders=propose_leaders, propose_n=propose_n,
+    )
+    inbox, stats = route(
+        state, out, dest_row, rank_in_dest,
+        M=M, E=E, budget=budget, base=base,
+        base_inbox=prefill, suppress=esc,
+    )
+    return state, inbox, stats, n_esc
+
+
 def routed_round(
     state: DeviceState,
     inbox: Inbox,
@@ -346,34 +390,14 @@ def routed_round(
     propose_leaders: bool = False,
     propose_n: int = 1,
 ) -> Tuple[DeviceState, Inbox, RouteStats, jnp.ndarray]:
-    """One full consensus round: step every row through ``inbox``, undo
-    escalated rows (their device effects are discarded, exactly the
-    host-replay contract minus the replay — dropping the inputs is
-    raft-safe message loss), then route the outboxes into the next
-    round's inbox on top of a fresh tick/proposal prefill.
-
-    Returns (state', inbox', stats, escalated_row_count).
-    """
+    """One full consensus round: step every row through ``inbox``, then
+    ``merge_and_route`` the outboxes into the next round's inbox."""
     from . import kernel as K
 
     M, E = inbox.M, inbox.E
     new_state, out = K.step(state, inbox, out_capacity=out_capacity)
-    esc = out.escalate != 0
-    n_esc = jnp.sum(esc, dtype=I32)
-    keep = ~esc
-
-    def sel(a, b):
-        m = keep.reshape((-1,) + (1,) * (a.ndim - 1))
-        return jnp.where(m, b, a)
-
-    state = jax.tree.map(sel, state, new_state)
-    prefill = make_prefill(
-        state, M, E,
+    return merge_and_route(
+        state, new_state, out, dest_row, rank_in_dest,
+        M=M, E=E, budget=budget, base=base,
         propose_leaders=propose_leaders, propose_n=propose_n,
     )
-    inbox, stats = route(
-        state, out, dest_row, rank_in_dest,
-        M=M, E=E, budget=budget, base=base,
-        base_inbox=prefill, suppress=esc,
-    )
-    return state, inbox, stats, n_esc

@@ -80,6 +80,7 @@ HOT_TYPES = (
     MT_TICK,
     MT_ELECTION,
     MT_PROPOSE,
+    MT_READ_INDEX,
     MT_REPLICATE,
     MT_REPLICATE_RESP,
     MT_REQUEST_VOTE,

@@ -841,7 +841,14 @@ class Raft:
         rm.respond_to()
         if rm.match < self.log.last_index():
             self.send_replicate(m.from_)
-        if m.hint or m.hint_high:
+        if (m.hint or m.hint_high) and (
+            m.from_ in self.remotes or m.from_ in self.witnesses
+        ):
+            # only VOTING members count toward the read quorum: a
+            # non-voting replica echoes heartbeat ctx hints too, and
+            # counting it would confirm linearizable reads without a
+            # real quorum (reference: etcd readOnly acks are tracked on
+            # the voter progress set [U])
             self._read_index_confirm(SystemCtx(low=m.hint, high=m.hint_high), m.from_)
 
     def _read_index_confirm(self, ctx: SystemCtx, from_: int) -> None:

@@ -61,6 +61,14 @@ class ReadIndex:
         self.queue = self.queue[done:]
         return out
 
+    def drop(self, ctx: SystemCtx) -> Optional[ReadStatus]:
+        """Remove one pending request (e.g. the leader refused it)."""
+        key = (ctx.low, ctx.high)
+        s = self.pending.pop(key, None)
+        if s is not None:
+            self.queue.remove(key)
+        return s
+
     def has_pending(self) -> bool:
         return bool(self.queue)
 

@@ -182,7 +182,13 @@ class PendingReadIndex(_PendingBase):
 
     def read(self, deadline: int) -> Tuple[SystemCtx, RequestState]:
         rs = self._alloc(deadline)
-        ctx = SystemCtx(low=rs.key, high=rs.key ^ 0x5DEECE66D)
+        # each half stays < 2^31 so the ctx can ride the device inbox's
+        # int32 hint fields (ops/engine.py device ReadIndex) and every
+        # wire codec without sign trouble; keys are sequential from a
+        # 61-bit randomized base, so the split stays injective
+        ctx = SystemCtx(
+            low=rs.key & 0x7FFFFFFF, high=(rs.key >> 31) & 0x7FFFFFFF
+        )
         with self._lock:
             self._ctx_map[(ctx.low, ctx.high)] = rs.key
         return ctx, rs

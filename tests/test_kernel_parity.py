@@ -205,3 +205,47 @@ def test_randomized_fuzz(seed):
         c.step(batches)
     # liveness sanity: at least one group elected some leader at some point
     assert any(r.term > 0 for r in c.rafts.values())
+
+
+def test_read_index_hot_path_leader():
+    """READ_INDEX on the leader row: the kernel must gate on a
+    current-term commit, broadcast ctx-carrying heartbeats identical to
+    the oracle's, and stay bit-parity through the confirm cycle (the
+    synthetic self-resp side channel is excluded by the harness)."""
+    c = Cluster({1: [1, 2, 3]})
+    lid = c.elect(1)
+    key = (1, lid)
+    # commit one entry at the leader's term so the read gate passes
+    c.step({key: [c.propose(1, lid, [b"v"])]})
+    c.run(4, tick=False)
+    # a local read: ctx rides the hint fields
+    c.step({key: [Message(type=MessageType.READ_INDEX, hint=77, hint_high=88)]})
+    # the ctx heartbeats + their responses settle with full state parity
+    c.run(3, tick=False)
+    assert c.rafts[key].read_index.has_pending() is False
+
+
+def test_read_index_before_term_commit_is_dropped():
+    """Before the leader's no-op barrier commits, reads must be refused
+    (oracle: dropped_read_indexes; kernel: reject self-resp + parity)."""
+    c = Cluster({1: [1, 2, 3]})
+    # drive ticks ONLY until a leader appears — its no-op barrier is
+    # appended but cannot have committed (no REPLICATE_RESP delivered,
+    # responses still sit in the in-flight net queues)
+    lid = None
+    for _ in range(200):
+        c.step(c.deliver_batches(tick=True))
+        if (lid := c.leader_of(1)) is not None:
+            break
+    assert lid is not None
+    key = (1, lid)
+    r = c.rafts[key]
+    assert r.log.committed < r.log.last_index(), "barrier already committed"
+    assert not r.committed_entry_in_current_term()
+    c.step({key: [Message(type=MessageType.READ_INDEX, hint=5, hint_high=6)]})
+    # the oracle refused the read; the kernel held bit-parity through
+    # the same refusal (its reject self-resp is filtered by the harness)
+    assert any(
+        ctx.low == 5 and ctx.high == 6 for ctx in r.dropped_read_indexes
+    ), r.dropped_read_indexes
+    assert not r.read_index.has_pending()
