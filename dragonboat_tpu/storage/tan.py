@@ -26,7 +26,7 @@ from typing import List, Optional
 
 from ..logger import get_logger
 from ..pb import Bootstrap, Entry, Snapshot, State, Update
-from ..raftio import ILogDB, NodeInfo, RaftState
+from ..raftio import ILogDB, NodeInfo
 from ..transport.wire import (
     MAX_PAYLOAD,
     WireError,
@@ -38,6 +38,7 @@ from ..transport.wire import (
     bounded_decompress,
     maybe_compress,
 )
+from .journal import CorruptJournalError, scan_segment
 from .logdb import InMemLogDB
 from .vfs import DEFAULT as OS_VFS, IVFS, OSVFS
 
@@ -64,7 +65,7 @@ DEFAULT_MAX_SEGMENT_BYTES = 64 * 1024 * 1024
 DEFAULT_GC_SEGMENTS = 4
 
 
-class CorruptLogError(Exception):
+class CorruptLogError(CorruptJournalError):
     """Mid-log corruption (not a clean torn tail)."""
 
 
@@ -233,41 +234,15 @@ class TanLogDB(ILogDB):
             self._replay_segment(self._segment_path(seq), torn_ok=last)
 
     def _replay_segment(self, path: str, torn_ok: bool) -> None:
-        data = self.fs.read_file(path)
-        pos = 0
-        n = len(data)
-        while pos < n:
-            if pos + _REC_HEADER.size > n:
-                if torn_ok:
-                    return self._truncate_tail(path, pos)
-                raise CorruptLogError(f"{path}: torn header at {pos}")
-            kind, length, crc = _REC_HEADER.unpack_from(data, pos)
-            body_at = pos + _REC_HEADER.size
-            if body_at + length > n:
-                if torn_ok:
-                    return self._truncate_tail(path, pos)
-                raise CorruptLogError(f"{path}: torn body at {pos}")
-            body = data[body_at : body_at + length]
-            if zlib.crc32(body) != crc:
-                if torn_ok and body_at + length == n:
-                    return self._truncate_tail(path, pos)  # torn final record
-                raise CorruptLogError(f"{path}: bad crc at {pos}")
-            try:
-                if kind & K_COMPRESSED:
-                    kind &= ~K_COMPRESSED
-                    body = bounded_decompress(body, MAX_PAYLOAD)
-                self._apply_record(kind, body)
-            except (WireError, ValueError, struct.error, zlib.error) as e:
-                raise CorruptLogError(f"{path}: bad record at {pos}: {e}")
-            pos = body_at + length
+        def apply(kind: int, body: bytes) -> None:
+            if kind & K_COMPRESSED:
+                kind &= ~K_COMPRESSED
+                body = bounded_decompress(body, MAX_PAYLOAD)
+            self._apply_record(kind, body)
 
-    def _truncate_tail(self, path: str, pos: int) -> None:
-        """Cut the torn bytes off a crash tail — otherwise the next open
-        replays this segment as a non-last segment (torn_ok=False) and the
-        WAL becomes permanently unopenable."""
-        _log.warning("%s: truncating torn tail at %d", path, pos)
-        self.fs.truncate(path, pos)
-        self._sync_dir()
+        # shared scanner (storage/journal.py): torn-tail truncation +
+        # crc/structure rules identical across the durable backends
+        scan_segment(self.fs, path, self.dir, torn_ok, apply, CorruptLogError)
 
     def _apply_record(self, kind: int, body: bytes) -> None:
         r = _R(body)

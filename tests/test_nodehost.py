@@ -66,13 +66,14 @@ def set_cmd(k, v):
 ADDRS = {1: "nh-1", 2: "nh-2", 3: "nh-3"}
 
 
-def make_nodehost(replica_id, rtt_ms=2, workers=2):
+def make_nodehost(replica_id, rtt_ms=2, workers=2, logdb_factory=None):
     cfg = NodeHostConfig(
         nodehost_dir=f"/tmp/nh-{replica_id}",
         rtt_millisecond=rtt_ms,
         raft_address=ADDRS[replica_id],
         expert=ExpertConfig(
-            engine=EngineConfig(exec_shards=workers, apply_shards=workers)
+            engine=EngineConfig(exec_shards=workers, apply_shards=workers),
+            logdb_factory=logdb_factory,
         ),
     )
     return NodeHost(cfg)

@@ -242,15 +242,22 @@ def check_against(db: TanLogDB, model_variants):
 
 @pytest.mark.parametrize("seed", range(12))
 def test_tan_powerloss_fuzz(seed):
-    rng = random.Random(seed)
     fs = StrictMemFS()
     # tiny segments force rotation + checkpoint GC under the fuzz
-    def open_db():
-        return TanLogDB(
+    run_powerloss_fuzz(
+        fs,
+        lambda: TanLogDB(
             "/wal", fs=fs, use_native=False,
             max_segment_bytes=700, gc_segments=2,
-        )
+        ),
+        seed,
+    )
 
+
+def run_powerloss_fuzz(fs: StrictMemFS, open_db, seed: int) -> None:
+    """Backend-agnostic kill-at-any-io-boundary fuzz over any ILogDB
+    constructed on ``fs`` (shared by the tan and sharded-KV backends)."""
+    rng = random.Random(seed)
     db = open_db()
     model = Model()
     next_index = {(s, r): 1 for s in (1, 2) for r in (1,)}
