@@ -23,6 +23,7 @@ import time
 from typing import Dict, List, Optional, TYPE_CHECKING
 
 from ..logger import get_logger
+from ..utils.stopper import Stopper
 
 if TYPE_CHECKING:
     from ..node import Node
@@ -134,36 +135,31 @@ class ExecEngine:
         self.step_engine = step_engine or HostStepEngine(logdb)
         self._nodes: Dict[int, "Node"] = {}  # shard_id -> node
         self._nodes_lock = threading.RLock()
-        self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
-        for i in range(step_workers):
-            t = threading.Thread(
-                target=self._step_worker_main,
-                args=(i,),
-                daemon=True,
-                name=f"tpu-raft-step-{i}",
-            )
-            self._threads.append(t)
-        for i in range(apply_workers):
-            t = threading.Thread(
-                target=self._apply_worker_main,
-                args=(i,),
-                daemon=True,
-                name=f"tpu-raft-apply-{i}",
-            )
-            self._threads.append(t)
+        # owned-thread lifecycle (reference: syncutil.Stopper [U]):
+        # stop() signals + joins every worker and reports stragglers
+        self._stopper = Stopper("tpu-raft-engine")
+        self._stop = self._stopper.should_stop
+        self._worker_plan = [
+            (self._step_worker_main, f"tpu-raft-step-{i}")
+            for i in range(step_workers)
+        ] + [
+            (self._apply_worker_main, f"tpu-raft-apply-{i}")
+            for i in range(apply_workers)
+        ]
 
     def start(self) -> None:
         self.step_engine.start()
-        for t in self._threads:
-            t.start()
+        for i, (fn, name) in enumerate(self._worker_plan):
+            wid = int(name.rsplit("-", 1)[1])
+            self._stopper.run_worker(lambda f=fn, w=wid: f(w), name)
 
     def stop(self) -> None:
         self._stop.set()
         self.step_ready.wake()
         self.apply_ready.wake()
-        for t in self._threads:
-            t.join(timeout=2.0)
+        leaked = self._stopper.stop(timeout=2.0)
+        if leaked:
+            _log.warning("engine workers leaked at stop: %s", leaked)
         self.step_engine.stop()
 
     # -- registration -----------------------------------------------------

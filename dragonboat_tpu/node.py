@@ -507,6 +507,24 @@ class Node:
         if not u.snapshot.is_empty():
             self._install_snapshot(u.snapshot)
         if u.entries_to_save:
+            from .invariants import check
+
+            ents = u.entries_to_save
+            check(
+                all(
+                    ents[i].index + 1 == ents[i + 1].index
+                    for i in range(len(ents) - 1)
+                ),
+                "entries_to_save not contiguous: %s",
+                [e.index for e in ents[:8]],
+            )
+            check(
+                u.state.is_empty() or u.state.commit <= ents[-1].index
+                or u.state.commit <= self.log_reader.last_index()
+                or not u.snapshot.is_empty(),
+                "hard-state commit %d beyond save window",
+                u.state.commit,
+            )
             self.log_reader.append(u.entries_to_save)
         for m in u.messages:
             self.transport.send(m)

@@ -208,6 +208,14 @@ class StateMachine:
         return results
 
     def _advance(self, e: Entry) -> None:
+        from ..invariants import check
+
+        check(
+            e.index <= self.last_applied + 1,
+            "apply gap: entry %d after applied %d",
+            e.index,
+            self.last_applied,
+        )
         if e.index > self.last_applied:
             self.last_applied = e.index
             self.applied_term = e.term

@@ -7,6 +7,10 @@ bench path is bench.py.
 import os
 import sys
 
+# run the whole suite with internal invariant assertions ON (reference:
+# build-tag-gated internal/invariants checks enabled in CI builds [U])
+os.environ.setdefault("DRAGONBOAT_TPU_INVARIANTS", "1")
+
 # NOTE: this image's sitecustomize imports jax at interpreter start to
 # register the TPU tunnel plugin, so mutating JAX_PLATFORMS here is too
 # late — pin the backend via jax.config before first backend init instead.

@@ -1,0 +1,109 @@
+"""Lifecycle + invariants: Stopper, thread-leak checks, gated asserts.
+
+reference: internal/utils/syncutil.Stopper + leaktest + the
+internal/invariants build-tag checks [U].
+"""
+from __future__ import annotations
+
+import shutil
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu.invariants import InvariantViolation, check, enable
+from dragonboat_tpu.utils.stopper import Stopper
+
+
+class TestStopper:
+    def test_workers_exit_on_signal(self):
+        st = Stopper("t")
+        ran = threading.Event()
+
+        def worker():
+            ran.set()
+            st.should_stop.wait(5)
+
+        st.run_worker(worker, "w1")
+        assert ran.wait(2)
+        leaked = st.stop(timeout=2)
+        assert leaked == []
+
+    def test_straggler_reported(self):
+        st = Stopper("t")
+        block = threading.Event()
+        st.run_worker(lambda: block.wait(10), "stuck")
+        leaked = st.stop(timeout=0.2)
+        assert leaked == ["stuck"]
+        block.set()
+
+    def test_no_spawn_after_stop(self):
+        st = Stopper("t")
+        st.stop()
+        with pytest.raises(RuntimeError):
+            st.run_worker(lambda: None)
+
+
+class TestInvariants:
+    def test_check_raises_when_enabled(self):
+        enable(True)
+        check(True, "fine")
+        with pytest.raises(InvariantViolation, match="boom 7"):
+            check(False, "boom %d", 7)
+
+    def test_check_noop_when_disabled(self):
+        enable(False)
+        try:
+            check(False, "never raises")
+        finally:
+            enable(True)  # conftest default for the rest of the suite
+
+
+class TestThreadLeaks:
+    def test_nodehost_cycles_leak_no_threads(self):
+        """Open/close cycles must not accrete threads — the engine's
+        Stopper joins every worker (the leaktest contract)."""
+        from dragonboat_tpu import (
+            EngineConfig,
+            ExpertConfig,
+            NodeHost,
+            NodeHostConfig,
+        )
+        from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+        from test_nodehost import KVStore, shard_config
+
+        def cycle(i):
+            reset_inproc_network()
+            shutil.rmtree("/tmp/nh-leak-1", ignore_errors=True)
+            nh = NodeHost(
+                NodeHostConfig(
+                    nodehost_dir="/tmp/nh-leak-1",
+                    rtt_millisecond=2,
+                    raft_address="leak-1",
+                    expert=ExpertConfig(
+                        engine=EngineConfig(exec_shards=2, apply_shards=2)
+                    ),
+                )
+            )
+            nh.start_replica({1: "leak-1"}, False, KVStore, shard_config(1))
+            s = nh.get_noop_session(1)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    nh.sync_propose(s, b"\x00k\x00v", timeout=1.0)
+                    break
+                except Exception:
+                    time.sleep(0.05)
+            nh.close()
+
+        cycle(0)  # warm lazy singletons
+        baseline = threading.active_count()
+        for i in range(3):
+            cycle(i + 1)
+        time.sleep(0.3)
+        after = threading.active_count()
+        assert after <= baseline + 1, (
+            f"thread leak across nodehost cycles: {baseline} -> {after}: "
+            f"{[t.name for t in threading.enumerate()]}"
+        )
