@@ -675,8 +675,11 @@ class VectorStepEngine(IStepEngine):
         inbox = jax.device_put(inbox, self._device)
 
         old_state = self._state
-        new_state, out = K.step(old_state, inbox, out_capacity=self.O)
-        summary = np.asarray(_summarize(new_state, out))
+        from ..profiling import annotate
+
+        with annotate("raft-device-step"):
+            new_state, out = K.step(old_state, inbox, out_capacity=self.O)
+            summary = np.asarray(_summarize(new_state, out))
         self.stats["device_steps"] += 1
         self.stats["device_rows_stepped"] += len(batch)
 

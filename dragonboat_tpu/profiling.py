@@ -1,0 +1,41 @@
+"""Kernel tracing/profiling hooks (SURVEY §5.1).
+
+The reference's observability for hot loops is Go pprof; the TPU-native
+equivalent is the JAX/XLA device profiler (xplane traces viewable in
+TensorBoard/xprof).  This module is a thin, dependency-light wrapper so
+the engine and the bench can be traced without importing jax at module
+scope anywhere in the host runtime.
+
+Usage:
+    from dragonboat_tpu.profiling import trace, annotate
+
+    with trace("/tmp/raft-xplane"):
+        ... run a workload ...            # device trace captured
+
+    with annotate("device-step"):         # named region in the trace
+        ... kernel launch ...
+
+``BENCH_PROFILE=<dir> python bench.py`` captures the timed window.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace (xplane) into ``log_dir``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region for the device trace (no-op cost off-profile)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

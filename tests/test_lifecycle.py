@@ -107,3 +107,20 @@ class TestThreadLeaks:
             f"thread leak across nodehost cycles: {baseline} -> {after}: "
             f"{[t.name for t in threading.enumerate()]}"
         )
+
+
+class TestProfiling:
+    def test_trace_produces_xplane(self, tmp_path):
+        """SURVEY §5.1: the kernel is traceable via the JAX profiler."""
+        import glob
+
+        import jax
+        import jax.numpy as jnp
+
+        from dragonboat_tpu.profiling import annotate, trace
+
+        with trace(str(tmp_path)):
+            with annotate("raft-test-region"):
+                jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
+        files = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+        assert files, f"no xplane trace written under {tmp_path}"
