@@ -286,3 +286,140 @@ class TestSnapshotInstallRaces:
         # not restored (stale); the resp points the leader at the real log
         assert r.log.last_index() == 12
         assert any(m.type == MessageType.REPLICATE_RESP for m in resps)
+
+
+# ---------------------------------------------------------------------------
+# the Figure-8 scenario: commit-only-current-term
+# ---------------------------------------------------------------------------
+class TestFigureEight:
+    def test_old_term_entry_not_committed_by_counting(self):
+        """Raft paper fig. 8: a leader must never commit an entry from a
+        PREVIOUS term by counting replicas — only a current-term entry's
+        quorum commits (and drags the older one with it)."""
+        net = Network.of(5)
+        net.elect(1)
+        r1 = net.peers[1]
+        term_e = r1.term
+        # (a) leader 1 replicates an entry ONLY to 2, then "crashes"
+        for p in (3, 4, 5):
+            net.isolate(p)
+        net.propose(1, b"old-term-entry")
+        idx = r1.log.last_index()
+        assert net.peers[2].log.last_index() == idx
+        assert r1.log.committed < idx  # 2/5 is no quorum
+        net.recover()
+        net.isolate(1)  # leader crashes
+        net.isolate(2)  # and so does its only copy-holder, for now
+        # (b) 3 wins term+1 with votes from 4,5 — then crashes before
+        # replicating anything (REPLICATE dropped so its barrier never
+        # reaches 4/5; only the votes travel)
+        net.drop_types.add(MessageType.REPLICATE)
+        net.submit(3, Message(type=MessageType.ELECTION))
+        net.drop_types.clear()
+        assert net.peers[3].role == RaftRole.LEADER
+        term_b = net.peers[3].term
+        assert term_b > term_e
+        net.recover()
+        net.isolate(3)
+        net.isolate(4)
+        net.isolate(5)
+        # (c) 1 returns, wins an election with 2's vote at a higher term,
+        # and re-replicates the OLD entry to 2 — still only 2/5 hold it
+        # at its ORIGINAL term; it must stay uncommitted
+        net.recover()
+        net.isolate(3)
+        # 1 rejoins and observes the higher term (a stray heartbeat from
+        # the term-b leader), stepping down — then campaigns past it
+        net.peers[1].handle(
+            Message(type=MessageType.HEARTBEAT, from_=3, to=1, term=term_b)
+        )
+        net.peers[1].drain_messages()
+        assert net.peers[1].role != RaftRole.LEADER
+        for _ in range(4):
+            net.submit(1, Message(type=MessageType.ELECTION))
+            if net.peers[1].role == RaftRole.LEADER:
+                break
+        r1 = net.peers[1]
+        assert r1.role == RaftRole.LEADER
+        # the critical invariant held throughout: the old-term entry was
+        # never committed while its only support was old-term replicas
+        assert term_e < r1.term
+        # (d) once the NEW leader commits a CURRENT-term entry, the old
+        # one commits transitively — and only then
+        net.recover()
+        pre = r1.log.committed
+        net.propose(1, b"current-term-entry")
+        assert r1.log.committed == r1.log.last_index()
+        assert r1.log.committed >= idx  # dragged the old entry with it
+        assert r1.log.term(idx) == term_e  # same old entry, same term
+
+    def test_quorum_of_old_term_alone_never_commits(self):
+        """Directly: acks for an old-term index do not move commit."""
+        net = Network.of(3)
+        net.elect(1)
+        r1 = net.peers[1]
+        # replicate an entry to everyone, but DROP the responses so the
+        # leader never learns; then force a term change and verify the
+        # new leader does not commit it by counting old acks
+        net.drop_types.add(MessageType.REPLICATE_RESP)
+        net.propose(1, b"e")
+        idx = r1.log.last_index()
+        assert r1.log.committed < idx
+        net.drop_types.clear()
+        # 2 campaigns at a higher term and wins (its log includes idx)
+        net.submit(2, Message(type=MessageType.ELECTION))
+        r2 = net.peers[2]
+        assert r2.role == RaftRole.LEADER
+        # becoming leader appends a barrier at the new term and commits
+        # it with a quorum — which drags idx; commit never happened at
+        # the OLD term (try_commit's current-term gate)
+        assert r2.log.committed == r2.log.last_index()
+        assert r2.log.term(idx) == r1.log.term(idx)
+
+
+# ---------------------------------------------------------------------------
+# duplicated / reordered traffic
+# ---------------------------------------------------------------------------
+class TestMessageResilience:
+    def test_duplicated_replicate_is_idempotent(self):
+        net = Network.of(3)
+        net.elect(1)
+        net.propose(1, b"x")
+        r2 = net.peers[2]
+        last = r2.log.last_index()
+        committed = r2.log.committed
+        # re-deliver a copy of the last REPLICATE (captured semantics:
+        # same prev/entries/commit)
+        ents = r2.log._get_entries(last, last + 1, 1 << 30)
+        dup = Message(
+            type=MessageType.REPLICATE,
+            from_=1, to=2, term=net.peers[1].term,
+            log_index=last - 1,
+            log_term=r2.log.term(last - 1),
+            commit=committed,
+            entries=tuple(ents),
+        )
+        for _ in range(3):
+            r2.handle(dup)
+            r2.drain_messages()
+        assert r2.log.last_index() == last
+        assert r2.log.committed == committed
+
+    def test_out_of_order_replicate_resp(self):
+        """A late, lower-index ack after a higher one must not regress
+        match/next or commit."""
+        net = Network.of(3)
+        net.elect(1)
+        r1 = net.peers[1]
+        for i in range(3):
+            net.propose(1, b"v%d" % i)
+        last = r1.log.last_index()
+        assert r1.log.committed == last
+        stale = Message(
+            type=MessageType.REPLICATE_RESP,
+            from_=2, to=1, term=r1.term, log_index=last - 2,
+        )
+        r1.handle(stale)
+        r1.drain_messages()
+        rm = r1.remotes[2]
+        assert rm.match >= last - 2 and r1.log.committed == last
