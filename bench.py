@@ -157,9 +157,11 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
         return route_j(st, new_st, out, dest, rank)
 
     stats_hist = []
+    t_warm = time.perf_counter()
     for _ in range(warm_launches * K):  # compile + elections settle
         st, inbox, s, n = one_round(st, inbox)
     jax.block_until_ready(st)
+    warm_secs = time.perf_counter() - t_warm  # dominated by XLA compile
 
     commit0 = np.asarray(st.committed).reshape(GROUPS, REPLICAS).max(1)
     rounds = timed_launches * K
@@ -169,7 +171,7 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
         stats_hist.append((s, n))  # device arrays; summed after the clock
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
-    acc_t = np.zeros(5, np.int64)
+    acc_t = np.zeros(6, np.int64)  # matches RouteStats._fields
     esc_t = 0
     for s, n in stats_hist:
         acc_t += np.asarray(s, np.int64)
@@ -192,7 +194,13 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
         "groups_advancing": int((commit1 > commit0).sum()),
         "escalations": esc_t,
         "dropped": int(acc_t[1] + acc_t[2] + acc_t[3]),
+        # host-only message classes (forwarded PROPOSE etc.): carried by
+        # the transport in the product engine, genuinely lost in this
+        # pure-device loop — recorded so routing loss is never invisible
+        "host_carried_lost": int(acc_t[5]),
         "messages_routed_per_sec": round(int(acc_t[0]) / dt, 1),
+        "compile_plus_warm_secs": round(warm_secs, 1),
+        "timed_secs": round(dt, 3),
     }
 
 
@@ -220,13 +228,37 @@ def main() -> None:
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
     groups = int(os.environ.get("BENCH_GROUPS", "1000" if smoke else "100000"))
     iters = 10 if smoke else 100
-    warm, timed, K = (4, 3, 8) if smoke else (8, 4, 16)
+    warm, timed, K = (4, 3, 8) if smoke else (6, 4, 16)
+
+    # The round-2 lesson (BENCH_r02 recorded rc=124 with an EMPTY tail):
+    # the driver's wall-clock budget is finite and a single JSON line at
+    # the very end records nothing when the run is killed early.  So the
+    # headline line is (re)printed after EVERY milestone — phase A, then
+    # each phase-B success — each line complete and parseable on its
+    # own.  Whatever the driver's cutoff, the last line standing is a
+    # valid result.
+    def emit(ticks_per_sec: float, a_groups, consensus) -> None:
+        print(
+            json.dumps(
+                {
+                    "metric": "raft_group_ticks_per_sec_per_chip",
+                    "value": round(ticks_per_sec, 1),
+                    "unit": "group-ticks/sec",
+                    "vs_baseline": round(ticks_per_sec / NORTH_STAR, 4),
+                    # the scale the phase-A number was actually measured
+                    # at — a tunnel-fault fallback to a smaller G must be
+                    # visible in the record, not silently comparable
+                    "phase_a_groups": a_groups,
+                    "consensus": consensus,
+                }
+            ),
+            flush=True,
+        )
 
     # Every measured phase runs in a FRESH subprocess: a device/tunnel
     # fault can kill a process SILENTLY (observed: SIGKILL-like death
     # with no traceback) and poisons the in-process jax backend, so
-    # isolation + retry is the only way to guarantee this run always
-    # prints its one JSON line.
+    # isolation is the only way to guarantee a printed line.
     def run_sub(code: str, marker: str, timeout: int):
         import subprocess
         import sys
@@ -246,21 +278,26 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — incl. TimeoutExpired
             return None, type(e).__name__
 
-    a_timeout = int(os.environ.get("BENCH_A_TIMEOUT", "900"))
-    ticks_per_sec, a_err = None, None
-    for attempt in range(3):
+    # Budget: one phase-A attempt (+1 retry at reduced scale), then one
+    # attempt per phase-B scale, descending.  No same-scale retries, no
+    # long sleeps — a failure falls DOWN the scale ladder instead.
+    a_timeout = int(os.environ.get("BENCH_A_TIMEOUT", "600"))
+    ticks_per_sec = -1.0  # record failure rather than crash
+    a_groups = 0
+    for a_scale in (groups, max(groups // 10, 100)):
         code = (
             "import jax, json, bench;"
-            f"print('BENCHA ' + json.dumps(bench.phase_a(jax, {groups}, "
+            f"print('BENCHA ' + json.dumps(bench.phase_a(jax, {a_scale}, "
             f"{iters})))"
         )
         val, a_err = run_sub(code, "BENCHA", a_timeout)
         if val is not None:
             ticks_per_sec = float(val)
+            a_groups = a_scale
             break
-        time.sleep(60)  # let a faulted tunnel recover before retrying
-    if ticks_per_sec is None:
-        ticks_per_sec = -1.0  # record the failure rather than crash
+        if a_scale != max(groups // 10, 100):
+            time.sleep(15)  # tunnel-recovery pause BETWEEN attempts only
+    emit(ticks_per_sec, a_groups, None)
 
     if profile_dir:
         # profiling runs a small phase A in-process with the tracer on
@@ -272,9 +309,16 @@ def main() -> None:
         except Exception:  # noqa: BLE001 — tracing must not cost the run
             pass
 
-    b_timeout = int(os.environ.get("BENCH_B_TIMEOUT", "900"))
+    # Phase-B scale ladder: XLA compile of the routed programs is the
+    # budget risk, not execution (measured on v5e-1: at 150k rows step
+    # compiles in ~70s + route ~200s, then a full consensus round runs
+    # in well under 1ms; at 300k rows compile alone can blow the whole
+    # driver budget).  50k groups is the north-star-adjacent scale that
+    # reliably fits; the ladder descends if the tunnel misbehaves.
+    b_timeout = int(os.environ.get("BENCH_B_TIMEOUT", "600"))
+    b_top = int(os.environ.get("BENCH_B_GROUPS", str(min(groups, 50000))))
     consensus = None
-    for scale in (groups, groups // 4, groups // 10):
+    for scale in (b_top, b_top // 2, b_top // 5):
         if scale < 100:
             break
         code = (
@@ -286,19 +330,7 @@ def main() -> None:
         if consensus is not None and "error" not in consensus:
             break
         consensus = {"error": f"{b_err or 'failed'} at {scale} groups"}
-        time.sleep(30)  # give a faulted tunnel a moment before retrying
-
-    print(
-        json.dumps(
-            {
-                "metric": "raft_group_ticks_per_sec_per_chip",
-                "value": round(ticks_per_sec, 1),
-                "unit": "group-ticks/sec",
-                "vs_baseline": round(ticks_per_sec / NORTH_STAR, 4),
-                "consensus": consensus,
-            }
-        )
-    )
+    emit(ticks_per_sec, a_groups, consensus)
 
 
 if __name__ == "__main__":

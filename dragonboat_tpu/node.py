@@ -236,7 +236,15 @@ class Node:
     # ------------------------------------------------------------------
     def add_tick(self) -> None:
         with self._qlock:
-            self._pending_ticks += 1
+            # cap the backlog at one election window: a node stalled past
+            # that (e.g. behind a one-off XLA compile) would otherwise
+            # replay several CheckQuorum/election windows back-to-back
+            # with no wall time for responses between them — combined
+            # with the per-step cap in step_with_inputs this bounds the
+            # quorum check to at most once per drained backlog.  Dropped
+            # ticks only slow the logical clock, which is liveness-safe.
+            if self._pending_ticks < self.config.election_rtt:
+                self._pending_ticks += 1
 
     def propose(
         self, session: Session, cmd: bytes, timeout_ticks: int
@@ -304,6 +312,14 @@ class Node:
         with self._qlock:
             self._cc_to_apply.append((cc, accepted))
 
+    def defer_ticks(self, n: int) -> None:
+        """Push drained-but-unprocessed ticks back (overload backpressure:
+        a step engine whose per-step input capacity is full processes what
+        fits and defers the rest; the logical clock lags wall clock
+        briefly instead of the row thrashing off the device)."""
+        with self._qlock:
+            self._pending_ticks += n
+
     def has_work(self) -> bool:
         with self._qlock:
             if (
@@ -364,6 +380,16 @@ class Node:
         transfers = si.transfers
         snapshot_reqs = si.snapshot_reqs
         ticks = si.ticks
+        # cap ticks per step at half an election window: the reference's
+        # ticker delivers ticks ONE at a time interleaved with message
+        # processing [U]; our batched drain would otherwise gulp several
+        # CheckQuorum/election windows in one step with zero wall time
+        # for responses to arrive — a healthy leader would step itself
+        # down.  Excess ticks are deferred (has_work re-arms the worker).
+        cap = max(1, self.peer.raft.election_timeout // 2)
+        if ticks > cap:
+            self.defer_ticks(ticks - cap)
+            si.ticks = ticks = cap
 
         # config-change application results from the apply loop
         for cc, accepted in cc_results:

@@ -1,0 +1,698 @@
+"""Colocated-cluster mode: one device state shared by several NodeHosts.
+
+The reference's step workers hand every outbound message to the
+transport even when the peer replica lives in the same process
+(reference: engine.go stepWorkerMain -> transport.Send [U]).  The
+``VectorStepEngine`` inherits that shape: each message round-trips
+device -> host decode -> transport -> host encode -> device.  When a
+whole cluster is colocated on one chip (multiple NodeHosts in one
+process — the standard test/bench topology, and the production topology
+for BASELINE configs 2-4), that detour is the scaling bottleneck.
+
+``ColocatedEngineGroup`` is the product configuration that removes it:
+
+    group = ColocatedEngineGroup(capacity=64, P=5, budget=2)
+    for each NodeHost config:
+        cfg.expert.step_engine_factory = group.factory
+
+Every member NodeHost's step engine becomes a facade over ONE shared
+``ColocatedVectorEngine``: all replicas live in one device state, and
+``ops/route.py`` scatters each step's outbox straight into co-located
+peers' inbox regions — elections, replication and commit advance run
+device-side, exactly like the consensus benchmark, while off-device
+peers (and host-only message classes) fall back to the per-host
+transport unchanged (route's ``delivered`` mask tells the host which
+messages it still owns).
+
+Payload reconstruction across replicas: device-routed REPLICATE carries
+only (term, is-config-change) per entry — the cmd bytes never leave the
+sending host.  Colocation makes the fix cheap: every stamped append is
+published to a shared per-shard entry cache (bounded by the ring
+lifetime), and a receiving replica's merge pulls payloads from the
+cache by (index, term).  A miss on a non-leader row fail-stops the
+replica (see ``VectorStepEngine._merge_appends``) — silent empty
+entries would diverge the SM.
+
+Concurrency: the colocated step holds the core lock end-to-end.  Member
+NodeHosts keep their own ExecEngines, apply workers, LogDBs and
+transports; only the step stage is fused.  A launch triggered by any
+member steps EVERY resident row (routed traffic may target any of
+them), and updates are persisted to each node's own LogDB before its
+messages are dispatched (the reference's save -> send -> apply order).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.execengine import IStepEngine
+from ..logger import get_logger
+from ..pb import Entry
+from ..raft.raft import RaftRole
+from . import kernel as K
+from . import sync as S
+from .engine import (
+    VectorStepEngine,
+    _R_APPEND_LO,
+    _R_BARRIER_IDX,
+    _R_BARRIER_TERM,
+    _R_COUNT,
+    _R_ESC,
+    _R_NEED_SS,
+    _R_ROLE,
+    _bucket,
+    _gather_detail,
+    _split_detail,
+    _summarize,
+    _tick_bookkeeping,
+    _pad_idx,
+    _set_remote_snapshot,
+)
+from .route import build_route_tables, route
+from .types import APPEND_LO_NONE, I32, Inbox, make_inbox
+
+_log = get_logger("engine")
+
+
+@jax.jit
+def _assemble_inbox(host: Inbox, pending: Inbox, alive: jnp.ndarray) -> Inbox:
+    """Concatenate host-encoded slots with the routed regions and zero
+    the rows that are not device-authoritative (dirty / detached): a
+    stale device row receiving traffic could double-vote."""
+
+    def cat(a, b):
+        x = jnp.concatenate([a, b], axis=1)
+        m = alive.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(m, x, 0)
+
+    return Inbox(*(cat(getattr(host, f), getattr(pending, f))
+                   for f in Inbox._fields))
+
+
+@functools.partial(jax.jit, static_argnames=("PB", "E", "budget"))
+def _route_step(old_state, new_state, out, dest, rank, dest_alive,
+                *, PB: int, E: int, budget: int):
+    """Post-launch tail: discard escalated rows' effects, then route the
+    outboxes into the next launch's pending regions (width P*budget,
+    base=0 — host slots are prepended at the next assemble)."""
+    esc = out.escalate != 0
+
+    def sel(a, b):
+        m = (~esc).reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, b, a)
+
+    merged = jax.tree.map(sel, old_state, new_state)
+    regions, stats, delivered = route(
+        merged, out, dest, rank,
+        M=PB, E=E, budget=budget, base=0,
+        suppress=esc, dest_alive=dest_alive,
+    )
+    return merged, regions, jnp.stack(list(stats)), delivered
+
+
+class ColocatedVectorEngine(VectorStepEngine):
+    """Shared device engine for several NodeHosts in one process.
+
+    Do not construct directly — use ``ColocatedEngineGroup``.
+    """
+
+    def __init__(self, *, budget: int = 2, capacity: int = 64, P: int = 5,
+                 W: int = 32, M: int = 8, E: int = 4, O: int = 32,
+                 device=None, mesh=None):
+        self.budget = budget
+        self._pending: Optional[Inbox] = None
+        self._pending_live = False  # last route delivered > 0 messages
+        self._host_shard = np.zeros((capacity,), np.int64)
+        self._host_replica = np.zeros((capacity,), np.int64)
+        self._host_peers = np.zeros((capacity, P), np.int64)
+        self._tables_dirty = True
+        self._dest_dev = None
+        self._rank_dev = None
+        # shard -> OrderedDict[(index, term) -> Entry]; bounded FIFO per
+        # shard, depth comfortably past the device ring lifetime so any
+        # entry still routable (ring_ok) is still reconstructible
+        self._entry_cache: Dict[int, "OrderedDict[Tuple[int, int], Entry]"] = {}
+        self._cache_depth = 8 * W
+        super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
+                         device=device, mesh=mesh)
+        self.stats.update(
+            launches=0, routed_delivered=0, routed_host_carried=0,
+            routed_dropped=0,
+        )
+
+    # -- row identity ---------------------------------------------------
+    def _row_key(self, node):
+        # several NodeHosts share this engine: replicas of one shard are
+        # distinct rows
+        return (node.shard_id, node.replica_id)
+
+    def _attach(self, node) -> Optional[int]:
+        key = self._row_key(node)
+        g = self._row_of.get(key)
+        if g is not None and self._meta[g].node is not node:
+            # replica restarted without a detach (stop raced the step):
+            # drop the stale binding and re-key freshly
+            self._row_of.pop(key)
+            self._meta.pop(g, None)
+            self._free.append(g)
+            g = None
+        is_new = key not in self._row_of
+        g = super()._attach(node)
+        if g is not None and is_new:
+            self._host_shard[g] = node.shard_id
+            self._host_replica[g] = node.replica_id
+            self._host_peers[g, :] = 0
+            self._tables_dirty = True
+        return g
+
+    def detach_replica(self, shard_id: int, replica_id: int) -> None:
+        with self._lock:
+            g = self._row_of.pop((shard_id, replica_id), None)
+            if g is not None:
+                self._meta.pop(g, None)
+                self._free.append(g)
+                self._host_shard[g] = 0
+                self._host_replica[g] = 0
+                self._host_peers[g, :] = 0
+                self._tables_dirty = True
+
+    def _upload_rows(self, rows) -> None:
+        super()._upload_rows(rows)
+        for g, r in rows:
+            lay = np.zeros((self.P,), np.int64)
+            for s, (pid, _) in enumerate(S.peer_layout(r)):
+                lay[s] = pid
+            if (self._host_peers[g] != lay).any():
+                self._host_peers[g] = lay
+                self._tables_dirty = True
+            # publish the uploaded ring window: entries appended on the
+            # HOST path (scalar excursions, WAL replay) can later be
+            # device-route-replicated straight from this row's ring, and
+            # the receiving replica reconstructs payloads from the cache
+            last = r.log.last_index()
+            lo = max(r.log.first_index(), last - self.W + 1)
+            if last >= lo:
+                try:
+                    ents = r.log._get_entries(lo, last + 1, 2**62)
+                except Exception:  # noqa: BLE001 — compacted tails are fine
+                    ents = []
+                self._cache_put(r.shard_id, ents)
+
+    def _rebuild_tables(self) -> None:
+        dest, rank = build_route_tables(
+            self._host_shard, self._host_replica, self._host_peers
+        )
+        self._dest_dev = self._put_rows(jnp.asarray(dest))
+        self._rank_dev = self._put_rows(jnp.asarray(rank))
+        self._tables_dirty = False
+
+    # -- entry cache ----------------------------------------------------
+    def _cache_put(self, shard_id: int, entries: List[Entry]) -> None:
+        od = self._entry_cache.setdefault(shard_id, OrderedDict())
+        for e in entries:
+            od[(e.index, e.term)] = e
+            od.move_to_end((e.index, e.term))
+        while len(od) > self._cache_depth:
+            od.popitem(last=False)
+
+    def _cache_lookup(self, r, idx: int, term: int) -> Optional[Entry]:
+        od = self._entry_cache.get(r.shard_id)
+        e = od.get((idx, term)) if od else None
+        if e is not None and r.replica_id in r.witnesses:
+            e = r._to_witness_entry(e)
+        return e
+
+    # -- warm -----------------------------------------------------------
+    def _warm(self) -> None:
+        G, P, B, E, O = self.capacity, self.P, self.budget, self.E, self.O
+        self._pending = self._put_rows(make_inbox(G, P * B, E))
+        st = self._state
+        host = self._put_rows(make_inbox(G, self.M, E))
+        alive = self._put_rows(jnp.zeros((G,), bool))
+        dest = self._put_rows(jnp.full((G, P), -1, I32))
+        rank = self._put_rows(jnp.zeros((G, P), I32))
+        full = _assemble_inbox(host, self._pending, alive)
+        new_st, out = K.step(st, full, out_capacity=O)
+        _summarize(new_st, out)
+        _route_step(st, new_st, out, dest, rank, alive,
+                    PB=P * B, E=E, budget=B)
+        from .engine import _gather_rows, _scatter_rows, _select_rows
+
+        _select_rows(self._put(jnp.ones((G,), bool)), st, st)
+        b = 1
+        while b <= G:
+            idx = self._put(jnp.zeros((b,), jnp.int32))
+            sub = _gather_rows(st, idx)
+            _scatter_rows(st, idx, sub)
+            _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
+            b <<= 1
+        one = self._put(jnp.zeros((1,), jnp.int32))
+        _set_remote_snapshot(st, one, one, one)
+        jax.block_until_ready(self._state)
+
+    def _drain_pending_to_host(self, pairs) -> None:
+        """Decode rows' pending routed-inbox regions into wire Messages
+        and enqueue them on the owning nodes (rows transitioning device
+        -> host).  REPLICATE payloads reconstruct from the entry cache;
+        an unreconstructible message is dropped (raft retries it)."""
+        from ..pb import Message, MessageType
+        from .engine import _gather_rows
+        from .types import MT_REPLICATE
+
+        if self._pending is None or not pairs:
+            return
+        idx = self._put(jnp.asarray(_pad_idx([g for _, g in pairs])))
+        sub = jax.tree.map(np.asarray, _gather_rows(self._pending, idx))
+        for k, (node, g) in enumerate(pairs):
+            r = node.peer.raft
+            for s in range(sub.mtype.shape[1]):
+                mt = int(sub.mtype[k, s])
+                if mt == 0:
+                    continue
+                n = int(sub.n_entries[k, s])
+                li = int(sub.log_index[k, s])
+                ents = []
+                ok = True
+                if mt == MT_REPLICATE and n > 0:
+                    for j in range(n):
+                        e = self._cache_lookup(
+                            r, li + 1 + j, int(sub.ent_term[k, s, j])
+                        )
+                        if e is None:
+                            ok = False
+                            break
+                        ents.append(e)
+                if not ok:
+                    continue
+                node.enqueue_received(
+                    Message(
+                        type=MessageType(mt),
+                        to=node.replica_id,
+                        from_=int(sub.from_id[k, s]),
+                        shard_id=node.shard_id,
+                        term=int(sub.term[k, s]),
+                        log_term=int(sub.log_term[k, s]),
+                        log_index=li,
+                        commit=int(sub.commit[k, s]),
+                        reject=bool(sub.reject[k, s]),
+                        hint=int(sub.hint[k, s]),
+                        hint_high=int(sub.hint_high[k, s]),
+                        entries=tuple(ents),
+                    )
+                )
+
+    # -- the colocated step --------------------------------------------
+    def step_shards(self, nodes, worker_id: int) -> None:
+        with self._lock:
+            self._step_colocated(nodes, worker_id)
+
+    def _step_colocated(self, nodes, worker_id: int) -> None:
+        updates: List[Tuple] = []
+        host_rows: List[Tuple] = []
+        batch: List[Tuple] = []
+        for node in nodes:
+            if node.stopped:
+                continue
+            si = node.drain_step_inputs()
+            if self._static_host_only(node):
+                host_rows.append((node, si))
+                continue
+            g = self._attach(node)
+            if g is None:
+                host_rows.append((node, si))
+                continue
+            mirror_leader = (
+                not self._meta[g].dirty
+                and self._mirror[_R_ROLE, g] == int(RaftRole.LEADER)
+            )
+            plan = self._plan_device(node, si, mirror_leader)
+            if plan is None:
+                host_rows.append((node, si))
+                continue
+            if not plan and not self._meta[g].dirty:
+                _tick_bookkeeping(node, si.ticks)
+                continue
+            batch.append((node, g, si, plan))
+
+        to_mat = []
+        drain_pairs = []
+        for node, si in host_rows:
+            g = self._row_of.get(self._row_key(node))
+            if g is not None and not self._meta[g].dirty:
+                to_mat.append(g)
+                drain_pairs.append((node, g))
+                self._meta[g].dirty = True
+        # a row leaving the device may hold routed-but-unconsumed inbox
+        # traffic; re-deliver it through the node's receive queue rather
+        # than letting the consumption mask destroy it — losing a
+        # heartbeat stream here is what turns a brief host excursion
+        # into an election storm
+        self._drain_pending_to_host(drain_pairs)
+        self._materialize_rows(to_mat)
+
+        # host path runs under the core lock in colocated mode: update
+        # construction for OTHER hosts' rows happens inside launches, so
+        # one lock must order both (the per-host parallelism the base
+        # engine preserves is deliberately traded away here)
+        for node, si in host_rows:
+            if node.stopped:
+                continue
+            u = node.step_with_inputs(si)
+            self.stats["host_rows_stepped"] += 1
+            if u is not None:
+                updates.append((node, u))
+
+        if batch or self._pending_live:
+            self._upload_rows(
+                [
+                    (g, node.peer.raft)
+                    for node, g, si, plan in batch
+                    if self._meta[g].dirty
+                ]
+            )
+            updates.extend(self._device_step_colocated(batch))
+
+        if updates:
+            by_db: Dict[int, Tuple] = {}
+            for node, u in updates:
+                by_db.setdefault(id(node.logdb), (node.logdb, []))[1].append(u)
+            for db, us in by_db.values():
+                db.save_raft_state(us, worker_id)
+            for node, u in updates:
+                if node.process_update(u):
+                    node.engine_apply_ready(node.shard_id)
+
+    def _device_step_colocated(self, batch) -> List[Tuple]:
+        from ..pb import Message, MessageType
+
+        G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
+        msg_rows: List[List[Message]] = [[] for _ in range(G)]
+        staging: Dict[int, Dict[int, List[Entry]]] = {}
+        prop_rows: List[int] = []
+        for node, g, si, plan in batch:
+            row_msgs = msg_rows[g]
+            stage: Dict[int, List[Entry]] = {}
+            for slot, (kind, payload) in enumerate(plan):
+                if kind == "msg":
+                    row_msgs.append(payload)
+                    if payload.entries:
+                        stage[slot] = list(payload.entries)
+                elif kind == "prop":
+                    row_msgs.append(
+                        Message(type=MessageType.PROPOSE,
+                                entries=tuple(payload))
+                    )
+                    stage[slot] = list(payload)
+                elif kind == "read":
+                    self.stats["device_reads"] += 1
+                    row_msgs.append(
+                        Message(type=MessageType.READ_INDEX,
+                                hint=payload.low, hint_high=payload.high)
+                    )
+                else:  # tick
+                    pc = node.device_reads.peek_ctx()
+                    row_msgs.append(
+                        Message(type=MessageType.LOCAL_TICK,
+                                hint=pc.low if pc else 0,
+                                hint_high=pc.high if pc else 0)
+                    )
+            if stage:
+                staging[g] = stage
+            # rows with proposal slots need slot_base detail: both local
+            # 'prop' slots and WIRE PROPOSE messages (a follower-forwarded
+            # proposal arriving at the leader carries staged entries too)
+            if any(k == "prop" for k, _ in plan) or any(
+                k == "msg" and int(p.type) == int(MessageType.PROPOSE)
+                for k, p in plan
+            ):
+                prop_rows.append(g)
+        host_inbox, overflow = S.encode_inbox(msg_rows, M, E)
+        assert not overflow, f"planner let oversized rows through: {overflow}"
+        host_inbox = self._put_rows(host_inbox)
+
+        if self._tables_dirty:
+            self._rebuild_tables()
+        alive_np = np.zeros((G,), bool)
+        for g, meta in self._meta.items():
+            alive_np[g] = not meta.dirty
+        alive = self._put_rows(jnp.asarray(alive_np))
+
+        old_state = self._state
+        from ..profiling import annotate
+
+        with annotate("raft-colocated-step"):
+            full = _assemble_inbox(host_inbox, self._pending, alive)
+            new_state, out = K.step(old_state, full, out_capacity=self.O)
+            merged, regions, stats_dev, delivered_dev = _route_step(
+                old_state, new_state, out, self._dest_dev, self._rank_dev,
+                alive, PB=P * B, E=E, budget=B,
+            )
+            summary = np.asarray(_summarize(new_state, out))
+        rstats = np.asarray(stats_dev)
+        delivered = np.asarray(delivered_dev)
+        self._pending = regions
+        self._state = merged
+        self._pending_live = int(rstats[0]) > 0
+        self.stats["launches"] += 1
+        self.stats["device_steps"] += 1
+        self.stats["device_rows_stepped"] += len(batch)
+        self.stats["routed_delivered"] += int(rstats[0])
+        self.stats["routed_host_carried"] += int(rstats[5])
+        self.stats["routed_dropped"] += int(rstats[1] + rstats[2] + rstats[3])
+
+        # ---- escalations ---------------------------------------------
+        batch_gs = {g for _, g, _, _ in batch}
+        esc_batch = [
+            (node, g, si)
+            for node, g, si, plan in batch
+            if summary[_R_ESC, g] != 0
+        ]
+        # resident rows stepped only by routed traffic can escalate too:
+        # discard their effects (the routed inputs are raft-safe to lose)
+        esc_other = [
+            g
+            for g, meta in self._meta.items()
+            if alive_np[g] and g not in batch_gs and summary[_R_ESC, g] != 0
+        ]
+        updates: List[Tuple] = []
+        if esc_batch or esc_other:
+            self.stats["escalations"] += len(esc_batch) + len(esc_other)
+            gs = [g for _, g, _ in esc_batch] + esc_other
+            # merged state already restored these rows (suppress mask in
+            # _route_step); materialize their pre-step state and replay
+            self._materialize_rows(gs, old_state)
+            for g in gs:
+                meta = self._meta.get(g)
+                if meta is not None:
+                    meta.dirty = True
+            for node, g, si in esc_batch:
+                if self._meta.get(g) is None or node.stopped:
+                    continue
+                u = node.step_with_inputs(si)
+                if u is not None:
+                    updates.append((node, u))
+        esc_set = set(g for _, g, _ in esc_batch) | set(esc_other)
+
+        # ---- live rows: batch rows + any resident row with effects ----
+        live: List[Tuple] = [
+            (node, g, si)
+            for node, g, si, plan in batch
+            if g not in esc_set
+        ]
+        for g, meta in self._meta.items():
+            if not alive_np[g] or g in batch_gs or g in esc_set:
+                continue
+            s_changed = (summary[:6, g] != self._mirror[:6, g]).any()
+            if (
+                s_changed
+                or summary[_R_COUNT, g] > 0
+                or summary[_R_APPEND_LO, g] != APPEND_LO_NONE
+                or summary[_R_NEED_SS, g]
+            ):
+                live.append((meta.node, g, None))
+
+        buf_rows = [g for _, g, _ in live if summary[_R_COUNT, g] > 0]
+        append_rows = [
+            g for _, g, _ in live if summary[_R_APPEND_LO, g] != APPEND_LO_NONE
+        ]
+        slot_rows = [g for g in prop_rows if g not in esc_set]
+        need_rows = [g for _, g, _ in live if summary[_R_NEED_SS, g]]
+        if buf_rows or append_rows or slot_rows or need_rows:
+            b = _bucket(
+                max(len(buf_rows), len(append_rows), len(slot_rows),
+                    len(need_rows))
+            )
+            idx4 = np.zeros((4, b), np.int32)
+            for row_i, rows in enumerate(
+                (buf_rows, slot_rows, need_rows, append_rows)
+            ):
+                if rows:
+                    idx4[row_i, : len(rows)] = rows
+                    idx4[row_i, len(rows):] = rows[-1]
+            flat = np.asarray(
+                _gather_detail(new_state, out, self._put(jnp.asarray(idx4)))
+            )
+            # the kernel ran on the ASSEMBLED inbox (host slots + routed
+            # regions), so the out slot arrays are M + P*B wide
+            (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
+             ring_c) = _split_detail(flat, self.O, M + P * B, E, P, self.W)
+        else:
+            buf_np = slot_base = slot_term = ent_drop = need_np = None
+            ring_t = ring_c = None
+        buf_at = {g: k for k, g in enumerate(buf_rows)}
+        ring_at = {g: k for k, g in enumerate(append_rows)}
+        slot_at = {g: k for k, g in enumerate(slot_rows)}
+        need_at = {g: k for k, g in enumerate(need_rows)}
+
+        from .engine import SLOT_DROPPED
+
+        snapshot_sends: List[Tuple[int, int, int]] = []
+        for node, g, si in live:
+            if node.stopped or self._meta.get(g) is None:
+                continue
+            r = node.peer.raft
+            term, vote, committed, leader, role, last = (
+                int(summary[i, g]) for i in range(6)
+            )
+            changed = (
+                summary[:6, g] != self._mirror[:6, g]
+            ).any() or summary[_R_COUNT, g] > 0
+            appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
+            if si is not None:
+                _tick_bookkeeping(node, si.ticks)
+            if not (
+                changed or appended or summary[_R_NEED_SS, g] or g in slot_at
+            ):
+                continue
+            # scalar sync BEFORE the merge: the noop-barrier-vs-lost-
+            # payload distinction in _merge_appends needs the POST-step
+            # role (a row that just won its election self-appends the
+            # barrier; its host mirror still says candidate)
+            r.term, r.vote, r.leader_id = term, vote, leader
+            r.role = RaftRole(role)
+            if appended:
+                try:
+                    stamped = self._merge_appends(
+                        r, g, int(summary[_R_APPEND_LO, g]), last,
+                        staging.get(g, {}), slot_at, slot_base, slot_term,
+                        ent_drop, ring_t[ring_at[g]], ring_c[ring_at[g]],
+                        fallback=self._cache_lookup,
+                        barrier=(
+                            int(summary[_R_BARRIER_IDX, g]),
+                            int(summary[_R_BARRIER_TERM, g]),
+                        ),
+                    )
+                except RuntimeError:
+                    # fail-stop THIS replica only (divergence policy);
+                    # aborting the loop would strand every other row's
+                    # merge and spread the inconsistency
+                    od = self._entry_cache.get(r.shard_id)
+                    _log.critical(
+                        "[%d:%d] routed append reconstruction failed; "
+                        "halting replica (cache keys tail: %s)",
+                        r.shard_id, r.replica_id,
+                        list(od.keys())[-12:] if od else [],
+                        exc_info=True,
+                    )
+                    self._halt_replica(g)
+                    continue
+                self._cache_put(r.shard_id, stamped)
+            if committed > r.log.committed:
+                r.log.commit_to(committed)
+            if (
+                role != int(RaftRole.LEADER)
+                and node.device_reads.has_pending()
+            ):
+                node.drop_device_reads()
+            if g in buf_at:
+                self._attach_messages(
+                    r, node, buf_np[buf_at[g]], int(summary[_R_COUNT, g]),
+                    staging.get(g, {}), delivered_row=delivered[g],
+                )
+            if g in slot_at:
+                sb = slot_base[slot_at[g]]
+                drop = ent_drop[slot_at[g]]
+                for slot, ents in staging.get(g, {}).items():
+                    if sb[slot] == SLOT_DROPPED:
+                        r.dropped_entries.extend(ents)
+                    elif sb[slot] >= 0:
+                        r.dropped_entries.extend(
+                            e for j, e in enumerate(ents) if drop[slot, j]
+                        )
+            if g in need_at:
+                self._send_snapshots(r, g, need_np[need_at[g]],
+                                     snapshot_sends)
+            u = node.peer.get_update(last_applied=node.sm.last_applied)
+            node.dispatch_dropped(u)
+            updates.append((node, u))
+            self._mirror[:6, g] = summary[:6, g]
+            node._check_leader_change()
+
+        if snapshot_sends:
+            self._state = _set_remote_snapshot(
+                self._state,
+                self._put(jnp.asarray(_pad_idx([g for g, _, _ in snapshot_sends]))),
+                self._put(jnp.asarray(_pad_idx([p for _, p, _ in snapshot_sends]))),
+                self._put(jnp.asarray(_pad_idx([i for _, _, i in snapshot_sends]))),
+            )
+
+        if self._pending_live:
+            # in-flight routed traffic: wake every resident node's engine
+            # so some worker launches again and the messages are consumed
+            for meta in self._meta.values():
+                if not meta.dirty and meta.node.notify_work is not None:
+                    meta.node.notify_work()
+        return updates
+
+
+class _ColocatedFacade(IStepEngine):
+    """Per-NodeHost view of the shared core (the IStepEngine each
+    ExecEngine drives).  Tracks shard -> replica so ``detach(shard_id)``
+    — the IStepEngine contract — releases only THIS host's replica."""
+
+    def __init__(self, core: ColocatedVectorEngine):
+        self.core = core
+        self._replica_of: Dict[int, int] = {}
+
+    @property
+    def stats(self):
+        return self.core.stats
+
+    def step_shards(self, nodes, worker_id: int) -> None:
+        for n in nodes:
+            self._replica_of[n.shard_id] = n.replica_id
+        self.core.step_shards(nodes, worker_id)
+
+    def detach(self, shard_id: int) -> None:
+        rid = self._replica_of.pop(shard_id, None)
+        if rid is not None:
+            self.core.detach_replica(shard_id, rid)
+
+
+class ColocatedEngineGroup:
+    """Product plug point: one group per colocated cluster.
+
+        group = ColocatedEngineGroup(capacity=64, P=5, budget=2)
+        cfg.expert.step_engine_factory = group.factory   # every member
+    """
+
+    def __init__(self, **kw):
+        self._kw = kw
+        self._core: Optional[ColocatedVectorEngine] = None
+        self._lock = threading.Lock()
+
+    @property
+    def core(self) -> Optional[ColocatedVectorEngine]:
+        return self._core
+
+    def factory(self, nodehost) -> _ColocatedFacade:
+        with self._lock:
+            if self._core is None:
+                self._core = ColocatedVectorEngine(**self._kw)
+            return _ColocatedFacade(self._core)

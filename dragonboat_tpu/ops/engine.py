@@ -76,6 +76,7 @@ _HOT_SET = frozenset(HOT_TYPES)
 # readback row indices of the _summarize stack
 _R_TERM, _R_VOTE, _R_COMMIT, _R_LEADER, _R_ROLE, _R_LAST = range(6)
 _R_COUNT, _R_ESC, _R_APPEND_LO, _R_NEED_SS = 6, 7, 8, 9
+_R_BARRIER_IDX, _R_BARRIER_TERM = 10, 11
 
 
 def _bucket(n: int) -> int:
@@ -127,6 +128,8 @@ def _summarize(state: DeviceState, out) -> jnp.ndarray:
             out.escalate,
             out.append_lo,
             jnp.any(out.need_snapshot == 1, axis=1).astype(I32),
+            out.barrier_idx,
+            out.barrier_term,
         ]
     )
 
@@ -210,6 +213,7 @@ class VectorStepEngine(IStepEngine):
         E: int = 4,
         O: int = 32,
         device=None,
+        mesh=None,
     ):
         if capacity & (capacity - 1):
             raise ValueError("capacity must be a power of two")
@@ -222,11 +226,34 @@ class VectorStepEngine(IStepEngine):
             E,
             O,
         )
-        self._device = device if device is not None else jax.devices()[0]
+        if mesh is not None:
+            # SPMD mode: every row-axis tensor is sharded over the mesh
+            # on the groups axis (SURVEY §2: the only parallel axis).
+            # The kernel is row-local so the step compiles with zero
+            # collectives; upload/readback gathers and (in the colocated
+            # subclass) cross-shard routing legitimately induce XLA
+            # collective permutes — correctness first, the bench path
+            # stays single-device.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if capacity % mesh.size:
+                raise ValueError(
+                    f"capacity {capacity} must divide over {mesh.size} devices"
+                )
+            if len(mesh.axis_names) != 1:
+                raise ValueError("engine mesh must be one-dimensional")
+            self._mesh = mesh
+            self._row_sharding = NamedSharding(
+                mesh, PartitionSpec(mesh.axis_names[0])
+            )
+            self._rep_sharding = NamedSharding(mesh, PartitionSpec())
+            self._device = None
+        else:
+            self._mesh = None
+            self._device = device if device is not None else jax.devices()[0]
         # inert rows: no peers, empty inbox -> the kernel never touches them
-        self._state = jax.device_put(
-            make_state(capacity, P, W, replica_ids=np.zeros(capacity)),
-            self._device,
+        self._state = self._put_rows(
+            make_state(capacity, P, W, replica_ids=np.zeros(capacity))
         )
         self._row_of: Dict[int, int] = {}  # shard_id -> g
         self._meta: Dict[int, _RowMeta] = {}  # g -> meta
@@ -246,13 +273,43 @@ class VectorStepEngine(IStepEngine):
         self._warm()
 
     def _put(self, x):
-        """Commit an array/pytree to the engine device.
+        """Commit a SMALL array/pytree (indexes, gathered sub-states) to
+        the engine device — replicated in mesh mode.
 
         EVERY array entering a jitted helper goes through this: jax keys
         executables on argument committed-ness/sharding, so mixing
         committed and uncommitted calls silently doubles every compile
         (~60s each for the step kernel)."""
+        if self._mesh is not None:
+            return jax.device_put(x, self._rep_sharding)
         return jax.device_put(x, self._device)
+
+    def _put_rows(self, x):
+        """Commit a full-capacity row pytree (state, inboxes, [G] masks)
+        — sharded over the groups axis in mesh mode."""
+        if self._mesh is not None:
+            return jax.device_put(x, self._row_sharding)
+        return jax.device_put(x, self._device)
+
+    @staticmethod
+    def _cq_grace(r) -> None:
+        """CheckQuorum grace across a device<->host residency boundary:
+        the peer-activity window is sheared by the transition (the other
+        side may have just cleared the flags), and an immediate quorum
+        check against an empty window steps a healthy leader down.
+
+        Rate-limited to once per election window (tracked on the raft's
+        logical clock): without the limit, a leader oscillating between
+        residencies faster than the window would never accumulate a full
+        inactivity window and a minority-partitioned leader could evade
+        stepdown indefinitely."""
+        now = r.tick_count
+        last = getattr(r, "_cq_grace_at", None)
+        if last is not None and now - last < r.election_timeout:
+            return
+        r._cq_grace_at = now
+        for rm in r.remotes.values():
+            rm.active = True
 
     def _warm(self) -> None:
         """Pre-compile the kernel and every per-bucket helper shape so the
@@ -262,10 +319,10 @@ class VectorStepEngine(IStepEngine):
         from .types import make_inbox
 
         st = self._state
-        inbox = self._put(make_inbox(self.capacity, self.M, self.E))
+        inbox = self._put_rows(make_inbox(self.capacity, self.M, self.E))
         _, out = K.step(st, inbox, out_capacity=self.O)
         _summarize(st, out)
-        _select_rows(self._put(jnp.ones((self.capacity,), bool)), st, st)
+        _select_rows(self._put_rows(jnp.ones((self.capacity,), bool)), st, st)
         b = 1
         while b <= self.capacity:
             idx = self._put(jnp.zeros((b,), jnp.int32))
@@ -280,6 +337,12 @@ class VectorStepEngine(IStepEngine):
     # ------------------------------------------------------------------
     # row lifecycle
     # ------------------------------------------------------------------
+    def _row_key(self, node):
+        """Row-table key.  One NodeHost hosts one replica per shard, so
+        the base engine keys by shard id; the colocated engine (multiple
+        NodeHosts sharing one device) overrides with (shard, replica)."""
+        return node.shard_id
+
     def detach(self, shard_id: int) -> None:
         with self._lock:
             g = self._row_of.pop(shard_id, None)
@@ -297,7 +360,7 @@ class VectorStepEngine(IStepEngine):
         apply workers never call back into the step engine."""
         node = self._meta[g].node
         self.stats["divergence_halts"] += 1
-        self._row_of.pop(node.shard_id, None)
+        self._row_of.pop(self._row_key(node), None)
         self._meta.pop(g, None)
         self._free.append(g)
         node.stop()
@@ -316,7 +379,7 @@ class VectorStepEngine(IStepEngine):
         return False
 
     def _attach(self, node) -> Optional[int]:
-        g = self._row_of.get(node.shard_id)
+        g = self._row_of.get(self._row_key(node))
         if g is not None:
             return g
         if not self._free:
@@ -329,7 +392,7 @@ class VectorStepEngine(IStepEngine):
                 )
             return None
         g = self._free.pop()
-        self._row_of[node.shard_id] = g
+        self._row_of[self._row_key(node)] = g
         self._meta[g] = _RowMeta(node)
         return g
 
@@ -410,8 +473,18 @@ class VectorStepEngine(IStepEngine):
             slots.append(("read", ctx))
         # conservative capacity check BEFORE consuming quiesce state so a
         # host fallback never double-processes ticks/activity
-        if len(slots) + si.ticks > self.M:
+        if len(slots) > self.M:
             return None
+        # tick backpressure: ticks that don't fit this step's inbox are
+        # DEFERRED (the logical clock briefly lags wall clock) instead of
+        # bouncing the whole row to the scalar path — under load a slow
+        # launch accumulates more ticks than M slots, and falling back
+        # would thrash device residency every step (reference: dragonboat
+        # coalesces LocalTick bursts rather than dropping ready state [U])
+        avail = self.M - len(slots)
+        if si.ticks > avail:
+            node.defer_ticks(si.ticks - avail)
+            si.ticks = avail
         ticks = si.ticks
         if node.quiesce.enabled:
             # committed to the device path now: record (non-exiting)
@@ -440,6 +513,9 @@ class VectorStepEngine(IStepEngine):
         """Scalar -> device for dirty rows (batched scatter)."""
         if not rows:
             return
+        for _, r in rows:
+            if r.role == RaftRole.LEADER and r.check_quorum:
+                self._cq_grace(r)
         sub = S.state_from_rafts([r for _, r in rows], self.P, self.W)
         pad = _bucket(len(rows))
         if pad > len(rows):
@@ -510,6 +586,8 @@ class VectorStepEngine(IStepEngine):
                 if granted:
                     votes[pid] = granted == 1
             r.votes = votes
+            if r.role == RaftRole.LEADER and r.check_quorum:
+                self._cq_grace(r)  # sheared window — see _cq_grace
             dev_last = int(sub.last_index[k])
             host_last = r.log.last_index()
             if dev_last != host_last:
@@ -577,7 +655,7 @@ class VectorStepEngine(IStepEngine):
             # cold rows leave the device before their scalar step
             to_mat = []
             for node, si in host_rows:
-                g = self._row_of.get(node.shard_id)
+                g = self._row_of.get(self._row_key(node))
                 if g is not None and not self._meta[g].dirty:
                     to_mat.append(g)
                     self._meta[g].dirty = True
@@ -601,7 +679,7 @@ class VectorStepEngine(IStepEngine):
                 batch = [
                     (node, g, si, plan)
                     for node, g, si, plan in batch
-                    if self._row_of.get(node.shard_id) == g
+                    if self._row_of.get(self._row_key(node)) == g
                     and self._meta.get(g) is not None
                     and self._meta[g].node is node
                     and not node.stopped
@@ -672,7 +750,7 @@ class VectorStepEngine(IStepEngine):
                 prop_rows.append(g)
         inbox, overflow = S.encode_inbox(msg_rows, M, E)
         assert not overflow, f"planner let oversized rows through: {overflow}"
-        inbox = jax.device_put(inbox, self._device)
+        inbox = self._put_rows(inbox)
 
         old_state = self._state
         from ..profiling import annotate
@@ -696,7 +774,7 @@ class VectorStepEngine(IStepEngine):
             for _, g, _ in esc_rows:
                 keep_new[g] = False
             new_state = _select_rows(
-                self._put(jnp.asarray(keep_new)), old_state, new_state
+                self._put_rows(jnp.asarray(keep_new)), old_state, new_state
             )
             self._materialize_rows([g for _, g, _ in esc_rows], old_state)
             for node, g, si in esc_rows:
@@ -851,7 +929,9 @@ class VectorStepEngine(IStepEngine):
         ent_drop,
         ring_term_row,
         ring_cc_row,
-    ) -> None:
+        fallback=None,
+        barrier: Optional[Tuple[int, int]] = None,
+    ) -> List[Entry]:
         W = self.W
         # candidates[idx] = (slot_order, Entry, term); later slots win
         cand: Dict[int, List[Tuple[int, Entry, int]]] = {}
@@ -881,12 +961,35 @@ class VectorStepEngine(IStepEngine):
             for c in cand.get(idx, ()):
                 if c[2] == rt and (pick is None or c[0] >= pick[0]):
                     pick = c
+            if pick is None and fallback is not None:
+                # device-routed append: the payload never crossed this
+                # host's wire — reconstruct from the colocated cache
+                fe = fallback(r, idx, rt)
+                if fe is not None:
+                    pick = (-1, fe, rt)
             if pick is None:
                 # become-leader noop barrier (the only unstaged append)
                 if int(ring_cc_row[idx & (W - 1)]) != 0:
                     raise RuntimeError(
                         f"[{r.shard_id}:{r.replica_id}] unstaged config "
                         f"change at index {idx}"
+                    )
+                if fallback is not None and (
+                    barrier is None
+                    or idx != barrier[0]
+                    or rt != barrier[1]
+                ):
+                    # routed-append mode: the ONLY legitimately unstaged
+                    # append is the barrier this row self-appended this
+                    # step (kernel-reported, valid even if the row then
+                    # stepped down in the same step).  Anything else came
+                    # over the device route and its payload is gone —
+                    # stamping an empty noop would silently diverge the
+                    # SM, so fail-stop (same policy as the last_index
+                    # divergence halt).
+                    raise RuntimeError(
+                        f"[{r.shard_id}:{r.replica_id}] unreconstructible "
+                        f"routed append at index {idx} (term {rt})"
                     )
                 stamped.append(
                     Entry(term=rt, index=idx, type=EntryType.APPLICATION)
@@ -906,6 +1009,7 @@ class VectorStepEngine(IStepEngine):
                     )
                 )
         r.log.inmem.merge(stamped)
+        return stamped
 
     # -- outbox decode + payload attachment ----------------------------
     def _attach_messages(
@@ -915,11 +1019,14 @@ class VectorStepEngine(IStepEngine):
         buf_row: np.ndarray,
         count: int,
         stage: Dict[int, List[Entry]],
+        delivered_row: Optional[np.ndarray] = None,
     ) -> None:
         shim = {"count": np.array([count]), "buf": buf_row[None]}
-        for msg, n_ent, src_slot in S.decode_out_row(
-            shim, 0, r.shard_id, r.replica_id
+        for k, (msg, n_ent, src_slot) in enumerate(
+            S.decode_out_row(shim, 0, r.shard_id, r.replica_id)
         ):
+            if delivered_row is not None and delivered_row[k]:
+                continue  # already scattered into a peer row on device
             if (
                 msg.type == MessageType.READ_INDEX_RESP
                 and msg.to == r.replica_id

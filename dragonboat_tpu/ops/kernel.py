@@ -530,6 +530,14 @@ def _become_leader(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
     )
     # commit barrier: empty entry at the new term
     st, out = _append_one(st, out, mask, jnp.zeros((st.G,), I32))
+    # record the barrier so the host can stamp it empty during append
+    # reconstruction even if this row steps down LATER IN THE SAME STEP
+    # (a higher-term message after the win) — the barrier is the only
+    # append that never has a staged or wire payload
+    out = out._replace(
+        barrier_idx=jnp.where(mask, st.last_index, out.barrier_idx),
+        barrier_term=jnp.where(mask, st.term, out.barrier_term),
+    )
     single = _num_voters(st) == 1
     st, out, _ = _try_commit(st, out, mask & single & _self_is_voter(st))
     return st, out

@@ -76,6 +76,8 @@ class RouteStats(NamedTuple):
     dropped_budget: jnp.ndarray       # per-sender region full
     dropped_ring: jnp.ndarray         # REPLICATE entries aged out of ring
     suppressed: jnp.ndarray           # messages of escalated source rows
+    host_carried: jnp.ndarray         # deliberately left to the host path
+    #                                   (forwarded PROPOSE, dest row dirty)
 
     def __add__(self, other: "RouteStats") -> "RouteStats":
         return RouteStats(*(a + b for a, b in zip(self, other)))
@@ -138,7 +140,8 @@ def route(
     base: int,
     base_inbox: Optional[Inbox] = None,
     suppress: Optional[jnp.ndarray] = None,
-) -> Tuple[Inbox, RouteStats]:
+    dest_alive: Optional[jnp.ndarray] = None,
+) -> Tuple[Inbox, RouteStats, jnp.ndarray]:
     """Scatter ``out``'s messages into a fresh (or prefilled) Inbox.
 
     ``state`` must be the POST-step state of the sending rows: REPLICATE
@@ -146,6 +149,17 @@ def route(
     holds the entries appended in the step that emitted the message.
     ``suppress`` masks source rows whose device effects were discarded
     (escalations): their messages must not be delivered.
+    ``dest_alive`` ([G] bool) masks DESTINATION rows that must not be fed
+    (engine rows on the host/scalar path): messages to them are left
+    undelivered so the host transport can carry them instead.
+
+    Returns ``(inbox, stats, delivered)`` where ``delivered`` is a
+    [G, O] bool — True where outbox message o of row g was scattered
+    into a peer row (the engine skips host decode for those).  Two
+    message classes are never device-delivered even when the peer is
+    resident: forwarded PROPOSE (its cmd payload exists only on the
+    sending host) and anything addressed to the sender itself (the
+    kernel's host-coordination READ_INDEX_RESP).
     """
     G, O, _ = out.buf.shape
     P = state.P
@@ -188,11 +202,6 @@ def route(
     routable = valid & found
     on_device = routable & (dest >= 0)
 
-    # per-sender emission index toward each peer slot (exclusive count)
-    oh = (hits & valid[:, :, None]).astype(I32)  # [G, O, P]
-    k_excl = jnp.cumsum(oh, axis=1) - oh
-    k = jnp.take_along_axis(k_excl, p_star[:, :, None], axis=2)[:, :, 0]
-
     # deliverability per MESSAGE (sender side; used for selection + stats)
     is_repl = mtype == MT_REPLICATE
     carries = is_repl & (n_ent > 0)
@@ -202,9 +211,29 @@ def route(
         & (log_index + n_ent <= state.last_index[:, None])
     )
 
+    # host-only classes: forwarded PROPOSE (cmd bytes never reach the
+    # device) and self-addressed coordination messages; plus messages
+    # whose destination row is currently host-authoritative (dirty)
+    not_propose = mtype != MT_PROPOSE
+    not_self = dest != jnp.arange(G)[:, None]
+    if dest_alive is not None:
+        dst_ok = dest_alive[jnp.clip(dest, 0, G - 1)] & (dest >= 0)
+    else:
+        dst_ok = dest >= 0
+    msg_ok = not_propose & not_self & dst_ok
+
+    # per-sender emission index toward each peer slot, counted over
+    # DELIVERABLE messages only — host-carried/ring-stale messages must
+    # not consume budget ranks they will never occupy (their slot would
+    # sit empty while a later deliverable message got pushed past B)
+    deliverable = valid & ring_ok & msg_ok  # [G, O]
+    oh = (hits & deliverable[:, :, None]).astype(I32)  # [G, O, P]
+    k_excl = jnp.cumsum(oh, axis=1) - oh
+    k = jnp.take_along_axis(k_excl, p_star[:, :, None], axis=2)[:, :, 0]
+
     # o_sel[g, p, b] = outbox index of g's b-th deliverable message to
     # peer slot p (selection is pure argmax over one-hot masks, no scatter)
-    sendable = hits & (valid & ring_ok)[:, :, None]  # [G, O, P]
+    sendable = hits & deliverable[:, :, None]  # [G, O, P]
     o_cols = []
     f_cols = []
     for b in range(B):
@@ -286,16 +315,18 @@ def route(
         ),
     )
     in_budget = k < B
+    delivered = valid & found & ring_ok & msg_ok & in_budget  # [G, O]
     stats = RouteStats(
         delivered=jnp.sum(sel_found, dtype=I32),
         dropped_off_device=jnp.sum(routable & (dest < 0), dtype=I32),
         dropped_budget=jnp.sum(
-            on_device & ring_ok & ~in_budget, dtype=I32
+            on_device & msg_ok & ring_ok & ~in_budget, dtype=I32
         ),
-        dropped_ring=jnp.sum(on_device & ~ring_ok, dtype=I32),
+        dropped_ring=jnp.sum(on_device & msg_ok & ~ring_ok, dtype=I32),
         suppressed=n_suppressed,
+        host_carried=jnp.sum(on_device & ~msg_ok, dtype=I32),
     )
-    return inbox, stats
+    return inbox, stats, delivered
 
 
 def make_prefill(
@@ -370,7 +401,7 @@ def merge_and_route(
         state, M, E,
         propose_leaders=propose_leaders, propose_n=propose_n,
     )
-    inbox, stats = route(
+    inbox, stats, _delivered = route(
         state, out, dest_row, rank_in_dest,
         M=M, E=E, budget=budget, base=base,
         base_inbox=prefill, suppress=esc,

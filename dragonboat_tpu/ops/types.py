@@ -229,6 +229,13 @@ class DeviceOut(NamedTuple):
                                 # (APPEND_LO_NONE if nothing appended); with
                                 # state'.last_index this bounds the host's
                                 # entries_to_save reconstruction
+    barrier_idx: jnp.ndarray    # [G] index of the become-leader noop barrier
+                                # self-appended THIS step (-1 if none): the
+                                # only append with no staged/wire payload, so
+                                # hosts reconstructing routed appends can
+                                # stamp it empty even if the row stepped down
+                                # later in the same step
+    barrier_term: jnp.ndarray   # [G] term that barrier was appended at
 
     @property
     def O(self) -> int:
@@ -356,4 +363,6 @@ def make_out(G: int, P: int, M: int, E: int, O: int) -> DeviceOut:
         slot_term=jnp.zeros((G, M), I32),
         ent_drop=jnp.zeros((G, M, E), I32),
         append_lo=jnp.full((G,), APPEND_LO_NONE, I32),
+        barrier_idx=jnp.full((G,), -1, I32),
+        barrier_term=jnp.zeros((G,), I32),
     )
