@@ -161,6 +161,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             self._row_of.pop(key)
             self._meta.pop(g, None)
             self._free.append(g)
+            self._release_row(g, node.shard_id)
             g = None
         is_new = key not in self._row_of
         g = super()._attach(node)
@@ -171,16 +172,33 @@ class ColocatedVectorEngine(VectorStepEngine):
             self._tables_dirty = True
         return g
 
+    def _release_row(self, g: int, shard_id: int) -> None:
+        """Clear the route-table claim of a freed row (caller holds the
+        lock and has already popped _row_of/_meta).  Also drops the
+        shard's entry cache when its last resident replica is gone —
+        without this a process cycling many shards leaks one payload
+        cache per shard id ever hosted."""
+        self._host_shard[g] = 0
+        self._host_replica[g] = 0
+        self._host_peers[g, :] = 0
+        self._tables_dirty = True
+        if not any(
+            s == shard_id for s, _ in self._row_of
+        ):
+            self._entry_cache.pop(shard_id, None)
+
+    def _halt_replica(self, g: int) -> None:
+        node = self._meta[g].node
+        super()._halt_replica(g)
+        self._release_row(g, node.shard_id)
+
     def detach_replica(self, shard_id: int, replica_id: int) -> None:
         with self._lock:
             g = self._row_of.pop((shard_id, replica_id), None)
             if g is not None:
                 self._meta.pop(g, None)
                 self._free.append(g)
-                self._host_shard[g] = 0
-                self._host_replica[g] = 0
-                self._host_peers[g, :] = 0
-                self._tables_dirty = True
+                self._release_row(g, shard_id)
 
     def _upload_rows(self, rows) -> None:
         super()._upload_rows(rows)
@@ -389,49 +407,8 @@ class ColocatedVectorEngine(VectorStepEngine):
                     node.engine_apply_ready(node.shard_id)
 
     def _device_step_colocated(self, batch) -> List[Tuple]:
-        from ..pb import Message, MessageType
-
         G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
-        msg_rows: List[List[Message]] = [[] for _ in range(G)]
-        staging: Dict[int, Dict[int, List[Entry]]] = {}
-        prop_rows: List[int] = []
-        for node, g, si, plan in batch:
-            row_msgs = msg_rows[g]
-            stage: Dict[int, List[Entry]] = {}
-            for slot, (kind, payload) in enumerate(plan):
-                if kind == "msg":
-                    row_msgs.append(payload)
-                    if payload.entries:
-                        stage[slot] = list(payload.entries)
-                elif kind == "prop":
-                    row_msgs.append(
-                        Message(type=MessageType.PROPOSE,
-                                entries=tuple(payload))
-                    )
-                    stage[slot] = list(payload)
-                elif kind == "read":
-                    self.stats["device_reads"] += 1
-                    row_msgs.append(
-                        Message(type=MessageType.READ_INDEX,
-                                hint=payload.low, hint_high=payload.high)
-                    )
-                else:  # tick
-                    pc = node.device_reads.peek_ctx()
-                    row_msgs.append(
-                        Message(type=MessageType.LOCAL_TICK,
-                                hint=pc.low if pc else 0,
-                                hint_high=pc.high if pc else 0)
-                    )
-            if stage:
-                staging[g] = stage
-            # rows with proposal slots need slot_base detail: both local
-            # 'prop' slots and WIRE PROPOSE messages (a follower-forwarded
-            # proposal arriving at the leader carries staged entries too)
-            if any(k == "prop" for k, _ in plan) or any(
-                k == "msg" and int(p.type) == int(MessageType.PROPOSE)
-                for k, p in plan
-            ):
-                prop_rows.append(g)
+        msg_rows, staging, prop_rows = self._encode_batch(batch)
         host_inbox, overflow = S.encode_inbox(msg_rows, M, E)
         assert not overflow, f"planner let oversized rows through: {overflow}"
         host_inbox = self._put_rows(host_inbox)

@@ -700,15 +700,21 @@ class VectorStepEngine(IStepEngine):
                 if node.process_update(u):
                     node.engine_apply_ready(node.shard_id)
 
-    def _device_step(self, batch) -> List[Tuple]:
-        G, M, E = self.capacity, self.M, self.E
-        # encode inboxes + staging (slot -> payload entries)
-        msg_rows: List[List[Message]] = [[] for _ in range(G)]
+    def _encode_batch(self, batch):
+        """Plans -> (per-row Message lists, staging, proposal rows).
+
+        Shared by the base and colocated device steps: slot order mirrors
+        the scalar replay order; staged payload entries are keyed by slot
+        for the post-step append reconstruction; ``prop_rows`` marks rows
+        whose slot_base detail must be gathered (local 'prop' slots AND
+        wire PROPOSE messages — a forwarded proposal arriving at the
+        leader carries staged entries too)."""
+        msg_rows: List[List[Message]] = [[] for _ in range(self.capacity)]
         staging: Dict[int, Dict[int, List[Entry]]] = {}
         prop_rows: List[int] = []
         for node, g, si, plan in batch:
             row_msgs = msg_rows[g]
-            stage = {}
+            stage: Dict[int, List[Entry]] = {}
             for slot, (kind, payload) in enumerate(plan):
                 if kind == "msg":
                     row_msgs.append(payload)
@@ -748,6 +754,11 @@ class VectorStepEngine(IStepEngine):
                 for k, p in plan
             ):
                 prop_rows.append(g)
+        return msg_rows, staging, prop_rows
+
+    def _device_step(self, batch) -> List[Tuple]:
+        G, M, E = self.capacity, self.M, self.E
+        msg_rows, staging, prop_rows = self._encode_batch(batch)
         inbox, overflow = S.encode_inbox(msg_rows, M, E)
         assert not overflow, f"planner let oversized rows through: {overflow}"
         inbox = self._put_rows(inbox)

@@ -1,0 +1,500 @@
+"""Protocol long tail: the remote flow-state matrix, in-memory/log edge
+families, and their kernel-parity counterparts.
+
+reference: internal/raft/remote_test.go, inmemory_test.go,
+logentry_test.go [U] — the state-transition and window-arithmetic test
+families those files cover, re-expressed for this implementation.  The
+parity section drives the same flow-state scenarios through the
+differential harness so the device kernel's remote lanes (rstate /
+match / next) stay bit-equal to the scalar's.
+"""
+import pytest
+
+from dragonboat_tpu.pb import (
+    Entry,
+    EntryType,
+    Message,
+    MessageType,
+    Snapshot,
+)
+from dragonboat_tpu.raft.log import (
+    EntryLog,
+    InMemLogReader,
+    InMemory,
+    LogCompactedError,
+    LogUnavailableError,
+)
+from dragonboat_tpu.raft.raft import RaftRole
+from dragonboat_tpu.raft.remote import Remote, RemoteState
+
+from raft_harness import Network, new_raft
+
+
+def ent(index, term=1, cmd=b"x"):
+    return Entry(type=EntryType.APPLICATION, index=index, term=term, cmd=cmd)
+
+
+# ---------------------------------------------------------------------------
+# 1. Remote flow-state matrix (reference: remote_test.go [U])
+# ---------------------------------------------------------------------------
+class TestRemoteMatrix:
+    def test_initial_state_is_retry(self):
+        rm = Remote()
+        assert rm.state == RemoteState.RETRY
+        assert (rm.match, rm.next) == (0, 1)
+
+    def test_probe_sends_once_then_waits(self):
+        rm = Remote(match=3, next=4)
+        rm.progress(7)  # one probe batch in flight
+        assert rm.state == RemoteState.WAIT
+        assert rm.next == 4  # probing does NOT advance next optimistically
+
+    def test_replicate_advances_next_optimistically(self):
+        rm = Remote(match=3, next=4, state=RemoteState.REPLICATE)
+        rm.progress(9)
+        assert rm.next == 10
+        assert rm.state == RemoteState.REPLICATE
+
+    def test_progress_raises_while_paused(self):
+        rm = Remote(state=RemoteState.WAIT)
+        with pytest.raises(RuntimeError):
+            rm.progress(5)
+        rm = Remote(state=RemoteState.SNAPSHOT)
+        with pytest.raises(RuntimeError):
+            rm.progress(5)
+
+    def test_respond_unpauses_probe(self):
+        rm = Remote(state=RemoteState.WAIT)
+        rm.respond_to()
+        assert rm.state == RemoteState.RETRY
+        # respond_to is a no-op in other states
+        rm.state = RemoteState.REPLICATE
+        rm.respond_to()
+        assert rm.state == RemoteState.REPLICATE
+
+    def test_try_update_advances_and_unpauses(self):
+        rm = Remote(match=2, next=3, state=RemoteState.WAIT)
+        assert rm.try_update(6)
+        assert (rm.match, rm.next) == (6, 7)
+        assert rm.state == RemoteState.RETRY
+
+    def test_try_update_stale_ack(self):
+        rm = Remote(match=6, next=9, state=RemoteState.REPLICATE)
+        assert not rm.try_update(4)
+        assert (rm.match, rm.next) == (6, 9)
+
+    def test_try_update_never_regresses_next(self):
+        rm = Remote(match=2, next=9, state=RemoteState.REPLICATE)
+        assert rm.try_update(5)
+        assert rm.next == 9  # ack below optimistic next keeps pipeline
+
+    def test_decrease_in_replicate_falls_back_to_probe(self):
+        rm = Remote(match=4, next=10, state=RemoteState.REPLICATE)
+        assert rm.decrease(9, 6)
+        assert rm.state == RemoteState.RETRY
+        assert rm.next == rm.match + 1
+
+    def test_decrease_replicate_stale_rejection(self):
+        rm = Remote(match=4, next=10, state=RemoteState.REPLICATE)
+        assert not rm.decrease(3, 2)  # rejected index <= match: stale
+        assert rm.state == RemoteState.REPLICATE
+
+    def test_decrease_probe_uses_follower_hint(self):
+        rm = Remote(match=0, next=8, state=RemoteState.RETRY)
+        assert rm.decrease(7, 3)  # follower says its last is 3
+        assert rm.next == 4
+
+    def test_decrease_probe_stale_when_next_moved(self):
+        rm = Remote(match=0, next=8)
+        assert not rm.decrease(5, 3)  # we never probed at prev=5
+        assert rm.next == 8
+
+    def test_decrease_clamps_above_match(self):
+        rm = Remote(match=5, next=7)
+        assert rm.decrease(6, 1)  # hint below match must not win
+        assert rm.next == 6  # max(min(6, 2), match+1, 1)
+
+    def test_decrease_unpauses_wait(self):
+        rm = Remote(match=0, next=8, state=RemoteState.WAIT)
+        assert rm.decrease(7, 3)
+        assert rm.state == RemoteState.RETRY
+
+    def test_snapshot_pause_and_success_resume(self):
+        rm = Remote(match=0, next=1)
+        rm.become_snapshot(50)
+        assert rm.is_paused() and rm.snapshot_index == 50
+        # SnapshotStatus(success) -> wait; next probe resumes past the
+        # snapshot index
+        rm.become_wait()
+        assert rm.state == RemoteState.WAIT
+        rm.wait_to_retry()
+        assert rm.next == 51  # max(match, snapshot_index) + 1
+
+    def test_snapshot_failure_clears_pending_index(self):
+        rm = Remote(match=3, next=4)
+        rm.become_snapshot(50)
+        rm.clear_pending_snapshot()
+        rm.become_wait()
+        assert rm.next == 4  # back to match + 1, not snapshot + 1
+
+    def test_become_replicate_resets_from_snapshot(self):
+        rm = Remote(match=50, next=4, state=RemoteState.SNAPSHOT,
+                    snapshot_index=50)
+        rm.become_replicate()
+        assert (rm.state, rm.next, rm.snapshot_index) == (
+            RemoteState.REPLICATE, 51, 0)
+
+    def test_reset_restores_probe(self):
+        rm = Remote(match=9, next=12, state=RemoteState.SNAPSHOT,
+                    snapshot_index=20)
+        rm.reset(next_index=13)
+        assert (rm.match, rm.next, rm.state, rm.snapshot_index) == (
+            0, 13, RemoteState.RETRY, 0)
+
+
+# ---------------------------------------------------------------------------
+# 2. Leader-side flow transitions through the protocol (Network level)
+# ---------------------------------------------------------------------------
+class TestLeaderFlowStates:
+    def test_followers_enter_replicate_after_first_ack(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        for rm in lead.remotes.values():
+            if rm is not lead.remotes.get(1):
+                assert rm.state == RemoteState.REPLICATE
+
+    def test_unreachable_degrades_replicate_to_probe(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        lead.handle(Message(type=MessageType.UNREACHABLE, from_=2))
+        assert lead.remotes[2].state in (RemoteState.RETRY, RemoteState.WAIT)
+        # an ack resumes pipelining
+        net.propose(1)
+        assert lead.remotes[2].state == RemoteState.REPLICATE
+
+    def test_partitioned_follower_probe_pauses(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        net.isolate(3)
+        lead.handle(Message(type=MessageType.UNREACHABLE, from_=3))
+        net.propose(1)  # commit still advances via replica 2
+        assert lead.log.committed == lead.log.last_index()
+        st = lead.remotes[3].state
+        assert st in (RemoteState.RETRY, RemoteState.WAIT)
+        # repeated proposals must NOT spam the paused probe with sends:
+        # next stays pinned while paused
+        n0 = lead.remotes[3].next
+        net.propose(1)
+        net.propose(1)
+        assert lead.remotes[3].next == n0
+        # heartbeat-resp after heal resumes and catches the follower up
+        net.recover()
+        net.tick_all(2)
+        assert lead.remotes[3].state == RemoteState.REPLICATE
+        assert net.peers[3].log.last_index() == lead.log.last_index()
+
+    def test_compacted_log_triggers_snapshot_state(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        net.isolate(3)
+        lead.handle(Message(type=MessageType.UNREACHABLE, from_=3))
+        for i in range(5):
+            net.propose(1)
+        # compact the leader's log past the follower's position and give
+        # the reader a snapshot covering the prefix; the in-memory window
+        # must ALSO be drained (saved + applied) or the leader can still
+        # serve the probe from inmem and never needs the snapshot path
+        last = lead.log.last_index()
+        last_term = lead.log.term(last)
+        lead.log.inmem.saved_log_to(last, last_term)
+        lead.log.logdb.apply_snapshot(Snapshot(
+            index=last, term=last_term,
+            membership=lead.get_membership(), shard_id=1,
+        ))
+        lead.log.inmem.applied_log_to(last)
+        net.recover()
+        # the follower's next rejection forces the snapshot path; the
+        # whole install + ack cycle completes inside the tick cascade, so
+        # assert the end state: the follower RESTORED from the snapshot
+        # (the entries are compacted everywhere — no other way to 6)
+        net.tick_all(2)
+        f3 = net.peers[3]
+        # the restore lands in the in-memory window (the host's
+        # persist-snapshot step doesn't exist in this pure harness)
+        assert f3.log.inmem.get_snapshot_index() == last
+        assert f3.log.first_index() == last + 1
+        assert f3.log.last_index() == last
+        rm = lead.remotes[3]
+        assert rm.match == last
+        assert rm.state == RemoteState.REPLICATE
+
+    def test_snapshot_status_reject_returns_to_probe(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        rm = lead.remotes[2]
+        rm.become_snapshot(40)
+        lead.handle(Message(
+            type=MessageType.SNAPSHOT_STATUS, from_=2, reject=True))
+        assert rm.state == RemoteState.WAIT
+        assert rm.snapshot_index == 0
+
+    def test_snapshot_received_pauses_until_ack(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        rm = lead.remotes[2]
+        rm.become_snapshot(40)
+        lead.handle(Message(type=MessageType.SNAPSHOT_RECEIVED, from_=2))
+        assert rm.state == RemoteState.WAIT
+        # ...and the eventual replicate-resp ack exits the snapshot
+        # cycle: match advances and the remote is no longer snapshotting
+        # (it may immediately probe-and-pause again, which is WAIT)
+        lead.handle(Message(
+            type=MessageType.REPLICATE_RESP, from_=2,
+            log_index=lead.log.last_index(), term=lead.term))
+        assert rm.state != RemoteState.SNAPSHOT
+        assert rm.match == lead.log.last_index()
+
+    def test_stale_snapshot_status_ignored(self):
+        net = Network.of(3)
+        net.elect(1)
+        lead = net.peers[1]
+        rm = lead.remotes[2]
+        assert rm.state == RemoteState.REPLICATE
+        lead.handle(Message(
+            type=MessageType.SNAPSHOT_STATUS, from_=2, reject=True))
+        assert rm.state == RemoteState.REPLICATE  # not in snapshot state
+
+
+# ---------------------------------------------------------------------------
+# 3. InMemory / EntryLog edge families (inmemory_test.go, logentry_test.go)
+# ---------------------------------------------------------------------------
+class TestInMemoryWindow:
+    def test_contiguous_merge_appends(self):
+        im = InMemory(0)
+        im.merge([ent(1), ent(2)])
+        im.merge([ent(3)])
+        assert [e.index for e in im.entries] == [1, 2, 3]
+        assert im.marker == 1
+
+    def test_merge_full_replace_below_marker(self):
+        im = InMemory(4)  # marker 5
+        im.merge([ent(5, 1), ent(6, 1)])
+        im.saved_log_to(6, 1)
+        im.merge([ent(3, 2), ent(4, 2)])  # leader overwrote our tail
+        assert im.marker == 3
+        assert [e.index for e in im.entries] == [3, 4]
+        assert im.saved_to == 2  # persisted suffix no longer trustworthy
+
+    def test_merge_mid_window_truncates_conflict(self):
+        im = InMemory(0)
+        im.merge([ent(1, 1), ent(2, 1), ent(3, 1)])
+        im.saved_log_to(3, 1)
+        im.merge([ent(2, 2)])
+        assert [(e.index, e.term) for e in im.entries] == [(1, 1), (2, 2)]
+        assert im.saved_to == 1
+
+    def test_entries_to_save_tracks_saved_cursor(self):
+        im = InMemory(0)
+        im.merge([ent(1), ent(2), ent(3)])
+        assert [e.index for e in im.entries_to_save()] == [1, 2, 3]
+        im.saved_log_to(2, 1)
+        assert [e.index for e in im.entries_to_save()] == [3]
+
+    def test_saved_log_to_ignores_term_mismatch(self):
+        im = InMemory(0)
+        im.merge([ent(1, 1), ent(2, 1)])
+        im.saved_log_to(2, 9)  # a different incarnation's persist ack
+        assert im.saved_to == 0
+
+    def test_applied_gc_respects_saved_cursor(self):
+        im = InMemory(0)
+        im.merge([ent(1), ent(2), ent(3)])
+        im.saved_log_to(1, 1)
+        im.applied_log_to(3)  # applied ahead of persisted: GC only to saved
+        assert im.marker == 2
+        assert [e.index for e in im.entries] == [2, 3]
+
+    def test_byte_accounting_through_truncation(self):
+        im = InMemory(0)
+        im.merge([ent(1, cmd=b"aaaa"), ent(2, cmd=b"bbbb")])
+        b0 = im.bytes
+        im.merge([ent(2, 2, cmd=b"c")])  # truncate + replace index 2
+        assert im.bytes < b0
+        im.applied_log_to(0)
+        assert im.bytes > 0
+
+    def test_restore_resets_window(self):
+        im = InMemory(0)
+        im.merge([ent(1), ent(2)])
+        ss = Snapshot(index=10, term=3, shard_id=1)
+        im.restore(ss)
+        assert im.marker == 11
+        assert im.entries == []
+        assert im.get_snapshot_index() == 10
+        assert im.get_term(10) == 3
+        im.saved_snapshot_to(10)
+        assert im.get_snapshot_index() is None
+
+    def test_get_entries_bounds(self):
+        im = InMemory(2)  # marker 3
+        im.merge([ent(3), ent(4)])
+        with pytest.raises(LogCompactedError):
+            im.get_entries(2, 4)
+        with pytest.raises(LogUnavailableError):
+            im.get_entries(3, 6)
+        assert [e.index for e in im.get_entries(3, 5)] == [3, 4]
+
+
+class TestEntryLogEdges:
+    def _log(self, terms):
+        rd = InMemLogReader([ent(i + 1, t) for i, t in enumerate(terms)])
+        lg = EntryLog(rd)
+        return lg
+
+    def test_term_at_boundaries(self):
+        lg = self._log([1, 1, 2])
+        assert lg.term(0) == 0
+        assert lg.term(3) == 2
+        with pytest.raises(LogUnavailableError):
+            lg.term(4)
+
+    def test_match_term_and_up_to_date(self):
+        lg = self._log([1, 2, 2])
+        assert lg.match_term(3, 2)
+        assert not lg.match_term(3, 1)
+        assert lg.up_to_date(3, 2)      # same point
+        assert lg.up_to_date(2, 3)      # higher term beats longer log
+        assert not lg.up_to_date(9, 1)  # lower term loses regardless
+
+    def test_try_append_conflict_truncates(self):
+        lg = self._log([1, 1, 1])
+        ok, _ = lg.try_append(1, 1, [ent(2, 2), ent(3, 2)])
+        assert ok
+        assert lg.last_index() == 3
+        assert lg.term(2) == 2
+
+    def test_try_append_rejects_on_prev_mismatch(self):
+        lg = self._log([1, 1])
+        ok, _ = lg.try_append(2, 9, [ent(3, 2)])
+        assert not ok
+        assert lg.last_index() == 2
+
+    def test_try_append_idempotent_prefix(self):
+        lg = self._log([1, 1, 2])
+        ok, _ = lg.try_append(1, 1, [ent(2, 1), ent(3, 2)])
+        assert ok
+        assert lg.last_index() == 3
+        assert lg.term(3) == 2
+
+    def test_commit_to_beyond_last_raises(self):
+        lg = self._log([1, 1])
+        with pytest.raises(RuntimeError):
+            lg.commit_to(5)
+
+    def test_commit_regression_is_noop(self):
+        lg = self._log([1, 1, 1])
+        lg.commit_to(3)
+        lg.commit_to(1)
+        assert lg.committed == 3
+
+    def test_entries_to_apply_and_cursor(self):
+        lg = self._log([1, 1, 1])
+        lg.commit_to(2)
+        got = lg.entries_to_apply()
+        assert [e.index for e in got] == [1, 2]
+
+    def test_restore_moves_everything(self):
+        lg = self._log([1, 1])
+        ss = Snapshot(index=9, term=4, shard_id=1)
+        lg.restore(ss)
+        assert lg.first_index() == 10
+        assert lg.last_index() == 9
+        assert lg.committed == 9
+        assert lg.term(9) == 4
+
+
+# ---------------------------------------------------------------------------
+# 4. Kernel parity for the flow-state scenarios
+# ---------------------------------------------------------------------------
+from kernel_harness import Cluster  # noqa: E402  (jax import is heavy)
+from dragonboat_tpu.pb import Message as PMsg  # noqa: E402
+
+
+class TestKernelFlowParity:
+    def test_probe_pause_resume_parity(self):
+        """A rejected probe (fresh follower behind) and the subsequent
+        catch-up must keep device rstate/next/match bit-equal."""
+        c = Cluster({1: [1, 2, 3]})
+        lid = c.elect(1)
+        # several appends while follower 3's traffic is withheld: drop
+        # row (1,3)'s inbox by not delivering its queued messages
+        for i in range(3):
+            c.step({(1, lid): [c.propose(1, lid, [b"p%d" % i])]})
+            # deliver only to the OTHER follower
+            b = c.deliver_batches(tick=False)
+            b.pop((1, 3), None)
+            c.step(b)
+        # now release everything; the leader probes/decreases and catches
+        # the lagging follower up — all under parity comparison
+        for _ in range(8):
+            c.step(c.deliver_batches(tick=False))
+        for _ in range(3):
+            c.step(c.deliver_batches(tick=True))
+        lead = c.rafts[(1, lid)]
+        assert c.rafts[(1, 3)].log.last_index() == lead.log.last_index()
+
+    def test_duplicate_and_reordered_acks_parity(self):
+        c = Cluster({1: [1, 2, 3]})
+        lid = c.elect(1)
+        c.step({(1, lid): [c.propose(1, lid, [b"a"])]})
+        # capture this round's outbound traffic, then deliver it TWICE
+        # in reversed order (duplication + reordering is raft-legal)
+        batches = c.deliver_batches(tick=False)
+        rev = {k: list(reversed(v)) for k, v in batches.items()}
+        c.step(rev)
+        c.step(rev)
+        for _ in range(6):
+            c.step(c.deliver_batches(tick=False))
+        lead = c.rafts[(1, lid)]
+        assert lead.log.committed == lead.log.last_index()
+
+    def test_unreachable_hint_parity(self):
+        c = Cluster({1: [1, 2, 3]})
+        lid = c.elect(1)
+        c.step({
+            (1, lid): [PMsg(type=MessageType.UNREACHABLE, from_=2)],
+        })
+        # follow-up proposal probes (not pipelines) toward 2
+        c.step({(1, lid): [c.propose(1, lid, [b"x"])]})
+        for _ in range(6):
+            c.step(c.deliver_batches(tick=False))
+        assert c.rafts[(1, 2)].log.last_index() == \
+            c.rafts[(1, lid)].log.last_index()
+
+    def test_mixed_groups_progress_independently(self):
+        """Two groups in one device batch: one churning through probe
+        fallback, the other committing normally — no cross-row bleed."""
+        c = Cluster({1: [1, 2, 3], 2: [1, 2, 3]})
+        l1 = c.elect(1)
+        l2 = c.elect(2)
+        for i in range(3):
+            c.step({
+                (1, l1): [c.propose(1, l1, [b"g1-%d" % i])],
+                (2, l2): [c.propose(2, l2, [b"g2-%d" % i])],
+            })
+            b = c.deliver_batches(tick=False)
+            b.pop((1, 3), None)  # group 1's follower 3 lags
+            c.step(b)
+        for _ in range(8):
+            c.step(c.deliver_batches(tick=False))
+        a = c.rafts[(1, l1)]
+        b_ = c.rafts[(2, l2)]
+        assert a.log.committed == a.log.last_index()
+        assert b_.log.committed == b_.log.last_index()
+        assert c.rafts[(1, 3)].log.last_index() == a.log.last_index()

@@ -32,6 +32,7 @@ import time
 import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dragonboat_tpu import (
     Config,
@@ -155,6 +156,9 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
                            pre_vote=True, check_quorum=True,
                            snapshot_entries=0),
                 )
+            if shard % 500 == 0:
+                print(f"started {shard}/{shards} shards "
+                      f"({round(time.time() - t0, 1)}s)", flush=True)
         report["start_replicas_secs"] = round(time.time() - t0, 1)
 
         # leader coverage = the become-leader barrier committed, i.e.
@@ -168,6 +172,8 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
                 for shard in range(1, shards + 1)
                 if nhs[1]._nodes[shard].peer.raft.log.committed >= 1
             )
+            print(f"leader coverage {covered}/{shards} "
+                  f"({round(time.time() - t0, 1)}s)", flush=True)
             if covered == shards:
                 break
             time.sleep(2.0)
@@ -228,6 +234,14 @@ def test_scale_shards():
 
 
 if __name__ == "__main__":
+    # standalone runs need the conftest's backend pinning: cpu platform
+    # (the TPU tunnel's ~1s dispatch breaks election timing) + compile
+    # cache so the warm kernel doesn't cost minutes
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
     out = run_scale(n, sys.argv[2] if len(sys.argv) > 2 else "")
     print(json.dumps(out, indent=1))
