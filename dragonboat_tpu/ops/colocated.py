@@ -146,6 +146,13 @@ class ColocatedVectorEngine(VectorStepEngine):
             routed_dropped=0,
         )
 
+    def _compute_base(self, r) -> int:
+        # routed messages carry raw int32 index lanes BETWEEN rows, which
+        # is only sound when every row of a shard shares one base; the
+        # colocated engine keeps base 0 and retains the absolute-int32
+        # ceiling (documented in _plan_device / PARITY.md)
+        return 0
+
     # -- row identity ---------------------------------------------------
     def _row_key(self, node):
         # several NodeHosts share this engine: replicas of one shard are
@@ -349,7 +356,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 not self._meta[g].dirty
                 and self._mirror[_R_ROLE, g] == int(RaftRole.LEADER)
             )
-            plan = self._plan_device(node, si, mirror_leader)
+            plan = self._plan_device(node, si, mirror_leader, g)
             if plan is None:
                 host_rows.append((node, si))
                 continue

@@ -176,6 +176,27 @@ def _set_remote_snapshot(state: DeviceState, g_idx, p_idx, snap_idx):
     )
 
 
+def _shift_msg_indexes(msg: Message, delta: int) -> Message:
+    """Shift a wire message's INDEX fields by ``delta`` (the rebase
+    boundary conversion): log_index and commit always; hint only when it
+    is an index (a REPLICATE_RESP reject hint), never when it is a ctx
+    key.  Used with -base entering the device and +base leaving it —
+    one definition so encode and decode can never disagree."""
+    if delta == 0:
+        return msg
+    h = (
+        msg.hint + delta
+        if msg.type == MessageType.REPLICATE_RESP and msg.reject
+        else msg.hint
+    )
+    return dataclasses.replace(
+        msg,
+        log_index=msg.log_index + delta,
+        commit=msg.commit + delta,
+        hint=h,
+    )
+
+
 def _tick_bookkeeping(node, ticks: int) -> None:
     """Advance the node's logical clock and GC timed-out futures — the
     device path's mirror of the tick tail of ``Node.step_with_inputs``."""
@@ -258,6 +279,11 @@ class VectorStepEngine(IStepEngine):
         self._row_of: Dict[int, int] = {}  # shard_id -> g
         self._meta: Dict[int, _RowMeta] = {}  # g -> meta
         self._free: List[int] = list(range(capacity - 1, -1, -1))
+        # per-row index base (the 64-bit story): the host log is 64-bit
+        # throughout; device rows hold indexes REBASED by a per-row
+        # multiple of W so the int32 lanes never overflow.  Recomputed at
+        # every upload; all host<->device index conversions go through it.
+        self._base = np.zeros((capacity,), np.int64)
         self._lock = threading.Lock()
         self._warned_full = False
         # host mirrors of the summary scalars (term/vote/commit/...)
@@ -365,6 +391,36 @@ class VectorStepEngine(IStepEngine):
         self._free.append(g)
         node.stop()
 
+    def _compute_base(self, r) -> int:
+        """Largest W-multiple not exceeding any live index quantity of
+        the row — subtracting it keeps every device lane positive (0
+        stays the sentinel for match/next/snap) and, being a multiple of
+        W, leaves ring slot assignment invariant.  The colocated engine
+        overrides this to 0: routed messages carry raw index lanes
+        between rows, which is only sound under one shared base."""
+        # committed bounds the base, NOT first_index: the device only
+        # holds the [last-W+1, last] ring, so a shifted first_index lane
+        # may legitimately go negative (uniform shift keeps every
+        # comparison exact); an uncompacted log whose retained span
+        # itself exceeds int32 is rejected by the planner's spread guard
+        qs = [r.log.committed]
+        if r.role == RaftRole.LEADER:
+            # per-peer progress lanes are live state only on a leader;
+            # followers carry stale values (e.g. next=1 from boot) that
+            # get reset at the next election — including those would pin
+            # the base at 0 forever.  Stale non-leader lanes clamp to the
+            # 0 sentinel at upload instead (state_from_rafts).
+            for group in (r.remotes, r.non_votings, r.witnesses):
+                for rm in group.values():
+                    if rm.match > 0:
+                        qs.append(rm.match - 1)
+                    if rm.next > 0:
+                        qs.append(rm.next - 1)
+                    if rm.snapshot_index > 0:
+                        qs.append(rm.snapshot_index - 1)
+        base = max(0, min(qs))
+        return base - (base % self.W)
+
     def _static_host_only(self, node) -> bool:
         """Shards that can never (currently) be device-resident — checked
         BEFORE attaching a row or consuming quiesce state."""
@@ -399,7 +455,9 @@ class VectorStepEngine(IStepEngine):
     # ------------------------------------------------------------------
     # classification
     # ------------------------------------------------------------------
-    def _plan_device(self, node, si, mirror_leader: bool) -> Optional[List[Tuple]]:
+    def _plan_device(
+        self, node, si, mirror_leader: bool, g: int
+    ) -> Optional[List[Tuple]]:
         """Return the ordered inbox slot plan, or None for the host path.
 
         Slot order mirrors the scalar replay order in
@@ -437,10 +495,29 @@ class VectorStepEngine(IStepEngine):
         if r.snapshotting:
             return None
         lim = 2**31 - 1
-        # the device state is int32; a row whose terms/indexes outgrow it
-        # stays on the scalar path (the host WAL is 64-bit throughout)
-        if r.term >= lim or r.log.last_index() + self.M * self.E >= lim:
+        # index lanes are REBASED per row (see _compute_base), so log
+        # growth never ages a row off the device; the remaining int32
+        # ceilings are terms (2^31 elections is out of scope — the row
+        # falls back loudly below) and a pathological >2^31 spread
+        # between a row's lowest live index quantity and its last index
+        if self._meta[g].dirty:
+            base = self._compute_base(r)
+            self._base[g] = base
+        else:
+            base = int(self._base[g])
+        if r.term >= lim:
+            if not getattr(r, "_term_lim_warned", False):
+                r._term_lim_warned = True
+                _log.warning(
+                    "[%d:%d] term %d exceeds the device int32 lane; "
+                    "scalar path permanently",
+                    r.shard_id, r.replica_id, r.term,
+                )
             return None
+        if r.log.last_index() - base + self.M * self.E >= lim:
+            return None
+        if base - r.log.first_index() >= lim:
+            return None  # >2^31 retained-but-uncompacted span
         slots: List[Tuple] = []
         for m in si.received:
             if int(m.type) not in _HOT_SET:
@@ -453,14 +530,27 @@ class VectorStepEngine(IStepEngine):
                 return None
             if len(m.entries) > self.E:
                 return None
-            # the device inbox is int32; 64-bit fields (e.g. ReadIndex ctx
-            # keys riding heartbeat hints) take the scalar path
+            # index fields enter the device rebased; ctx keys (hint on
+            # heartbeat/read slots) are 64-bit-split and checked raw, but
+            # a reject hint IS an index and shifts with the base
+            if int(m.type) == int(MessageType.REPLICATE_RESP) and m.reject:
+                h = m.hint - base
+                if base and h <= 0:
+                    # the follower's last index sits BELOW this row's
+                    # base: the kernel's decrease floor (max(..., 1) in
+                    # rebased space) cannot walk next under the base, so
+                    # the scalar path must handle this rejection — it
+                    # decreases in absolute space and the next upload
+                    # recomputes a base low enough for the lagging peer
+                    return None
+            else:
+                h = m.hint
             if (
                 m.term > lim
                 or m.log_term > lim
-                or m.log_index > lim
-                or m.commit > lim
-                or m.hint > lim
+                or not -lim < m.log_index - base < lim
+                or not -lim < m.commit - base < lim
+                or not -lim < h < lim
                 or m.hint_high > lim
             ):
                 return None
@@ -516,7 +606,10 @@ class VectorStepEngine(IStepEngine):
         for _, r in rows:
             if r.role == RaftRole.LEADER and r.check_quorum:
                 self._cq_grace(r)
-        sub = S.state_from_rafts([r for _, r in rows], self.P, self.W)
+        bases = [int(self._base[g]) for g, _ in rows]
+        sub = S.state_from_rafts(
+            [r for _, r in rows], self.P, self.W, bases=bases
+        )
         pad = _bucket(len(rows))
         if pad > len(rows):
             sub = jax.tree.map(
@@ -528,12 +621,13 @@ class VectorStepEngine(IStepEngine):
         idx = self._put(jnp.asarray(_pad_idx([g for g, _ in rows])))
         self._state = _scatter_rows(self._state, idx, self._put(sub))
         for k, (g, r) in enumerate(rows):
+            # the mirror holds what the DEVICE holds: index rows shifted
             self._mirror[_R_TERM, g] = r.term
             self._mirror[_R_VOTE, g] = r.vote
-            self._mirror[_R_COMMIT, g] = r.log.committed
+            self._mirror[_R_COMMIT, g] = r.log.committed - self._base[g]
             self._mirror[_R_LEADER, g] = r.leader_id
             self._mirror[_R_ROLE, g] = int(r.role)
-            self._mirror[_R_LAST, g] = r.log.last_index()
+            self._mirror[_R_LAST, g] = r.log.last_index() - self._base[g]
             self._meta[g].dirty = False
 
     def _materialize_rows(
@@ -552,6 +646,7 @@ class VectorStepEngine(IStepEngine):
         sub = jax.tree.map(np.asarray, _gather_rows(st, idx))
         for k, g in enumerate(gs):
             node = self._meta[g].node
+            base = int(self._base[g])
             if node.device_reads.has_pending():
                 # the scalar path takes over: device-read confirmations
                 # ride device steps and would never arrive — fail fast
@@ -562,7 +657,7 @@ class VectorStepEngine(IStepEngine):
             r.vote = int(sub.vote[k])
             r.leader_id = int(sub.leader_id[k])
             r.role = RaftRole(int(sub.role[k]))
-            r.log.committed = int(sub.committed[k])
+            r.log.committed = int(sub.committed[k]) + base
             r.election_tick = int(sub.election_tick[k])
             r.heartbeat_tick = int(sub.heartbeat_tick[k])
             r.randomized_election_timeout = int(sub.rand_timeout[k])
@@ -577,10 +672,13 @@ class VectorStepEngine(IStepEngine):
                 rm = r.get_remote(pid)
                 if rm is None:
                     continue
-                rm.match = int(sub.match[k, p])
-                rm.next = int(sub.next_idx[k, p])
+                m_ = int(sub.match[k, p])
+                n_ = int(sub.next_idx[k, p])
+                s_ = int(sub.snap_index[k, p])
+                rm.match = m_ + base if m_ > 0 else m_
+                rm.next = n_ + base if n_ > 0 else n_
                 rm.state = RemoteState(int(sub.rstate[k, p]))
-                rm.snapshot_index = int(sub.snap_index[k, p])
+                rm.snapshot_index = s_ + base if s_ > 0 else s_
                 rm.active = bool(sub.active[k, p])
                 granted = int(sub.granted[k, p])
                 if granted:
@@ -588,7 +686,7 @@ class VectorStepEngine(IStepEngine):
             r.votes = votes
             if r.role == RaftRole.LEADER and r.check_quorum:
                 self._cq_grace(r)  # sheared window — see _cq_grace
-            dev_last = int(sub.last_index[k])
+            dev_last = int(sub.last_index[k]) + base
             host_last = r.log.last_index()
             if dev_last != host_last:
                 # the reconstruction invariant broke: the host log no
@@ -640,7 +738,7 @@ class VectorStepEngine(IStepEngine):
                     not self._meta[g].dirty
                     and self._mirror[_R_ROLE, g] == int(RaftRole.LEADER)
                 )
-                plan = self._plan_device(node, si, mirror_leader)
+                plan = self._plan_device(node, si, mirror_leader, g)
                 if plan is None:
                     host_rows.append((node, si))
                     continue
@@ -715,11 +813,12 @@ class VectorStepEngine(IStepEngine):
         for node, g, si, plan in batch:
             row_msgs = msg_rows[g]
             stage: Dict[int, List[Entry]] = {}
+            base = int(self._base[g])
             for slot, (kind, payload) in enumerate(plan):
                 if kind == "msg":
-                    row_msgs.append(payload)
                     if payload.entries:
                         stage[slot] = list(payload.entries)
+                    row_msgs.append(_shift_msg_indexes(payload, -base))
                 elif kind == "prop":
                     row_msgs.append(
                         Message(
@@ -844,9 +943,12 @@ class VectorStepEngine(IStepEngine):
         snapshot_sends: List[Tuple[int, int, int]] = []  # (g, p, ss_index)
         for node, g, si in live:
             r = node.peer.raft
+            base = int(self._base[g])
             term, vote, committed, leader, role, last = (
                 int(summary[i, g]) for i in range(6)
             )
+            committed += base
+            last += base
             changed = (
                 summary[:6, g] != self._mirror[:6, g]
             ).any() or summary[_R_COUNT, g] > 0
@@ -865,7 +967,7 @@ class VectorStepEngine(IStepEngine):
                 self._merge_appends(
                     r,
                     g,
-                    int(summary[_R_APPEND_LO, g]),
+                    int(summary[_R_APPEND_LO, g]) + base,
                     last,
                     staging.get(g, {}),
                     slot_at,
@@ -874,6 +976,7 @@ class VectorStepEngine(IStepEngine):
                     ent_drop,
                     ring_t[ring_at[g]],
                     ring_c[ring_at[g]],
+                    base=base,
                 )
             # 2. protocol scalar sync
             r.term, r.vote, r.leader_id = term, vote, leader
@@ -894,6 +997,7 @@ class VectorStepEngine(IStepEngine):
                     buf_np[buf_at[g]],
                     int(summary[_R_COUNT, g]),
                     staging.get(g, {}),
+                    base=base,
                 )
             # 4. dropped proposal slots / cc-gated entries -> futures
             if g in slot_at:
@@ -942,6 +1046,7 @@ class VectorStepEngine(IStepEngine):
         ring_cc_row,
         fallback=None,
         barrier: Optional[Tuple[int, int]] = None,
+        base: int = 0,
     ) -> List[Entry]:
         W = self.W
         # candidates[idx] = (slot_order, Entry, term); later slots win
@@ -952,8 +1057,9 @@ class VectorStepEngine(IStepEngine):
         for slot in sorted(stage):
             ents = stage[slot]
             if sb is not None and sb[slot] >= 0:
-                # a PROPOSE slot accepted at base sb[slot]
-                pos = int(sb[slot])
+                # a PROPOSE slot accepted at pre-append index sb[slot]
+                # (device-shifted; sentinels < 0 never shift)
+                pos = int(sb[slot]) + base
                 for j, e in enumerate(ents):
                     if drop is not None and drop[slot, j]:
                         continue
@@ -1031,6 +1137,7 @@ class VectorStepEngine(IStepEngine):
         count: int,
         stage: Dict[int, List[Entry]],
         delivered_row: Optional[np.ndarray] = None,
+        base: int = 0,
     ) -> None:
         shim = {"count": np.array([count]), "buf": buf_row[None]}
         for k, (msg, n_ent, src_slot) in enumerate(
@@ -1038,6 +1145,7 @@ class VectorStepEngine(IStepEngine):
         ):
             if delivered_row is not None and delivered_row[k]:
                 continue  # already scattered into a peer row on device
+            msg = _shift_msg_indexes(msg, base)
             if (
                 msg.type == MessageType.READ_INDEX_RESP
                 and msg.to == r.replica_id
@@ -1112,7 +1220,8 @@ class VectorStepEngine(IStepEngine):
                     snapshot=send,
                 )
             )
-            snapshot_sends.append((g, p, ss.index))
+            # the device's snap_index lane is rebased like every index
+            snapshot_sends.append((g, p, ss.index - int(self._base[g])))
 
 
 def vector_step_engine_factory(**kw):

@@ -58,10 +58,20 @@ def peer_layout(raft: Raft) -> List[Tuple[int, int]]:
 
 
 def state_from_rafts(
-    rafts: Sequence[Raft], P: int, W: int
+    rafts: Sequence[Raft], P: int, W: int,
+    bases: Optional[Sequence[int]] = None,
 ) -> DeviceState:
     """Pack oracles into a DeviceState, copying the full volatile state
-    (not just a fresh boot) so escalated rows can return to the device."""
+    (not just a fresh boot) so escalated rows can return to the device.
+
+    ``bases``: optional per-row int64 index base subtracted from every
+    log-index field (committed/last/first/match/next/snap) so rows whose
+    absolute indexes exceed int32 stay device-steppable — the engine's
+    64-bit story (the host WAL is 64-bit throughout; the device works in
+    a rebased window).  Each base MUST be a multiple of W so the ring
+    slot of an index is invariant under the shift ((abs-base) % W ==
+    abs % W), and must not exceed any live index quantity of its row.
+    """
     G = len(rafts)
     st = make_state(
         G,
@@ -72,12 +82,33 @@ def state_from_rafts(
         peer_ids=_peer_ids(rafts, P),
         peer_kinds=_peer_kinds(rafts, P),
     )
+    # int64 staging: absolute indexes may exceed int32 before the shift
     cols: Dict[str, np.ndarray] = {
-        k: np.array(getattr(st, k)) for k in st._fields
+        k: np.array(getattr(st, k), dtype=np.int64) for k in st._fields
     }
     for g, r in enumerate(rafts):
         _fill_row(cols, g, r, P, W)
-    return DeviceState(**{k: jnp.asarray(v) for k, v in cols.items()})
+        if bases is not None and bases[g]:
+            b = int(bases[g])
+            assert b % W == 0, f"row {g}: base {b} not a multiple of W"
+            for k in ("committed", "last_index", "first_index"):
+                cols[k][g] -= b
+            for k in ("match", "next_idx", "snap_index"):
+                row = cols[k][g]
+                row[row > 0] -= b
+                # stale lanes below the base (a non-leader's boot-time
+                # next=1 etc.) clamp to the 0 sentinel: they are dead
+                # state that the next election resets anyway, and
+                # negative lanes would wrap int32
+                row[row < 0] = 0
+    out: Dict[str, np.ndarray] = {}
+    for k, v in cols.items():
+        if (v > 2**31 - 1).any() or (v < -(2**31)).any():
+            raise OverflowError(
+                f"state field {k} exceeds int32 after rebase"
+            )
+        out[k] = v.astype(np.int32)
+    return DeviceState(**{k: jnp.asarray(v) for k, v in out.items()})
 
 
 def _peer_ids(rafts, P):
