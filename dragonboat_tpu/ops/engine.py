@@ -1021,13 +1021,22 @@ class VectorStepEngine(IStepEngine):
             self._mirror[:6, g] = summary[:6, g]
             node._check_leader_change()
 
-        if snapshot_sends:
+        lanes = [(g, p, i) for g, p, i in snapshot_sends if i is not None]
+        if lanes:
             self._state = _set_remote_snapshot(
                 self._state,
-                self._put(jnp.asarray(_pad_idx([g for g, _, _ in snapshot_sends]))),
-                self._put(jnp.asarray(_pad_idx([p for _, p, _ in snapshot_sends]))),
-                self._put(jnp.asarray(_pad_idx([i for _, _, i in snapshot_sends]))),
+                self._put(jnp.asarray(_pad_idx([g for g, _, _ in lanes]))),
+                self._put(jnp.asarray(_pad_idx([p for _, p, _ in lanes]))),
+                self._put(jnp.asarray(_pad_idx([i for _, _, i in lanes]))),
             )
+        below_base = sorted({g for g, _, i in snapshot_sends if i is None})
+        if below_base:
+            # see _send_snapshots: these rows continue on the scalar path
+            for g in below_base:
+                meta = self._meta.get(g)
+                if meta is not None:
+                    meta.dirty = True
+            self._materialize_rows(below_base)
         return updates
 
     # -- append reconstruction -----------------------------------------
@@ -1220,8 +1229,19 @@ class VectorStepEngine(IStepEngine):
                     snapshot=send,
                 )
             )
+            lane = ss.index - int(self._base[g])
+            if lane <= 0:
+                # the durable snapshot sits below this row's base (a
+                # compacted leader whose retained window outruns the
+                # snapshot): the int32 lane can't represent it, and a
+                # zero/negative lane would corrupt the remote's snapshot
+                # tracking.  The INSTALL message above still goes out
+                # (absolute, host wire); the ROW takes a host excursion
+                # so the scalar owns the whole snapshot dance in 64-bit.
+                snapshot_sends.append((g, p, None))
+                continue
             # the device's snap_index lane is rebased like every index
-            snapshot_sends.append((g, p, ss.index - int(self._base[g])))
+            snapshot_sends.append((g, p, lane))
 
 
 def vector_step_engine_factory(**kw):

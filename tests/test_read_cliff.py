@@ -1,0 +1,104 @@
+"""Measure the follower-read cliff (VERDICT r2 weak #6 / ask #9).
+
+Leader reads ride the kernel's device-resident ReadIndex hot path;
+follower reads forward as a cold wire READ_INDEX, which materializes
+BOTH the follower (read-nonleader plan) and the leader (cold wire type)
+to the scalar path.  This measures that cliff so the next device-read
+design decision is data-driven:
+
+    READ_CLIFF=1 python -m pytest tests/test_read_cliff.py -q -s
+
+Numbers land in docs/PARITY.md; the CPU backend makes them indicative
+(relative cliff, not absolute TPU latency).
+"""
+import json
+import os
+import shutil
+import statistics
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("READ_CLIFF"),
+    reason="measurement run: set READ_CLIFF=1",
+)
+
+
+def measure(nhs, rid, n, key):
+    lats = []
+    errors = 0
+    for _ in range(n):
+        t0 = time.perf_counter()
+        try:
+            nhs[rid].sync_read(1, key, timeout=3.0)
+            lats.append(time.perf_counter() - t0)
+        except Exception:
+            errors += 1
+        time.sleep(0.01)  # let queues drain; measure latency, not queuing
+    lats.sort()
+    if not lats:
+        return {"errors": errors}
+    return {
+        "n": len(lats),
+        "errors": errors,
+        "p50_ms": round(1000 * statistics.median(lats), 2),
+        "p90_ms": round(1000 * lats[int(len(lats) * 0.9)], 2),
+        "mean_ms": round(1000 * statistics.fmean(lats), 2),
+    }
+
+
+def test_read_cliff():
+    from test_nodehost import ADDRS, KVStore, propose_r, set_cmd, \
+        wait_for_leader
+    from test_vector_engine import make_vector_nodehost, vec_shard_config
+    from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+    reset_inproc_network()
+    for rid in ADDRS:
+        shutil.rmtree(f"/tmp/nh-vec-{rid}", ignore_errors=True)
+    # rtt 20ms so per-step batches stay under the device inbox (the
+    # device-read test's calibration) — the leader path stays hot
+    nhs = {rid: make_vector_nodehost(rid, rtt_ms=20) for rid in ADDRS}
+    try:
+        for rid, nh in nhs.items():
+            nh.start_replica(
+                ADDRS, False, KVStore,
+                vec_shard_config(rid, heartbeat_rtt=3),
+            )
+        lid = wait_for_leader(nhs)
+        s = nhs[lid].get_noop_session(1)
+        propose_r(nhs[lid], s, set_cmd("rc", b"v"))
+        time.sleep(1.0)
+        n = int(os.environ.get("READ_CLIFF_N", "150"))
+
+        st0 = dict(nhs[lid].engine.step_engine.stats)
+        leader = measure(nhs, lid, n, "rc")
+        st1 = dict(nhs[lid].engine.step_engine.stats)
+        leader["device_reads"] = st1["device_reads"] - st0["device_reads"]
+
+        fid = next(r for r in ADDRS if r != lid)
+        host0 = sum(
+            nh.engine.step_engine.stats["host_rows_stepped"]
+            for nh in nhs.values()
+        )
+        follower = measure(nhs, fid, n, "rc")
+        host1 = sum(
+            nh.engine.step_engine.stats["host_rows_stepped"]
+            for nh in nhs.values()
+        )
+        follower["host_rows_stepped"] = host1 - host0
+
+        out = {"leader_reads": leader, "follower_reads": follower,
+               "cliff_p50": round(
+                   follower.get("p50_ms", 0) / max(leader.get("p50_ms", 1e-9), 1e-9), 2
+               )}
+        print("\nREAD_CLIFF " + json.dumps(out, indent=1))
+        assert leader.get("n", 0) > n * 0.8
+        assert follower.get("n", 0) > n * 0.8
+    finally:
+        for nh in nhs.values():
+            nh.close()
