@@ -618,12 +618,17 @@ class ColocatedVectorEngine(VectorStepEngine):
             self._mirror[:6, g] = summary[:6, g]
             node._check_leader_change()
 
-        if snapshot_sends:
+        # colocated base is pinned 0 so below-base (None) lanes cannot
+        # occur; filter defensively anyway — feeding None to _pad_idx
+        # would crash the step worker if that invariant ever changed
+        lanes = [t for t in snapshot_sends if t[2] is not None]
+        assert len(lanes) == len(snapshot_sends), "colocated base must be 0"
+        if lanes:
             self._state = _set_remote_snapshot(
                 self._state,
-                self._put(jnp.asarray(_pad_idx([g for g, _, _ in snapshot_sends]))),
-                self._put(jnp.asarray(_pad_idx([p for _, p, _ in snapshot_sends]))),
-                self._put(jnp.asarray(_pad_idx([i for _, _, i in snapshot_sends]))),
+                self._put(jnp.asarray(_pad_idx([t[0] for t in lanes]))),
+                self._put(jnp.asarray(_pad_idx([t[1] for t in lanes]))),
+                self._put(jnp.asarray(_pad_idx([t[2] for t in lanes]))),
             )
 
         if self._pending_live:

@@ -518,6 +518,19 @@ class VectorStepEngine(IStepEngine):
             return None
         if base - r.log.first_index() >= lim:
             return None  # >2^31 retained-but-uncompacted span
+        for group in (r.remotes, r.non_votings, r.witnesses):
+            for rm in group.values():
+                if (
+                    rm.state == RemoteState.SNAPSHOT
+                    and 0 < rm.snapshot_index <= base
+                ):
+                    # a below-base snapshot install is in flight: the
+                    # device lane can't represent it (see
+                    # _send_snapshots), so the row stays scalar until
+                    # SnapshotStatus/Received resolves the transfer —
+                    # otherwise re-uploads would re-fire need_snapshot
+                    # and stream duplicate full snapshots every cycle
+                    return None
         slots: List[Tuple] = []
         for m in si.received:
             if int(m.type) not in _HOT_SET:
@@ -1021,22 +1034,33 @@ class VectorStepEngine(IStepEngine):
             self._mirror[:6, g] = summary[:6, g]
             node._check_leader_change()
 
-        lanes = [(g, p, i) for g, p, i in snapshot_sends if i is not None]
+        lanes = [t for t in snapshot_sends if t[2] is not None]
         if lanes:
             self._state = _set_remote_snapshot(
                 self._state,
-                self._put(jnp.asarray(_pad_idx([g for g, _, _ in lanes]))),
-                self._put(jnp.asarray(_pad_idx([p for _, p, _ in lanes]))),
-                self._put(jnp.asarray(_pad_idx([i for _, _, i in lanes]))),
+                self._put(jnp.asarray(_pad_idx([t[0] for t in lanes]))),
+                self._put(jnp.asarray(_pad_idx([t[1] for t in lanes]))),
+                self._put(jnp.asarray(_pad_idx([t[2] for t in lanes]))),
             )
-        below_base = sorted({g for g, _, i in snapshot_sends if i is None})
-        if below_base:
+        below = [t for t in snapshot_sends if t[2] is None]
+        if below:
             # see _send_snapshots: these rows continue on the scalar path
-            for g in below_base:
+            gs = sorted(
+                {t[0] for t in below if self._meta.get(t[0]) is not None}
+            )
+            for g in gs:
+                self._meta[g].dirty = True
+            self._materialize_rows(gs)
+            # mark the scalar remotes AFTER materialize (which overwrote
+            # them from the device): the SNAPSHOT state both suppresses
+            # probe spam and keeps the planner off the device path
+            for g, p, _, pid, ss_index in below:
                 meta = self._meta.get(g)
-                if meta is not None:
-                    meta.dirty = True
-            self._materialize_rows(below_base)
+                if meta is None or meta.node.stopped:
+                    continue
+                rm = meta.node.peer.raft.get_remote(pid)
+                if rm is not None:
+                    rm.become_snapshot(ss_index)
         return updates
 
     # -- append reconstruction -----------------------------------------
@@ -1237,11 +1261,15 @@ class VectorStepEngine(IStepEngine):
                 # zero/negative lane would corrupt the remote's snapshot
                 # tracking.  The INSTALL message above still goes out
                 # (absolute, host wire); the ROW takes a host excursion
-                # so the scalar owns the whole snapshot dance in 64-bit.
-                snapshot_sends.append((g, p, None))
+                # and the scalar remote is marked SNAPSHOT after the
+                # materialize (below) so the planner keeps the row off
+                # the device until the install resolves — otherwise
+                # every re-upload would re-fire need_snapshot and
+                # stream a duplicate full snapshot.
+                snapshot_sends.append((g, p, None, pid, ss.index))
                 continue
             # the device's snap_index lane is rebased like every index
-            snapshot_sends.append((g, p, lane))
+            snapshot_sends.append((g, p, lane, pid, ss.index))
 
 
 def vector_step_engine_factory(**kw):

@@ -180,27 +180,53 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
         report["leader_coverage"] = covered
         report["election_secs"] = round(time.time() - t0, 1)
 
-        # sampled proposals commit end-to-end
+        # sampled proposals commit end-to-end — CONCURRENTLY: at this
+        # scale one launch generation steps all 16k rows and takes
+        # seconds, so a commit needs ~30-60s of wall clock; serial
+        # proposals would each pay that full pipeline latency while
+        # parallel ones share the same launch generations
+        import threading
+
+        import collections
         t0 = time.time()
         sample = list(range(1, shards + 1, max(1, shards // 100)))
-        ok = 0
-        for shard in sample:
+        ok_lock = threading.Lock()
+        ok = [0]
+        errs = collections.Counter()
+
+        def propose_one(shard):
             nh = nhs[1 + (shard % REPLICAS)]
             s = nh.get_noop_session(shard)
-            end = time.time() + 30.0
+            end = time.time() + 240.0
             while True:
                 try:
                     nh.sync_propose(
-                        s, pickle.dumps((f"k{shard}", shard)), timeout=5.0
+                        s, pickle.dumps((f"k{shard}", shard)), timeout=90.0
                     )
-                    ok += 1
-                    break
-                except Exception:
+                    with ok_lock:
+                        ok[0] += 1
+                    return
+                except Exception as e:
+                    with ok_lock:
+                        errs[type(e).__name__] += 1
                     if time.time() > end:
-                        break
-                    time.sleep(0.1)
+                        return
+                    time.sleep(0.5)
+
+        threads = [
+            threading.Thread(target=propose_one, args=(shard,), daemon=True)
+            for shard in sample
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            # must exceed a thread's worst-case lifetime (240s deadline
+            # + one last 90s sync_propose) so no proposer outlives the
+            # report read / NodeHost teardown
+            t.join(timeout=360.0)
         report["proposals_attempted"] = len(sample)
-        report["proposals_committed"] = ok
+        report["proposals_committed"] = ok[0]
+        report["propose_errors"] = dict(errs.most_common(5))
         report["propose_secs"] = round(time.time() - t0, 1)
         # elections keep progressing during the propose phase; record
         # the FINAL coverage too so a slow-start run isn't misread

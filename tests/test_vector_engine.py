@@ -149,7 +149,9 @@ class TestVectorCluster:
         s = nh.get_noop_session(1)
         propose_r(nh, s, set_cmd("pre", b"1"))
         m = nh.sync_get_shard_membership(1)
-        deadline = time.time() + 10.0
+        # generous: the cold excursion + config-change commit needs
+        # several launch round-trips, and CI-load slows each to ~100ms
+        deadline = time.time() + 25.0
         while True:
             try:
                 nh.sync_request_add_non_voting(
