@@ -150,8 +150,10 @@ class TestVectorCluster:
         propose_r(nh, s, set_cmd("pre", b"1"))
         m = nh.sync_get_shard_membership(1)
         # generous: the cold excursion + config-change commit needs
-        # several launch round-trips, and CI-load slows each to ~100ms
-        deadline = time.time() + 25.0
+        # several launch round-trips; under full-suite CPU load each
+        # round-trip stretches to ~100-300ms and only one config change
+        # can be in flight at a time, so retries serialize behind it
+        deadline = time.time() + 45.0
         while True:
             try:
                 nh.sync_request_add_non_voting(
