@@ -181,9 +181,21 @@ def _shift_msg_indexes(msg: Message, delta: int) -> Message:
     boundary conversion): log_index and commit always; hint only when it
     is an index (a REPLICATE_RESP reject hint), never when it is a ctx
     key.  Used with -base entering the device and +base leaving it —
-    one definition so encode and decode can never disagree."""
+    one definition so encode and decode can never disagree.
+
+    READ_INDEX_RESP is special-cased: the kernel's synthetic to-self
+    resp overloads log_index as a VOTER REPLICA ID (or 0 = "request
+    recorded"), not a log index — shifting it would turn the recorded
+    marker into ``base`` and voter ids into garbage, stalling every
+    device-path read once a row's base is nonzero.  Its ``commit`` IS a
+    real index (the recorded read index) and still shifts.  Wire
+    READ_INDEX_RESP (whose log_index is a real index) never crosses
+    this boundary: the type is not in HOT_TYPES, so it cannot enter a
+    device inbox, and the kernel only emits the self-addressed form."""
     if delta == 0:
         return msg
+    if msg.type == MessageType.READ_INDEX_RESP:
+        return dataclasses.replace(msg, commit=msg.commit + delta)
     h = (
         msg.hint + delta
         if msg.type == MessageType.REPLICATE_RESP and msg.reject

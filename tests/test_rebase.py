@@ -252,6 +252,134 @@ class TestRebaseArithmetic:
         engine.detach(node.shard_id)
 
 
+class ReadFakeNode(FakeNode):
+    """FakeNode that records the engine's intercepted synthetic
+    READ_INDEX_RESP-to-self messages (the device-read contract)."""
+
+    def __init__(self, raft):
+        super().__init__(raft)
+        self.read_resps = []
+
+    def handle_device_read_resp(self, m):
+        self.read_resps.append(m)
+
+
+class TestDeviceReadsWithBase:
+    """Device-path linearizable reads on a RESIDENT LEADER whose row
+    base is nonzero — the advisor-found stall: the kernel's synthetic
+    READ_INDEX_RESP overloads log_index as a voter id (or the 0
+    "recorded" marker), so the rebase shift must never touch it, while
+    its commit field IS an index and must shift to absolute."""
+
+    def test_single_voter_read_served_past_2_31(self, engine):
+        from dragonboat_tpu.pb import SystemCtx
+
+        r = high_raft(replica_id=1, peers=(1,), base_index=B31 + 100)
+        node = ReadFakeNode(r)
+        with engine._lock:
+            g = engine._attach(node)
+            si = StepInputs(ticks=1)
+            plan = engine._plan_device(node, si, False, g)
+            engine._upload_rows([(g, r)])
+            for _ in range(40):
+                if r.role == RaftRole.LEADER:
+                    break
+                si = StepInputs(ticks=1)
+                plan = engine._plan_device(node, si, False, g)
+                engine._device_step([(node, g, si, plan)])
+            assert r.role == RaftRole.LEADER
+            assert engine._base[g] > 0
+            ctx = SystemCtx(low=7, high=9)
+            si = StepInputs(read_indexes=[ctx])
+            plan = engine._plan_device(node, si, True, g)
+            assert plan is not None, "leader reads must stay on device"
+            engine._device_step([(node, g, si, plan)])
+        assert node.read_resps, "no synthetic read resp intercepted"
+        m = node.read_resps[-1]
+        assert not m.reject
+        # the "request recorded" marker must survive the rebase shift
+        assert m.log_index == 0
+        # ...while the recorded read index converts to ABSOLUTE
+        assert m.commit == r.log.committed > B31
+        assert (m.hint, m.hint_high) == (7, 9)
+        engine.detach(node.shard_id)
+
+    def test_voter_confirmations_not_shifted(self, engine):
+        """3-voter leader at base > 0: the READ_INDEX broadcast rides
+        heartbeats; each HEARTBEAT_RESP echoing the ctx surfaces as a
+        READ_INDEX_RESP whose log_index is the VOTER ID — with the
+        shift bug it came back as id+base and quorum never confirmed.
+
+        Base is MODEST here (the common steady state: committed >= W):
+        peer resps carry log_index=0, so a base past 2^31 pushes them
+        outside the int32 lane bound and the row (correctly, loudly)
+        bounces to the scalar path instead."""
+        from dragonboat_tpu.pb import SystemCtx
+
+        base0 = 6400
+        r = high_raft(replica_id=1, peers=(1, 2, 3), base_index=base0)
+        node = ReadFakeNode(r)
+        with engine._lock:
+            g = engine._attach(node)
+            si = StepInputs(ticks=1)
+            plan = engine._plan_device(node, si, False, g)
+            assert plan is not None
+            engine._upload_rows([(g, r)])
+            # drive a device election: ticks until the campaign fires,
+            # then grant votes from both peers
+            for _ in range(40):
+                if r.role == RaftRole.CANDIDATE:
+                    break
+                si = StepInputs(ticks=1)
+                plan = engine._plan_device(node, si, False, g)
+                engine._device_step([(node, g, si, plan)])
+            assert r.role == RaftRole.CANDIDATE
+            votes = [
+                Message(type=MessageType.REQUEST_VOTE_RESP, from_=p, to=1,
+                        shard_id=1, term=r.term, commit=base0)
+                for p in (2, 3)
+            ]
+            si = StepInputs(received=votes)
+            plan = engine._plan_device(node, si, False, g)
+            assert plan is not None
+            engine._device_step([(node, g, si, plan)])
+            assert r.role == RaftRole.LEADER
+            barrier = r.log.last_index()
+            # commit the barrier: quorum ack from voter 2
+            ack = Message(type=MessageType.REPLICATE_RESP, from_=2, to=1,
+                          shard_id=1, term=r.term, log_index=barrier,
+                          commit=base0)
+            si = StepInputs(received=[ack])
+            plan = engine._plan_device(node, si, False, g)
+            assert plan is not None
+            engine._device_step([(node, g, si, plan)])
+            assert r.log.committed == barrier > base0
+            # the read: recorded marker first...
+            ctx = SystemCtx(low=11, high=13)
+            si = StepInputs(read_indexes=[ctx])
+            plan = engine._plan_device(node, si, True, g)
+            assert plan is not None
+            engine._device_step([(node, g, si, plan)])
+            assert node.read_resps
+            rec = node.read_resps[-1]
+            assert not rec.reject and rec.log_index == 0
+            assert rec.commit == barrier
+            # ...then a ctx-echoing heartbeat resp from voter 2
+            hb = Message(type=MessageType.HEARTBEAT_RESP, from_=2, to=1,
+                         shard_id=1, term=r.term, hint=11, hint_high=13,
+                         commit=base0)
+            si = StepInputs(received=[hb])
+            plan = engine._plan_device(node, si, False, g)
+            assert plan is not None
+            engine._device_step([(node, g, si, plan)])
+        confirms = [m for m in node.read_resps
+                    if not m.reject and m.log_index != 0]
+        assert confirms, "voter confirmation never surfaced"
+        assert confirms[-1].log_index == 2  # the voter id, NOT id+base
+        assert (confirms[-1].hint, confirms[-1].hint_high) == (11, 13)
+        engine.detach(node.shard_id)
+
+
 class TestClusterRebasing:
     def test_pipeline_runs_with_nonzero_bases(self):
         """Ordinary cluster workload past W entries: re-uploads compute
