@@ -86,6 +86,13 @@ class IStepEngine(abc.ABC):
     def detach(self, shard_id: int) -> None:
         """A shard was unregistered; release any engine-held row state."""
 
+    def detach_many(self, shard_ids) -> None:
+        """Batch detach (NodeHost.close): engines holding shared state
+        behind one lock override this so a 10k-shard teardown is one
+        lock acquisition, not 10k interleaved with live launches."""
+        for s in shard_ids:
+            self.detach(s)
+
 
 class HostStepEngine(IStepEngine):
     """Default serial step loop with cross-shard batched WAL writes."""
@@ -157,7 +164,13 @@ class ExecEngine:
         self._stop.set()
         self.step_ready.wake()
         self.apply_ready.wake()
-        leaked = self._stopper.stop(timeout=2.0)
+        # the join must outlast one worst-case step iteration: in
+        # colocated mode a worker can be blocked on the shared core lock
+        # behind another member's full-width launch (multi-second at 64k
+        # rows on CPU) — 2s here is what produced the r03 MULTICHIP
+        # 'workers leaked at stop' artifact.  The join returns the
+        # moment workers exit, so a healthy stop stays fast.
+        leaked = self._stopper.stop(timeout=30.0)
         if leaked:
             _log.warning("engine workers leaked at stop: %s", leaked)
         self.step_engine.stop()
@@ -176,6 +189,12 @@ class ExecEngine:
         with self._nodes_lock:
             self._nodes.pop(shard_id, None)
         self.step_engine.detach(shard_id)
+
+    def unregister_many(self, shard_ids) -> None:
+        with self._nodes_lock:
+            for s in shard_ids:
+                self._nodes.pop(s, None)
+        self.step_engine.detach_many(shard_ids)
 
     def nodes_for_partition(self, shard_ids: List[int]) -> List["Node"]:
         with self._nodes_lock:

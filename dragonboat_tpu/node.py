@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import os
 import threading
-from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .client import Session
@@ -62,6 +61,7 @@ class StepInputs:
         "transfers",
         "snapshot_reqs",
         "ticks",
+        "gc_ticks",
     )
 
     def __init__(
@@ -74,18 +74,45 @@ class StepInputs:
         transfers=(),
         snapshot_reqs=(),
         ticks=0,
+        gc_ticks=0,
     ):
-        self.received = list(received)
-        self.proposals = list(proposals)
-        self.read_indexes = list(read_indexes)
-        self.config_changes = list(config_changes)
-        self.cc_results = list(cc_results)
-        self.transfers = list(transfers)
-        self.snapshot_reqs = list(snapshot_reqs)
+        # empty inputs stay the shared () — consumers only iterate and
+        # slice, and the idle per-tick drain at 50k rows must not build
+        # seven throwaway lists per row
+        self.received = list(received) if received else ()
+        self.proposals = list(proposals) if proposals else ()
+        self.read_indexes = list(read_indexes) if read_indexes else ()
+        self.config_changes = list(config_changes) if config_changes else ()
+        self.cc_results = list(cc_results) if cc_results else ()
+        self.transfers = list(transfers) if transfers else ()
+        self.snapshot_reqs = list(snapshot_reqs) if snapshot_reqs else ()
         self.ticks = ticks
+        # ticks DROPPED by the add_tick backlog cap: they advance the
+        # logical clock (future deadlines are measured on it, so client
+        # timeouts stay bounded in wall time during step stalls) but
+        # drive no raft ticks
+        self.gc_ticks = gc_ticks
 
 
 class Node:
+    # __slots__: a NodeHost hosts tens of thousands of these (reference
+    # hosts millions of groups via quiesce [U]); the per-instance dict
+    # plus seven deques were the bulk of the r03 112-412 KB/row host
+    # footprint.  Queues are plain lists (append + swap-drain only).
+    __slots__ = (
+        "config", "shard_id", "replica_id", "logdb", "snapshot_storage",
+        "transport", "on_leader_updated", "events", "registry",
+        "_qlock", "_received", "_proposals", "_read_indexes",
+        "_config_changes", "_cc_to_apply", "_snapshot_reqs",
+        "_leader_transfers", "_pending_ticks", "_gc_only_ticks",
+        "pending_proposal", "pending_read_index", "pending_config_change",
+        "pending_snapshot", "pending_leader_transfer", "device_reads",
+        "tick_count", "leader_id", "stopped", "stopping", "_snapshotting",
+        "_applied_since_snapshot", "_retired_snapshots", "_apply_lock",
+        "_sm_close_lock", "notify_work", "engine_apply_ready",
+        "log_reader", "sm", "_stop_event", "peer", "quiesce",
+    )
+
     def __init__(
         self,
         config: Config,
@@ -110,15 +137,20 @@ class Node:
         self.registry = registry
 
         # --- queues (thread-safe inputs to step) -------------------------
+        # plain lists, not deques: producers only append and the drain
+        # swaps the whole list out, and an empty deque costs ~750 B — at
+        # 50k replica rows the seven deques alone were ~250 MB of idle
+        # host footprint
         self._qlock = threading.Lock()
-        self._received: deque = deque()
-        self._proposals: deque = deque()  # Entry
-        self._read_indexes: deque = deque()  # SystemCtx
-        self._config_changes: deque = deque()  # (key, ConfigChange)
-        self._cc_to_apply: deque = deque()  # (ConfigChange|None, accepted)
-        self._snapshot_reqs: deque = deque()  # (key, overhead)
-        self._leader_transfers: deque = deque()  # target
+        self._received: list = []
+        self._proposals: list = []  # Entry
+        self._read_indexes: list = []  # SystemCtx
+        self._config_changes: list = []  # (key, ConfigChange)
+        self._cc_to_apply: list = []  # (ConfigChange|None, accepted)
+        self._snapshot_reqs: list = []  # (key, overhead)
+        self._leader_transfers: list = []  # target
         self._pending_ticks = 0
+        self._gc_only_ticks = 0  # dropped by the backlog cap; clock-only
 
         # --- pending futures --------------------------------------------
         # keys must be unique across NODE INCARNATIONS, not just within
@@ -137,14 +169,15 @@ class Node:
             # halves for the device inbox (request.PendingReadIndex.read)
             return ((config.replica_id & 0xFFF) << 48) | _rand.getrandbits(47)
 
-        self.pending_proposal = PendingProposal()
+        _tables_lock = threading.Lock()  # shared: see _PendingBase
+        self.pending_proposal = PendingProposal(_tables_lock)
         self.pending_proposal._next_key = key_base()
-        self.pending_read_index = PendingReadIndex()
+        self.pending_read_index = PendingReadIndex(_tables_lock)
         self.pending_read_index._next_key = key_base()
-        self.pending_config_change = PendingConfigChange()
+        self.pending_config_change = PendingConfigChange(_tables_lock)
         self.pending_config_change._next_key = key_base()
-        self.pending_snapshot = PendingSnapshot()
-        self.pending_leader_transfer = PendingLeaderTransfer()
+        self.pending_snapshot = PendingSnapshot(_tables_lock)
+        self.pending_leader_transfer = PendingLeaderTransfer(_tables_lock)
         # ctx/quorum table for DEVICE-resident reads (ops/engine.py): the
         # kernel serves the protocol (gate + ctx heartbeats); the host
         # tracks which voters echoed each ctx.  Scalar-path reads use
@@ -154,6 +187,12 @@ class Node:
         self.tick_count = 0
         self.leader_id = 0
         self.stopped = False
+        # stopping = shutdown announced but SM not yet closed: the node
+        # must stop PARTICIPATING (elections, device routing) immediately
+        # even though apply workers may still be draining (NodeHost.close
+        # sets it on every node before unregistering; a half-closed
+        # cluster otherwise keeps electing rows whose hosts are gone)
+        self.stopping = False
         self._snapshotting = False
         self._applied_since_snapshot = 0
         # superseded snapshot files are kept for one extra generation: an
@@ -171,6 +210,7 @@ class Node:
         self._sm_close_lock = threading.Lock()
         # set by the engine at registration; wakes the owning step worker
         self.notify_work: Optional[Callable[[], None]] = None
+        self.engine_apply_ready: Optional[Callable[[int], None]] = None
 
         # --- storage views ----------------------------------------------
         bootstrap = logdb.get_bootstrap_info(config.shard_id, config.replica_id)
@@ -242,9 +282,13 @@ class Node:
             # with no wall time for responses between them — combined
             # with the per-step cap in step_with_inputs this bounds the
             # quorum check to at most once per drained backlog.  Dropped
-            # ticks only slow the logical clock, which is liveness-safe.
+            # ticks slow only the RAFT clock (liveness-safe); they still
+            # count toward the logical clock via gc_ticks so pending-
+            # future deadlines don't stretch in wall time during stalls.
             if self._pending_ticks < self.config.election_rtt:
                 self._pending_ticks += 1
+            else:
+                self._gc_only_ticks += 1
 
     def propose(
         self, session: Session, cmd: bytes, timeout_ticks: int
@@ -343,24 +387,35 @@ class Node:
         split out so a vectorized step engine can route drained inputs to
         the device or replay them on the scalar peer — ops/engine.py)."""
         with self._qlock:
+            # swap, don't copy: non-empty queue lists hand over
+            # wholesale and fresh empties replace them; empty inputs
+            # stay the shared () from StepInputs.__init__
             si = StepInputs(
-                received=list(self._received),
-                proposals=list(self._proposals),
-                read_indexes=list(self._read_indexes),
-                config_changes=list(self._config_changes),
-                cc_results=list(self._cc_to_apply),
-                transfers=list(self._leader_transfers),
-                snapshot_reqs=list(self._snapshot_reqs),
-                ticks=self._pending_ticks,
+                ticks=self._pending_ticks, gc_ticks=self._gc_only_ticks
             )
-            self._received.clear()
-            self._proposals.clear()
-            self._read_indexes.clear()
-            self._config_changes.clear()
-            self._cc_to_apply.clear()
-            self._leader_transfers.clear()
-            self._snapshot_reqs.clear()
+            if self._received:
+                si.received = self._received
+                self._received = []
+            if self._proposals:
+                si.proposals = self._proposals
+                self._proposals = []
+            if self._read_indexes:
+                si.read_indexes = self._read_indexes
+                self._read_indexes = []
+            if self._config_changes:
+                si.config_changes = self._config_changes
+                self._config_changes = []
+            if self._cc_to_apply:
+                si.cc_results = self._cc_to_apply
+                self._cc_to_apply = []
+            if self._leader_transfers:
+                si.transfers = self._leader_transfers
+                self._leader_transfers = []
+            if self._snapshot_reqs:
+                si.snapshot_reqs = self._snapshot_reqs
+                self._snapshot_reqs = []
             self._pending_ticks = 0
+            self._gc_only_ticks = 0
         return si
 
     def step(self) -> Optional[Update]:
@@ -442,6 +497,15 @@ class Node:
             else:
                 self.peer.tick()
             # tick-driven GC of timed-out futures
+            self.pending_proposal.gc(self.tick_count)
+            self.pending_read_index.gc(self.tick_count)
+            self.pending_config_change.gc(self.tick_count)
+            self.pending_snapshot.gc(self.tick_count)
+            self.pending_leader_transfer.gc(self.tick_count)
+        if si.gc_ticks:
+            # backlog-dropped ticks: clock + deadline GC only (deadlines
+            # are monotone, so one pass at the final count is exact)
+            self.tick_count += si.gc_ticks
             self.pending_proposal.gc(self.tick_count)
             self.pending_read_index.gc(self.tick_count)
             self.pending_config_change.gc(self.tick_count)
@@ -865,6 +929,7 @@ class Node:
         return self.sm.lookup(query)
 
     def stop(self) -> None:
+        self.stopping = True
         self.stopped = True
         self._stop_event.set()
         self.pending_proposal.drop_all()

@@ -198,6 +198,7 @@ class NodeHost:
             )
             self.engine.start()
 
+            self._ticks_paused = False
             self._ticker_stop = threading.Event()
             self._ticker = threading.Thread(
                 target=self._ticker_main, daemon=True, name="tpu-raft-ticker"
@@ -229,8 +230,14 @@ class NodeHost:
         with self._nodes_lock:
             nodes = list(self._nodes.values())
             self._nodes.clear()
+        # announce shutdown BEFORE unregistering: step engines must stop
+        # letting these replicas participate (win elections, route
+        # appends) while the teardown drains — in colocated mode a
+        # still-participating row of a closing host strands routed
+        # payloads and fail-stops healthy peers
         for n in nodes:
-            self.engine.unregister(n.shard_id)
+            n.stopping = True
+        self.engine.unregister_many([n.shard_id for n in nodes])
         # join worker threads before closing the user SMs: an apply worker
         # may still be inside sm.handle
         self.engine.stop()
@@ -248,11 +255,30 @@ class NodeHost:
     def _ticker_main(self) -> None:
         period = self.config.rtt_millisecond / 1000.0
         while not self._ticker_stop.wait(period):
+            if self._ticks_paused:
+                continue
             with self._nodes_lock:
                 nodes = list(self._nodes.values())
             for n in nodes:
                 n.add_tick()
             self.engine.notify_many([n.shard_id for n in nodes])
+
+    def pause_ticks(self) -> None:
+        """Suspend the logical clock (mass-start tooling).
+
+        Starting tens of thousands of replicas takes wall-clock time
+        during which already-started shards would otherwise hit their
+        election timeouts and launch full engine step generations,
+        starving the start loop of CPU (the r03 10k-shard run spent 13
+        minutes in start_replica for this reason).  Pausing ticks while
+        loading keeps registration-driven steps (which are cheap) and
+        freezes election clocks; ``resume_ticks`` lets every shard's
+        randomized timeout start from the same instant.  No reference
+        equivalent — Go hosts start replicas in microseconds [U]."""
+        self._ticks_paused = True
+
+    def resume_ticks(self) -> None:
+        self._ticks_paused = False
 
     # ------------------------------------------------------------------
     # shard lifecycle

@@ -42,6 +42,7 @@ messages are dispatched (the reference's save -> send -> apply order).
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 import threading
 from collections import OrderedDict
@@ -59,6 +60,7 @@ from . import kernel as K
 from . import sync as S
 from .engine import (
     VectorStepEngine,
+    _shift_msg_indexes,
     _R_APPEND_LO,
     _R_BARRIER_IDX,
     _R_BARRIER_TERM,
@@ -124,7 +126,7 @@ class ColocatedVectorEngine(VectorStepEngine):
 
     def __init__(self, *, budget: int = 2, capacity: int = 64, P: int = 5,
                  W: int = 32, M: int = 8, E: int = 4, O: int = 32,
-                 device=None, mesh=None):
+                 rebase_chunk: int = 1 << 30, device=None, mesh=None):
         self.budget = budget
         self._pending: Optional[Inbox] = None
         self._pending_live = False  # last route delivered > 0 messages
@@ -139,19 +141,25 @@ class ColocatedVectorEngine(VectorStepEngine):
         # entry still routable (ring_ok) is still reconstructible
         self._entry_cache: Dict[int, "OrderedDict[Tuple[int, int], Entry]"] = {}
         self._cache_depth = 8 * W
+        # per-SHARD shared index base (the colocated 64-bit story):
+        # routed messages carry raw int32 index lanes between rows, so a
+        # per-row base would desynchronize them — instead every resident
+        # row of a shard shares one W-aligned base, advanced by whole-
+        # shard rebases (see _maybe_rebase_shards).  rebase_chunk is how
+        # far committed may outrun the base before a rebase (tests
+        # shrink it to exercise multi-rebase traffic at ordinary scale).
+        self._shard_base: Dict[int, int] = {}
+        self._rebase_chunk = rebase_chunk
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         self.stats.update(
             launches=0, routed_delivered=0, routed_host_carried=0,
-            routed_dropped=0,
+            routed_dropped=0, coalesced_rows=0, shard_rebases=0,
         )
 
     def _compute_base(self, r) -> int:
-        # routed messages carry raw int32 index lanes BETWEEN rows, which
-        # is only sound when every row of a shard shares one base; the
-        # colocated engine keeps base 0 and retains the absolute-int32
-        # ceiling (documented in _plan_device / PARITY.md)
-        return 0
+        # the SHARD's shared base, not a per-row quantity — see __init__
+        return self._shard_base.get(r.shard_id, 0)
 
     # -- row identity ---------------------------------------------------
     def _row_key(self, node):
@@ -193,6 +201,10 @@ class ColocatedVectorEngine(VectorStepEngine):
             s == shard_id for s, _ in self._row_of
         ):
             self._entry_cache.pop(shard_id, None)
+            # base resets with the last replica; a returning shard with
+            # a large log re-establishes it via _maybe_rebase_shards
+            # before any row can pass the planner's lane bounds
+            self._shard_base.pop(shard_id, None)
 
     def _halt_replica(self, g: int) -> None:
         node = self._meta[g].node
@@ -200,12 +212,19 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._release_row(g, node.shard_id)
 
     def detach_replica(self, shard_id: int, replica_id: int) -> None:
+        self.detach_replicas([(shard_id, replica_id)])
+
+    def detach_replicas(self, pairs) -> None:
+        """Batch detach under ONE core-lock acquisition (NodeHost.close
+        releases every row of a member at once; per-row locking would
+        interleave thousands of acquisitions with live launches)."""
         with self._lock:
-            g = self._row_of.pop((shard_id, replica_id), None)
-            if g is not None:
-                self._meta.pop(g, None)
-                self._free.append(g)
-                self._release_row(g, shard_id)
+            for shard_id, replica_id in pairs:
+                g = self._row_of.pop((shard_id, replica_id), None)
+                if g is not None:
+                    self._meta.pop(g, None)
+                    self._free.append(g)
+                    self._release_row(g, shard_id)
 
     def _upload_rows(self, rows) -> None:
         super()._upload_rows(rows)
@@ -296,26 +315,13 @@ class ColocatedVectorEngine(VectorStepEngine):
         sub = jax.tree.map(np.asarray, _gather_rows(self._pending, idx))
         for k, (node, g) in enumerate(pairs):
             r = node.peer.raft
+            base = int(self._base[g])  # routed lanes are shard-rebased
             for s in range(sub.mtype.shape[1]):
                 mt = int(sub.mtype[k, s])
                 if mt == 0:
                     continue
                 n = int(sub.n_entries[k, s])
-                li = int(sub.log_index[k, s])
-                ents = []
-                ok = True
-                if mt == MT_REPLICATE and n > 0:
-                    for j in range(n):
-                        e = self._cache_lookup(
-                            r, li + 1 + j, int(sub.ent_term[k, s, j])
-                        )
-                        if e is None:
-                            ok = False
-                            break
-                        ents.append(e)
-                if not ok:
-                    continue
-                node.enqueue_received(
+                msg = _shift_msg_indexes(
                     Message(
                         type=MessageType(mt),
                         to=node.replica_id,
@@ -323,26 +329,153 @@ class ColocatedVectorEngine(VectorStepEngine):
                         shard_id=node.shard_id,
                         term=int(sub.term[k, s]),
                         log_term=int(sub.log_term[k, s]),
-                        log_index=li,
+                        log_index=int(sub.log_index[k, s]),
                         commit=int(sub.commit[k, s]),
                         reject=bool(sub.reject[k, s]),
                         hint=int(sub.hint[k, s]),
                         hint_high=int(sub.hint_high[k, s]),
-                        entries=tuple(ents),
-                    )
+                    ),
+                    base,
                 )
+                ents = []
+                ok = True
+                if mt == MT_REPLICATE and n > 0:
+                    for j in range(n):
+                        e = self._cache_lookup(
+                            r,
+                            msg.log_index + 1 + j,
+                            int(sub.ent_term[k, s, j]),
+                        )
+                        if e is None:
+                            ok = False
+                            break
+                        ents.append(e)
+                if not ok:
+                    continue
+                if ents:
+                    msg = dataclasses.replace(msg, entries=tuple(ents))
+                node.enqueue_received(msg)
 
     # -- the colocated step --------------------------------------------
     def step_shards(self, nodes, worker_id: int) -> None:
+        if all(n.stopped or n.stopping for n in nodes):
+            # teardown fast path: don't contend for the core lock (the
+            # owning worker may be asked to stop while we'd be queued
+            # behind another member's multi-second launch)
+            return
         with self._lock:
             self._step_colocated(nodes, worker_id)
+
+    def _coalesce(self, nodes) -> List:
+        """Pull every other attached node with queued work into this
+        launch: a full-width kernel step costs the same whether it
+        carries one member NodeHost's inputs or all of them, so one
+        launch serves the whole cluster's tick generation instead of
+        one launch per member (at 10k shards x 5 members that is the
+        difference between 1 and 5 multi-second launches per
+        generation).  Safe under the core lock: ALL colocated node
+        stepping happens inside it, so no other worker can be draining
+        these queues concurrently."""
+        seen = {id(n) for n in nodes}
+        out = list(nodes)
+        for meta in self._meta.values():
+            n = meta.node
+            if (
+                id(n) not in seen
+                and not n.stopped
+                and not n.stopping
+                and n.has_work()
+            ):
+                seen.add(id(n))
+                out.append(n)
+        coalesced = len(out) - len(nodes)
+        if coalesced:
+            self.stats["coalesced_rows"] += coalesced
+        return out
+
+    def _maybe_rebase_shards(self, nodes) -> None:
+        """Whole-shard group rebasing (the colocated 64-bit story).
+
+        When any row's committed outruns its shard's shared base by
+        ``rebase_chunk``, every RESIDENT row of that shard leaves the
+        device together — in-flight routed traffic drains to the host
+        queues first, so no rebased int32 lane survives the base change
+        — and the shard's base advances to the largest W-multiple safe
+        for ALL its rows (min across rows; leader rows bound it by
+        their laggiest peer lane).  Rows re-upload with the new base on
+        their next step.  Reference: uint64 log indexes throughout
+        raftpb [U]; this keeps the colocated device path unbounded
+        instead of aging shards off at 2^31 (r03 verdict #4)."""
+        need = set()
+        for node in nodes:
+            if node.stopped or node.stopping:
+                continue
+            r = node.peer.raft
+            if (
+                r.log.committed - self._shard_base.get(node.shard_id, 0)
+                >= self._rebase_chunk
+            ):
+                need.add(node.shard_id)
+        if not need:
+            return
+        # progress guard (review finding): compute the candidate base
+        # FIRST and only pay the drain/materialize round-trip when it
+        # actually advances.  The min is bounded by every known row of
+        # the shard — a freshly joined replica at committed 0 or a
+        # leader's laggy peer lane yields candidate <= current, which
+        # must NOT regress the base (healthy rows would blow the int32
+        # spread bound) nor thrash the shard off the device every step.
+        advancing = {}
+        for shard in need:
+            rafts = [
+                self._meta[g].node.peer.raft
+                for (s, _), g in self._row_of.items()
+                if s == shard and self._meta.get(g) is not None
+            ]
+            if not rafts:
+                continue
+            candidate = min(
+                VectorStepEngine._compute_base(self, r) for r in rafts
+            )
+            if candidate > self._shard_base.get(shard, 0):
+                advancing[shard] = candidate
+        if not advancing:
+            return
+        pairs = []
+        for (shard, _), g in self._row_of.items():
+            meta = self._meta.get(g)
+            if shard in advancing and meta is not None and not meta.dirty:
+                pairs.append((meta.node, g))
+        self._drain_pending_to_host(pairs)
+        self._materialize_rows([g for _, g in pairs])
+        for _, g in pairs:
+            meta = self._meta.get(g)
+            if meta is not None:
+                meta.dirty = True
+        for shard, base in advancing.items():
+            self._shard_base[shard] = base
+            self.stats["shard_rebases"] += 1
+
+    def _plan_device(self, node, si, mirror_leader: bool, g):
+        # a replica rejoining a shard whose base already advanced past
+        # its committed position cannot upload (its lanes would go
+        # negative): scalar path until host-wire catch-up reaches the
+        # base.  Rows known at rebase time can never be in this state —
+        # the candidate min() is bounded by them.
+        if node.peer.raft.log.committed < self._shard_base.get(
+            node.shard_id, 0
+        ):
+            return None
+        return super()._plan_device(node, si, mirror_leader, g)
 
     def _step_colocated(self, nodes, worker_id: int) -> None:
         updates: List[Tuple] = []
         host_rows: List[Tuple] = []
         batch: List[Tuple] = []
+        nodes = self._coalesce(nodes)
+        self._maybe_rebase_shards(nodes)
         for node in nodes:
-            if node.stopped:
+            if node.stopped or node.stopping:
                 continue
             si = node.drain_step_inputs()
             if self._static_host_only(node):
@@ -361,7 +494,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 host_rows.append((node, si))
                 continue
             if not plan and not self._meta[g].dirty:
-                _tick_bookkeeping(node, si.ticks)
+                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
                 continue
             batch.append((node, g, si, plan))
 
@@ -401,7 +534,17 @@ class ColocatedVectorEngine(VectorStepEngine):
                     if self._meta[g].dirty
                 ]
             )
-            updates.extend(self._device_step_colocated(batch))
+            if self._pending_live or any(plan for _, _, _, plan in batch):
+                updates.extend(self._device_step_colocated(batch))
+            else:
+                # pure preload: rows uploaded, nothing to step and no
+                # routed traffic in flight — skip the full-width launch
+                # (mass start streams thousands of such registrations).
+                # Clock bookkeeping matches what the launch path's live
+                # loop would have done for these rows: si.ticks still
+                # counts quiesce-swallowed ticks, gc_ticks the dropped.
+                for node, g, si, plan in batch:
+                    _tick_bookkeeping(node, si.ticks + si.gc_ticks)
 
         if updates:
             by_db: Dict[int, Tuple] = {}
@@ -424,7 +567,14 @@ class ColocatedVectorEngine(VectorStepEngine):
             self._rebuild_tables()
         alive_np = np.zeros((G,), bool)
         for g, meta in self._meta.items():
-            alive_np[g] = not meta.dirty
+            # a stopping member's rows must neither consume routed
+            # traffic nor be routable targets: a stopped-but-undetached
+            # leader would keep winning device elections while its host
+            # no longer publishes payloads to the entry cache — healthy
+            # peers then fail-stop on unreconstructible appends
+            alive_np[g] = not meta.dirty and not (
+                meta.node.stopped or meta.node.stopping
+            )
         alive = self._put_rows(jnp.asarray(alive_np))
 
         old_state = self._state
@@ -536,20 +686,24 @@ class ColocatedVectorEngine(VectorStepEngine):
 
         from .engine import SLOT_DROPPED
 
-        snapshot_sends: List[Tuple[int, int, int]] = []
+        # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
+        snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
         for node, g, si in live:
-            if node.stopped or self._meta.get(g) is None:
+            if node.stopped or node.stopping or self._meta.get(g) is None:
                 continue
             r = node.peer.raft
+            base = int(self._base[g])  # the shard's shared base
             term, vote, committed, leader, role, last = (
                 int(summary[i, g]) for i in range(6)
             )
+            committed += base
+            last += base
             changed = (
                 summary[:6, g] != self._mirror[:6, g]
             ).any() or summary[_R_COUNT, g] > 0
             appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
             if si is not None:
-                _tick_bookkeeping(node, si.ticks)
+                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
             if not (
                 changed or appended or summary[_R_NEED_SS, g] or g in slot_at
             ):
@@ -563,14 +717,15 @@ class ColocatedVectorEngine(VectorStepEngine):
             if appended:
                 try:
                     stamped = self._merge_appends(
-                        r, g, int(summary[_R_APPEND_LO, g]), last,
+                        r, g, int(summary[_R_APPEND_LO, g]) + base, last,
                         staging.get(g, {}), slot_at, slot_base, slot_term,
                         ent_drop, ring_t[ring_at[g]], ring_c[ring_at[g]],
                         fallback=self._cache_lookup,
                         barrier=(
-                            int(summary[_R_BARRIER_IDX, g]),
+                            int(summary[_R_BARRIER_IDX, g]) + base,
                             int(summary[_R_BARRIER_TERM, g]),
                         ),
+                        base=base,
                     )
                 except RuntimeError:
                     # fail-stop THIS replica only (divergence policy);
@@ -598,6 +753,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self._attach_messages(
                     r, node, buf_np[buf_at[g]], int(summary[_R_COUNT, g]),
                     staging.get(g, {}), delivered_row=delivered[g],
+                    base=base,
                 )
             if g in slot_at:
                 sb = slot_base[slot_at[g]]
@@ -618,11 +774,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             self._mirror[:6, g] = summary[:6, g]
             node._check_leader_change()
 
-        # colocated base is pinned 0 so below-base (None) lanes cannot
-        # occur; filter defensively anyway — feeding None to _pad_idx
-        # would crash the step worker if that invariant ever changed
         lanes = [t for t in snapshot_sends if t[2] is not None]
-        assert len(lanes) == len(snapshot_sends), "colocated base must be 0"
         if lanes:
             self._state = _set_remote_snapshot(
                 self._state,
@@ -630,6 +782,31 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self._put(jnp.asarray(_pad_idx([t[1] for t in lanes]))),
                 self._put(jnp.asarray(_pad_idx([t[2] for t in lanes]))),
             )
+        below = [t for t in snapshot_sends if t[2] is None]
+        if below:
+            # the durable snapshot sits below the shard base (see
+            # VectorStepEngine._send_snapshots): these rows take a host
+            # excursion until the install resolves; drain their routed
+            # traffic first so the transition loses no messages
+            gs = sorted(
+                {t[0] for t in below if self._meta.get(t[0]) is not None}
+            )
+            pairs = [
+                (self._meta[g].node, g)
+                for g in gs
+                if not self._meta[g].dirty
+            ]
+            self._drain_pending_to_host(pairs)
+            for g in gs:
+                self._meta[g].dirty = True
+            self._materialize_rows(gs)
+            for g, p, _, pid, ss_index in below:
+                meta = self._meta.get(g)
+                if meta is None or meta.node.stopped:
+                    continue
+                rm = meta.node.peer.raft.get_remote(pid)
+                if rm is not None:
+                    rm.become_snapshot(ss_index)
 
         if self._pending_live:
             # in-flight routed traffic: wake every resident node's engine
@@ -662,6 +839,15 @@ class _ColocatedFacade(IStepEngine):
         rid = self._replica_of.pop(shard_id, None)
         if rid is not None:
             self.core.detach_replica(shard_id, rid)
+
+    def detach_many(self, shard_ids) -> None:
+        pairs = []
+        for s in shard_ids:
+            rid = self._replica_of.pop(s, None)
+            if rid is not None:
+                pairs.append((s, rid))
+        if pairs:
+            self.core.detach_replicas(pairs)
 
 
 class ColocatedEngineGroup:

@@ -336,18 +336,26 @@ class VectorStepEngine(IStepEngine):
         side may have just cleared the flags), and an immediate quorum
         check against an empty window steps a healthy leader down.
 
+        The grace DELAYS the next check by restarting the activity
+        window (election_tick = 0) instead of fabricating activity: the
+        old mark-all-remotes-active form satisfied every check for a
+        leader crossing the boundary about once per window — the same
+        cadence as the check itself — so a minority-partitioned leader
+        could evade stepdown indefinitely (advisor finding).  With the
+        reset, passing the delayed check still requires GENUINE
+        responses during the fresh window.
+
         Rate-limited to once per election window (tracked on the raft's
-        logical clock): without the limit, a leader oscillating between
-        residencies faster than the window would never accumulate a full
-        inactivity window and a minority-partitioned leader could evade
-        stepdown indefinitely."""
+        logical clock) so an oscillating leader cannot push the check
+        out forever; worst case a partitioned leader steps down within
+        ~2-3 windows instead of the reference's ~1 (`raft.go
+        checkQuorumActive [U]`)."""
         now = r.tick_count
         last = getattr(r, "_cq_grace_at", None)
         if last is not None and now - last < r.election_timeout:
             return
         r._cq_grace_at = now
-        for rm in r.remotes.values():
-            rm.active = True
+        r.election_tick = 0
 
     def _warm(self) -> None:
         """Pre-compile the kernel and every per-bucket helper shape so the
@@ -771,7 +779,7 @@ class VectorStepEngine(IStepEngine):
                     # nothing for the device, but the logical clock still
                     # advanced: a quiesced row's swallowed ticks must GC
                     # pending futures exactly like the scalar loop does
-                    _tick_bookkeeping(node, si.ticks)
+                    _tick_bookkeeping(node, si.ticks + si.gc_ticks)
                     continue
                 batch.append((node, g, si, plan))
 
@@ -965,7 +973,8 @@ class VectorStepEngine(IStepEngine):
         need_at = {g: k for k, g in enumerate(need_rows)}
 
         # ---- per-row update construction -----------------------------
-        snapshot_sends: List[Tuple[int, int, int]] = []  # (g, p, ss_index)
+        # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
+        snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
         for node, g, si in live:
             r = node.peer.raft
             base = int(self._base[g])
@@ -979,7 +988,7 @@ class VectorStepEngine(IStepEngine):
             ).any() or summary[_R_COUNT, g] > 0
             appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
             # tick bookkeeping (mirrors Node.step_with_inputs)
-            _tick_bookkeeping(node, si.ticks)
+            _tick_bookkeeping(node, si.ticks + si.gc_ticks)
             if not (
                 changed
                 or appended
@@ -1235,8 +1244,11 @@ class VectorStepEngine(IStepEngine):
         r: Raft,
         g: int,
         need_row: np.ndarray,
-        snapshot_sends: List[Tuple[int, int, int]],
+        snapshot_sends: List[Tuple[int, int, Optional[int], int, int]],
     ) -> None:
+        # snapshot_sends entries are (g, p, lane, pid, ss_index); lane is
+        # None when the durable snapshot sits below the row's base (the
+        # host-excursion path)
         peer_ids = np.asarray(self._state.peer_id[g])  # small row fetch
         ss = r.log.logdb.snapshot()
         for p in range(self.P):

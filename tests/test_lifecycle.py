@@ -124,3 +124,62 @@ class TestProfiling:
                 jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
         files = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
         assert files, f"no xplane trace written under {tmp_path}"
+
+    def test_colocated_cluster_close_leaks_no_threads(self):
+        """r03 regression: a member's step worker blocked on the shared
+        colocated core lock (behind another member's launch) outlived
+        Stopper.stop and leaked.  Closing a working colocated cluster
+        must join every engine/ticker thread."""
+        from dragonboat_tpu import (
+            EngineConfig,
+            ExpertConfig,
+            NodeHost,
+            NodeHostConfig,
+        )
+        from dragonboat_tpu.ops.colocated import ColocatedEngineGroup
+        from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+        from test_nodehost import KVStore, propose_r, set_cmd, \
+            wait_for_leader
+        from test_vector_engine import vec_shard_config
+
+        reset_inproc_network()
+        addrs = {1: "cleak-1", 2: "cleak-2", 3: "cleak-3"}
+        group = ColocatedEngineGroup(
+            capacity=16, P=5, W=32, M=8, E=4, O=32, budget=2
+        )
+        nhs = {}
+        for rid, addr in addrs.items():
+            shutil.rmtree(f"/tmp/nh-cleak-{rid}", ignore_errors=True)
+            nhs[rid] = NodeHost(
+                NodeHostConfig(
+                    nodehost_dir=f"/tmp/nh-cleak-{rid}",
+                    rtt_millisecond=5,
+                    raft_address=addr,
+                    expert=ExpertConfig(
+                        engine=EngineConfig(exec_shards=1, apply_shards=2),
+                        step_engine_factory=group.factory,
+                    ),
+                )
+            )
+        for rid, nh in nhs.items():
+            nh.start_replica(addrs, False, KVStore, vec_shard_config(rid))
+        wait_for_leader(nhs)
+        s = nhs[1].get_noop_session(1)
+        propose_r(nhs[1], s, set_cmd("k", b"v"))
+        # close all members while the cluster is live (no quiesce: the
+        # tick stream keeps launches in flight through the teardown)
+        for nh in nhs.values():
+            nh.close()
+        deadline = time.time() + 10.0
+        while True:
+            leaked = [
+                t.name
+                for t in threading.enumerate()
+                if t.name.startswith("tpu-raft-") and t.is_alive()
+            ]
+            if not leaked:
+                return
+            if time.time() > deadline:
+                raise AssertionError(f"threads leaked after close: {leaked}")
+            time.sleep(0.2)

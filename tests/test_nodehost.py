@@ -118,6 +118,35 @@ def propose_r(nh, session, cmd, deadline=10.0):
             time.sleep(0.02)
 
 
+def add_non_voting_poll(nh, shard_id, replica_id, addr, deadline=60.0):
+    """Membership change with GOAL-STATE polling (de-flake discipline).
+
+    An attempt's future can time out under load while its config-change
+    entry still commits; the next attempt is then REJECTED (stale
+    config-change id / member already present), so retry loops keyed on
+    per-attempt acks spin until their wall deadline and flake.  Success
+    is the MEMBERSHIP containing the replica — poll that; the deadline
+    is only the global give-up, so CPU load stretches the wait, never
+    the verdict (reference: deterministic tick-driven membership tests
+    in raft_etcd_test.go [U])."""
+    end = time.time() + deadline
+    last = None
+    while True:
+        m = nh.get_shard_membership(shard_id)
+        if replica_id in m.non_votings:
+            return m
+        try:
+            nh.sync_request_add_non_voting(
+                shard_id, replica_id, addr, m.config_change_id, timeout=2.0
+            )
+        except Exception as e:  # noqa: BLE001 — poll state, then retry
+            last = e
+        if time.time() > end:
+            raise AssertionError(
+                f"membership never added {replica_id}: last error {last!r}"
+            )
+
+
 def wait_for_leader(nhs, shard_id=1, timeout=5.0):
     """Wait until every nodehost knows the (same) leader for the shard."""
     deadline = time.time() + timeout

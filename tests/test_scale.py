@@ -43,14 +43,18 @@ from dragonboat_tpu import (
     NodeHostConfig,
     Result,
 )
+from dragonboat_tpu.ops.colocated import ColocatedEngineGroup
 from dragonboat_tpu.ops.engine import vector_step_engine_factory
 from dragonboat_tpu.transport.inproc import reset_inproc_network
 
 SHARDS = int(os.environ.get("SCALE_SHARDS", "0"))
+# "colocated" (default): ONE shared device state for all five member
+# NodeHosts with on-device message routing — the product configuration
+# built for exactly this geometry (r03 ran the plain per-host engine
+# here and stalled: 81.5% coverage, 0/100 commits at 10k shards).
+# "vector": the per-host engine + host transport, kept for comparison.
+ENGINE = os.environ.get("SCALE_ENGINE", "colocated")
 REPLICAS = 5
-pytestmark = pytest.mark.skipif(
-    SHARDS <= 0, reason="scale run is env-gated: set SCALE_SHARDS=N"
-)
 
 ADDRS = {r: f"scale-nh-{r}" for r in range(1, REPLICAS + 1)}
 
@@ -116,12 +120,33 @@ def _pow2_at_least(n: int) -> int:
     return b
 
 
-def run_scale(shards: int, artifact_path: str = "") -> dict:
+def run_scale(shards: int, artifact_path: str = "",
+              engine: str = ENGINE, proposals: int = 100) -> dict:
     rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-    capacity = _pow2_at_least(shards)
+    if engine == "colocated":
+        # every replica row of every member lives in ONE device state
+        capacity = _pow2_at_least(shards * REPLICAS)
+        # budget=4: a launch carries up to 8 deferred ticks = 4
+        # heartbeats per peer lane (heartbeat_rtt=2); budget 2 dropped
+        # half of them plus vote-storm resps (24% routed drops at 1k
+        # shards), so election timers never reset and campaigns looped
+        group = ColocatedEngineGroup(
+            capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=32, budget=4
+        )
+
+        def make_factory(rid):
+            return group.factory
+    else:
+        capacity = _pow2_at_least(shards)
+
+        def make_factory(rid):
+            return vector_step_engine_factory(
+                capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=16
+            )
     reset_inproc_network()
     shutil.rmtree("/tmp/scale-sm", ignore_errors=True)
-    report = {"shards": shards, "replicas": REPLICAS, "capacity": capacity}
+    report = {"shards": shards, "replicas": REPLICAS, "capacity": capacity,
+              "engine": engine}
 
     t0 = time.time()
     nhs = {}
@@ -137,16 +162,25 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
                 raft_address=addr,
                 expert=ExpertConfig(
                     engine=EngineConfig(exec_shards=1, apply_shards=4),
-                    step_engine_factory=vector_step_engine_factory(
-                        capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=16
-                    ),
+                    step_engine_factory=make_factory(rid),
                 ),
             )
         )
     report["boot_nodehosts_secs"] = round(time.time() - t0, 1)
+    # marginal-cost baseline: the jax runtime, compiled executables and
+    # the engine's fixed device buffers exist once per PROCESS, not per
+    # replica row — per-row cost measured from here answers "what does
+    # one more row cost", the quantity that bounds rows/host (the total
+    # delta from process start is reported alongside)
+    rss_boot = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 
     try:
         t0 = time.time()
+        # tick holiday while loading: already-started shards would
+        # otherwise hit election timeouts mid-load and launch full step
+        # generations, starving the start loop (r03: 783s of start)
+        for nh in nhs.values():
+            nh.pause_ticks()
         for shard in range(1, shards + 1):
             for rid, nh in nhs.items():
                 nh.start_replica(
@@ -154,17 +188,19 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
                     Config(replica_id=rid, shard_id=shard,
                            election_rtt=20, heartbeat_rtt=2,
                            pre_vote=True, check_quorum=True,
-                           snapshot_entries=0),
+                           quiesce=True, snapshot_entries=0),
                 )
             if shard % 500 == 0:
                 print(f"started {shard}/{shards} shards "
                       f"({round(time.time() - t0, 1)}s)", flush=True)
+        for nh in nhs.values():
+            nh.resume_ticks()
         report["start_replicas_secs"] = round(time.time() - t0, 1)
 
         # leader coverage = the become-leader barrier committed, i.e.
         # node.sm.last_applied >= 1 is NOT required, commit >= 1 is
         t0 = time.time()
-        deadline = time.time() + max(120.0, shards * 0.2)
+        deadline = time.time() + max(300.0, shards * 0.3)
         covered = 0
         while time.time() < deadline:
             covered = sum(
@@ -172,8 +208,14 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
                 for shard in range(1, shards + 1)
                 if nhs[1]._nodes[shard].peer.raft.log.committed >= 1
             )
+            st = (group.core.stats if engine == "colocated"
+                  else nhs[1].engine.step_engine.stats)
             print(f"leader coverage {covered}/{shards} "
-                  f"({round(time.time() - t0, 1)}s)", flush=True)
+                  f"({round(time.time() - t0, 1)}s) "
+                  f"launches={st.get('launches', st['device_steps'])} "
+                  f"esc={st['escalations']} host={st['host_rows_stepped']} "
+                  f"routed={st.get('routed_delivered', 0)}/"
+                  f"drop={st.get('routed_dropped', 0)}", flush=True)
             if covered == shards:
                 break
             time.sleep(2.0)
@@ -189,7 +231,7 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
 
         import collections
         t0 = time.time()
-        sample = list(range(1, shards + 1, max(1, shards // 100)))
+        sample = list(range(1, shards + 1, max(1, shards // proposals)))
         ok_lock = threading.Lock()
         ok = [0]
         errs = collections.Counter()
@@ -237,17 +279,28 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
         )
 
         stats = {}
-        for rid, nh in nhs.items():
-            for k, v in nh.engine.step_engine.stats.items():
-                stats[k] = stats.get(k, 0) + v
+        if engine == "colocated":
+            # every facade shares the ONE core's stats dict
+            stats.update(group.core.stats)
+        else:
+            for rid, nh in nhs.items():
+                for k, v in nh.engine.step_engine.stats.items():
+                    stats[k] = stats.get(k, 0) + v
         report["engine_stats"] = stats
         rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        report["rss_delta_mb"] = round((rss1 - rss0) / 1024.0, 1)
+        report["rss_total_delta_mb"] = round((rss1 - rss0) / 1024.0, 1)
+        report["rss_delta_mb"] = round((rss1 - rss_boot) / 1024.0, 1)
         report["host_kb_per_replica_row"] = round(
-            (rss1 - rss0) / float(shards * REPLICAS), 2
+            (rss1 - rss_boot) / float(shards * REPLICAS), 2
         )
     finally:
         t0 = time.time()
+        # freeze the logical clocks cluster-wide before the first member
+        # closes: serially-closing members otherwise shrink quorums and
+        # the survivors spend the whole teardown re-electing (the 189s
+        # shutdown in the 1k smoke)
+        for nh in nhs.values():
+            nh.pause_ticks()
         for nh in nhs.values():
             nh.close()
         report["shutdown_secs"] = round(time.time() - t0, 1)
@@ -258,10 +311,26 @@ def run_scale(shards: int, artifact_path: str = "") -> dict:
     return report
 
 
+@pytest.mark.skipif(
+    SHARDS <= 0, reason="big scale run is env-gated: set SCALE_SHARDS=N"
+)
 def test_scale_shards():
     report = run_scale(SHARDS, os.environ.get("SCALE_ARTIFACT", ""))
     print(json.dumps(report, indent=1))
     assert report["leader_coverage"] >= SHARDS * 0.98, report
+    assert report["proposals_committed"] >= report["proposals_attempted"] * 0.9, report
+    assert report["engine_stats"]["device_rows_stepped"] > 0, report
+
+
+def test_scale_small_always_on():
+    """The always-on scale guard: 500 shards x 5 replicas (2500 replica
+    rows) through the colocated engine must elect everywhere and commit
+    sampled client proposals — so the default suite carries a real scale
+    signal instead of an env-gated artifact (r03 review finding).  The
+    geometry is the 10k artifact's exactly, scaled to suite runtime."""
+    report = run_scale(500, "", engine="colocated", proposals=20)
+    print(json.dumps(report, indent=1))
+    assert report["final_leader_coverage"] >= 490, report
     assert report["proposals_committed"] >= report["proposals_attempted"] * 0.9, report
     assert report["engine_stats"]["device_rows_stepped"] > 0, report
 
