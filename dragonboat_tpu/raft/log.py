@@ -139,6 +139,7 @@ class InMemLogReader:
 
 
 class InMemory:
+    __slots__ = ("entries", "marker", "saved_to", "snapshot", "bytes")
     """The unpersisted/unapplied in-memory window of the log.
 
     reference: internal/raft/inmemory.go [U].  ``marker`` is the raft index
@@ -235,6 +236,7 @@ class InMemory:
 
 
 class EntryLog:
+    __slots__ = ("logdb", "inmem", "committed", "processed")
     """Unified log view with committed/processed cursors.
 
     reference: internal/raft/logentry.go (entryLog) [U].

@@ -32,6 +32,7 @@ class PeerInfo:
 
 
 class Peer:
+    __slots__ = ("raft", "prev_state")
     def __init__(self, raft: Raft):
         self.raft = raft
         self.prev_state: State = raft.raft_state()

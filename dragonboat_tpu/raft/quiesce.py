@@ -12,6 +12,7 @@ from ..pb import Message, MessageType
 
 
 class QuiesceManager:
+    __slots__ = ("enabled", "threshold", "idle_ticks", "quiesced", "exit_grace")
     def __init__(self, enabled: bool, election_timeout: int, threshold_mult: int = 10):
         self.enabled = enabled
         self.threshold = election_timeout * threshold_mult

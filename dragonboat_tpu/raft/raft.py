@@ -74,6 +74,24 @@ def election_jitter(shard_id: int, replica_id: int, seq: int, span: int) -> int:
 class Raft:
     """One raft replica's protocol state (reference: raft struct [U])."""
 
+    # __slots__: tens of thousands of replicas per host — the instance
+    # dict is pure overhead at that scale.  The last two slots are the
+    # vector engine's residency-boundary markers (ops/engine.py sets
+    # them with setattr; declared here so slots allow it).
+    __slots__ = (
+        "shard_id", "replica_id", "election_timeout", "heartbeat_timeout",
+        "check_quorum", "pre_vote", "max_entries_per_replicate",
+        "max_replicate_bytes", "max_in_mem_log_size", "term", "vote",
+        "leader_id", "log", "remotes", "non_votings", "witnesses",
+        "addresses", "role", "votes", "msgs", "ready_to_reads",
+        "dropped_entries", "dropped_read_indexes", "read_index",
+        "election_tick", "heartbeat_tick", "randomized_election_timeout",
+        "_timeout_seq", "leader_transfer_target", "pending_config_change",
+        "is_leader_transfer_target", "snapshotting", "tick_count",
+        "applied", "launched_non_voting", "launched_witness",
+        "_cq_grace_at", "_term_lim_warned",
+    )
+
     def __init__(
         self,
         shard_id: int,

@@ -22,6 +22,7 @@ class ReadStatus:
 
 
 class ReadIndex:
+    __slots__ = ("pending", "queue")
     def __init__(self):
         self.pending: Dict[Tuple[int, int], ReadStatus] = {}
         self.queue: List[Tuple[int, int]] = []

@@ -23,7 +23,7 @@ class RemoteState(enum.IntEnum):
     SNAPSHOT = 3
 
 
-@dataclass
+@dataclass(slots=True)
 class Remote:
     match: int = 0
     next: int = 1

@@ -82,8 +82,13 @@ class RequestState:
 
 
 class _PendingBase:
-    def __init__(self):
-        self._lock = threading.Lock()
+    __slots__ = ("_lock", "_next_key", "_pending")
+
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        # a node's five tables share one lock (pass it in): contention
+        # is per-replica and tiny, while 4 saved locks x 50k rows is
+        # real host footprint
+        self._lock = lock if lock is not None else threading.Lock()
         self._pending: Dict[int, RequestState] = {}
         self._next_key = 0
 
@@ -104,6 +109,12 @@ class _PendingBase:
             rs.notify(RequestResultCode.DROPPED)
 
     def gc(self, now_tick: int) -> None:
+        if not self._pending:
+            # lock-free empty check: the sweep runs five-tables deep per
+            # tick per replica row — at 50k rows that is millions of
+            # no-op lock acquisitions per second.  The race is benign: a
+            # request registered concurrently is swept next tick.
+            return
         with self._lock:
             expired = [
                 k for k, rs in self._pending.items() if rs.deadline <= now_tick
@@ -129,6 +140,7 @@ class _PendingBase:
 
 
 class PendingProposal(_PendingBase):
+    __slots__ = ()
     """reference: pendingProposal (sharded by key in the reference; a
     single dict suffices under the GIL) [U]."""
 
@@ -163,12 +175,13 @@ class PendingProposal(_PendingBase):
 
 
 class PendingReadIndex(_PendingBase):
+    __slots__ = ("_ctx_map", "_waiting")
     """reference: pendingReadIndex [U].  Two stages: (1) ctx confirmed by
     quorum -> learn the read index; (2) applied index reaches it ->
     complete."""
 
-    def __init__(self):
-        super().__init__()
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        super().__init__(lock)
         self._ctx_map: Dict[Tuple[int, int], int] = {}  # ctx -> key
         self._waiting: List[Tuple[int, int]] = []  # (read_index, key)
 
@@ -228,6 +241,7 @@ class PendingReadIndex(_PendingBase):
 
 
 class PendingConfigChange(_PendingBase):
+    __slots__ = ()
     def request(self, cc, deadline: int) -> Tuple[int, RequestState]:
         rs = self._alloc(deadline)
         return rs.key, rs
@@ -242,6 +256,7 @@ class PendingConfigChange(_PendingBase):
 
 
 class PendingSnapshot(_PendingBase):
+    __slots__ = ()
     def request(self, deadline: int) -> RequestState:
         return self._alloc(deadline)
 
@@ -256,6 +271,7 @@ class PendingSnapshot(_PendingBase):
 
 
 class PendingLeaderTransfer(_PendingBase):
+    __slots__ = ()
     def request(self, target: int, deadline: int) -> RequestState:
         return self._alloc(deadline)
 

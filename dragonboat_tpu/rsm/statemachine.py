@@ -11,9 +11,8 @@ from __future__ import annotations
 import enum
 import io
 import threading
-from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from ..client import (
     NOOP_SERIES_ID,
@@ -77,10 +76,16 @@ class Task:
 
 
 class TaskQueue:
-    """MPSC committed-task queue (reference: rsm.TaskQueue [U])."""
+    """MPSC committed-task queue (reference: rsm.TaskQueue [U]).
+
+    A plain list with swap-drain: producers only append, the single
+    consumer takes the whole list (an idle queue is one empty list, not
+    a ~750 B deque — this object exists once per replica row)."""
+
+    __slots__ = ("_q", "_lock")
 
     def __init__(self):
-        self._q: Deque[Task] = deque()
+        self._q: List[Task] = []
         self._lock = threading.Lock()
 
     def add(self, t: Task) -> None:
@@ -88,9 +93,11 @@ class TaskQueue:
             self._q.append(t)
 
     def get_all(self) -> List[Task]:
+        if not self._q:
+            return []
         with self._lock:
-            out = list(self._q)
-            self._q.clear()
+            out = self._q
+            self._q = []
             return out
 
     def __len__(self) -> int:
@@ -109,6 +116,12 @@ class ApplyResult:
 class StateMachine:
     """Per-replica managed SM + sessions + membership (reference:
     rsm.StateMachine [U])."""
+
+    __slots__ = (
+        "shard_id", "replica_id", "managed", "sessions", "members",
+        "task_queue", "last_applied", "applied_term",
+        "on_disk_init_index", "is_witness", "_mu",
+    )
 
     def __init__(
         self,
