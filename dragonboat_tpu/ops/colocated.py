@@ -84,12 +84,20 @@ _log = get_logger("engine")
 
 @jax.jit
 def _assemble_inbox(host: Inbox, pending: Inbox, alive: jnp.ndarray) -> Inbox:
-    """Concatenate host-encoded slots with the routed regions and zero
-    the rows that are not device-authoritative (dirty / detached): a
-    stale device row receiving traffic could double-vote."""
+    """Concatenate the ROUTED regions first, then the host-encoded
+    slots, zeroing rows that are not device-authoritative (dirty /
+    detached — a stale device row receiving traffic could double-vote).
 
-    def cat(a, b):
-        x = jnp.concatenate([a, b], axis=1)
+    Routed-first is the scalar replay order (received messages before
+    proposals/reads/ticks): routed traffic IS received messages, and
+    the host region ends with the fused tick slot.  With the old
+    host-first order a candidate's tick slot could re-fire its election
+    BEFORE counting the vote responses already sitting in its routed
+    region — with multi-tick fusion (+timeout//2 per launch) that
+    re-campaign loop stalled whole-cluster elections."""
+
+    def cat(h, p):
+        x = jnp.concatenate([p, h], axis=1)  # pending | host
         m = alive.reshape((-1,) + (1,) * (x.ndim - 1))
         return jnp.where(m, x, 0)
 
@@ -116,6 +124,11 @@ def _route_step(old_state, new_state, out, dest, rank, dest_alive,
         suppress=esc, dest_alive=dest_alive,
     )
     return merged, regions, jnp.stack(list(stats)), delivered
+
+
+@jax.jit
+def _zero_inbox_rows(inbox: Inbox, idx) -> Inbox:
+    return Inbox(*(getattr(inbox, f).at[idx].set(0) for f in Inbox._fields))
 
 
 class ColocatedVectorEngine(VectorStepEngine):
@@ -155,6 +168,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         self.stats.update(
             launches=0, routed_delivered=0, routed_host_carried=0,
             routed_dropped=0, coalesced_rows=0, shard_rebases=0,
+            # cumulative wall-time breakdown (ms) of the launch path —
+            # the single-core CPU backend hides where a 65k-row launch
+            # goes without it
+            t_coalesce_ms=0, t_plan_ms=0, t_upload_ms=0, t_device_ms=0,
+            t_detail_ms=0, t_updates_ms=0, t_persist_ms=0,
         )
 
     def _compute_base(self, r) -> int:
@@ -295,6 +313,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             sub = _gather_rows(st, idx)
             _scatter_rows(st, idx, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
+            _zero_inbox_rows(self._pending, idx)
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
@@ -355,6 +374,14 @@ class ColocatedVectorEngine(VectorStepEngine):
                 if ents:
                     msg = dataclasses.replace(msg, entries=tuple(ents))
                 node.enqueue_received(msg)
+        # drained => CLEARED: the pending copies are dead the moment
+        # they re-enter the host queues.  Without this, a shard rebase
+        # that re-uploads its rows in the SAME step re-delivers the
+        # stale copies with index lanes encoded against the OLD base
+        # (review finding: healthy replicas fail-stopped on the shifted
+        # replicates); the host-excursion path only survived it because
+        # drained rows stayed dirty through the next launch's alive mask.
+        self._pending = _zero_inbox_rows(self._pending, idx)
 
     # -- the colocated step --------------------------------------------
     def step_shards(self, nodes, worker_id: int) -> None:
@@ -469,11 +496,18 @@ class ColocatedVectorEngine(VectorStepEngine):
         return super()._plan_device(node, si, mirror_leader, g)
 
     def _step_colocated(self, nodes, worker_id: int) -> None:
+        import time as _time
+
         updates: List[Tuple] = []
         host_rows: List[Tuple] = []
         batch: List[Tuple] = []
+        _t0 = _time.perf_counter()
         nodes = self._coalesce(nodes)
         self._maybe_rebase_shards(nodes)
+        self.stats["t_coalesce_ms"] += int(
+            (_time.perf_counter() - _t0) * 1000
+        )
+        _t0 = _time.perf_counter()
         for node in nodes:
             if node.stopped or node.stopping:
                 continue
@@ -526,13 +560,18 @@ class ColocatedVectorEngine(VectorStepEngine):
             if u is not None:
                 updates.append((node, u))
 
+        self.stats["t_plan_ms"] += int((_time.perf_counter() - _t0) * 1000)
         if batch or self._pending_live:
+            _t0 = _time.perf_counter()
             self._upload_rows(
                 [
                     (g, node.peer.raft)
                     for node, g, si, plan in batch
                     if self._meta[g].dirty
                 ]
+            )
+            self.stats["t_upload_ms"] += int(
+                (_time.perf_counter() - _t0) * 1000
             )
             if self._pending_live or any(plan for _, _, _, plan in batch):
                 updates.extend(self._device_step_colocated(batch))
@@ -547,6 +586,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                     _tick_bookkeeping(node, si.ticks + si.gc_ticks)
 
         if updates:
+            _t0 = _time.perf_counter()
             by_db: Dict[int, Tuple] = {}
             for node, u in updates:
                 by_db.setdefault(id(node.logdb), (node.logdb, []))[1].append(u)
@@ -555,10 +595,17 @@ class ColocatedVectorEngine(VectorStepEngine):
             for node, u in updates:
                 if node.process_update(u):
                     node.engine_apply_ready(node.shard_id)
+            self.stats["t_persist_ms"] += int(
+                (_time.perf_counter() - _t0) * 1000
+            )
 
     def _device_step_colocated(self, batch) -> List[Tuple]:
         G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
-        msg_rows, staging, prop_rows = self._encode_batch(batch)
+        # staging keys in ASSEMBLED coordinates: the routed regions
+        # (width P*B) come first, host slots after (see _assemble_inbox)
+        msg_rows, staging, prop_rows = self._encode_batch(
+            batch, slot_offset=P * B
+        )
         host_inbox, overflow = S.encode_inbox(msg_rows, M, E)
         assert not overflow, f"planner let oversized rows through: {overflow}"
         host_inbox = self._put_rows(host_inbox)
@@ -578,8 +625,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         alive = self._put_rows(jnp.asarray(alive_np))
 
         old_state = self._state
+        import time as _time
+
         from ..profiling import annotate
 
+        _t0 = _time.perf_counter()
         with annotate("raft-colocated-step"):
             full = _assemble_inbox(host_inbox, self._pending, alive)
             new_state, out = K.step(old_state, full, out_capacity=self.O)
@@ -588,6 +638,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 alive, PB=P * B, E=E, budget=B,
             )
             summary = np.asarray(_summarize(new_state, out))
+        self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
         rstats = np.asarray(stats_dev)
         delivered = np.asarray(delivered_dev)
         self._pending = regions
@@ -669,6 +720,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 if rows:
                     idx4[row_i, : len(rows)] = rows
                     idx4[row_i, len(rows):] = rows[-1]
+            _t0 = _time.perf_counter()
             flat = np.asarray(
                 _gather_detail(new_state, out, self._put(jnp.asarray(idx4)))
             )
@@ -676,6 +728,9 @@ class ColocatedVectorEngine(VectorStepEngine):
             # regions), so the out slot arrays are M + P*B wide
             (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
              ring_c) = _split_detail(flat, self.O, M + P * B, E, P, self.W)
+            self.stats["t_detail_ms"] += int(
+                (_time.perf_counter() - _t0) * 1000
+            )
         else:
             buf_np = slot_base = slot_term = ent_drop = need_np = None
             ring_t = ring_c = None
@@ -686,6 +741,7 @@ class ColocatedVectorEngine(VectorStepEngine):
 
         from .engine import SLOT_DROPPED
 
+        _t0 = _time.perf_counter()
         # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
         snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
         for node, g, si in live:
@@ -773,6 +829,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             updates.append((node, u))
             self._mirror[:6, g] = summary[:6, g]
             node._check_leader_change()
+        self.stats["t_updates_ms"] += int((_time.perf_counter() - _t0) * 1000)
 
         lanes = [t for t in snapshot_sends if t[2] is not None]
         if lanes:

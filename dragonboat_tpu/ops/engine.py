@@ -211,14 +211,18 @@ def _shift_msg_indexes(msg: Message, delta: int) -> Message:
 
 def _tick_bookkeeping(node, ticks: int) -> None:
     """Advance the node's logical clock and GC timed-out futures — the
-    device path's mirror of the tick tail of ``Node.step_with_inputs``."""
-    for _ in range(ticks):
-        node.tick_count += 1
-        node.pending_proposal.gc(node.tick_count)
-        node.pending_read_index.gc(node.tick_count)
-        node.pending_config_change.gc(node.tick_count)
-        node.pending_snapshot.gc(node.tick_count)
-        node.pending_leader_transfer.gc(node.tick_count)
+    device path's mirror of the tick tail of ``Node.step_with_inputs``.
+    Deadlines are monotone, so ONE sweep at the final count is exact
+    (with multi-tick fusion ``ticks`` is now tens per step; a per-tick
+    sweep would be 5*n lock acquisitions per row per generation)."""
+    if not ticks:
+        return
+    node.tick_count += ticks
+    node.pending_proposal.gc(node.tick_count)
+    node.pending_read_index.gc(node.tick_count)
+    node.pending_config_change.gc(node.tick_count)
+    node.pending_snapshot.gc(node.tick_count)
+    node.pending_leader_transfer.gc(node.tick_count)
 
 
 class _RowMeta:
@@ -598,16 +602,23 @@ class VectorStepEngine(IStepEngine):
         # host fallback never double-processes ticks/activity
         if len(slots) > self.M:
             return None
-        # tick backpressure: ticks that don't fit this step's inbox are
-        # DEFERRED (the logical clock briefly lags wall clock) instead of
-        # bouncing the whole row to the scalar path — under load a slow
-        # launch accumulates more ticks than M slots, and falling back
-        # would thrash device residency every step (reference: dragonboat
-        # coalesces LocalTick bursts rather than dropping ready state [U])
-        avail = self.M - len(slots)
-        if si.ticks > avail:
-            node.defer_ticks(si.ticks - avail)
-            si.ticks = avail
+        # multi-tick fusion: ALL of a row's drained ticks ride one
+        # count-carrying LOCAL_TICK slot (kernel._tick advances timers
+        # by n).  The count cap mirrors the scalar step's half-election-
+        # window gulp limit — at most one timer threshold crossing per
+        # launch, so a stalled row can't replay several CheckQuorum/
+        # election windows back-to-back with no wall time for responses.
+        # Overflow ticks are DEFERRED (the logical clock briefly lags;
+        # reference: dragonboat coalesces LocalTick bursts [U]).
+        cap = max(1, r.election_timeout // 2)
+        if si.ticks > cap:
+            node.defer_ticks(si.ticks - cap)
+            si.ticks = cap
+        if si.ticks and len(slots) >= self.M:
+            # every slot taken by messages/proposals: defer the ticks
+            # rather than bouncing the row off the device
+            node.defer_ticks(si.ticks)
+            si.ticks = 0
         ticks = si.ticks
         if node.quiesce.enabled:
             # committed to the device path now: record (non-exiting)
@@ -626,7 +637,8 @@ class VectorStepEngine(IStepEngine):
                         node.broadcast_quiesce_enter()
                 else:
                     ticks += 1
-        slots.extend(("tick", None) for _ in range(ticks))
+        if ticks:
+            slots.append(("tick", ticks))
         return slots
 
     # ------------------------------------------------------------------
@@ -831,7 +843,7 @@ class VectorStepEngine(IStepEngine):
                 if node.process_update(u):
                     node.engine_apply_ready(node.shard_id)
 
-    def _encode_batch(self, batch):
+    def _encode_batch(self, batch, slot_offset: int = 0):
         """Plans -> (per-row Message lists, staging, proposal rows).
 
         Shared by the base and colocated device steps: slot order mirrors
@@ -839,7 +851,12 @@ class VectorStepEngine(IStepEngine):
         for the post-step append reconstruction; ``prop_rows`` marks rows
         whose slot_base detail must be gathered (local 'prop' slots AND
         wire PROPOSE messages — a forwarded proposal arriving at the
-        leader carries staged entries too)."""
+        leader carries staged entries too).
+
+        ``slot_offset`` shifts staging keys to ASSEMBLED slot indices:
+        the colocated engine prepends its routed regions (width P*B)
+        before the host slots, and the kernel reports slot_base/
+        ent_drop/src_slot in assembled coordinates."""
         msg_rows: List[List[Message]] = [[] for _ in range(self.capacity)]
         staging: Dict[int, Dict[int, List[Entry]]] = {}
         prop_rows: List[int] = []
@@ -847,7 +864,8 @@ class VectorStepEngine(IStepEngine):
             row_msgs = msg_rows[g]
             stage: Dict[int, List[Entry]] = {}
             base = int(self._base[g])
-            for slot, (kind, payload) in enumerate(plan):
+            for plan_slot, (kind, payload) in enumerate(plan):
+                slot = slot_offset + plan_slot
                 if kind == "msg":
                     if payload.entries:
                         stage[slot] = list(payload.entries)
@@ -869,12 +887,14 @@ class VectorStepEngine(IStepEngine):
                             hint_high=payload.high,
                         )
                     )
-                else:  # tick — carry the latest pending read ctx so lost
+                else:  # tick — log_index carries the fused count; hint
+                    # lanes carry the latest pending read ctx so lost
                     # confirmations retry on the heartbeat cadence
                     pc = node.device_reads.peek_ctx()
                     row_msgs.append(
                         Message(
                             type=MessageType.LOCAL_TICK,
+                            log_index=payload,
                             hint=pc.low if pc else 0,
                             hint_high=pc.high if pc else 0,
                         )

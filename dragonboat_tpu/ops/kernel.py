@@ -523,6 +523,11 @@ def _become_leader(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
     st = st._replace(role=_w(mask, ROLE_LEADER, st.role))
     st = _reset(st, mask, st.term)
     st = st._replace(leader_id=_w(mask, st.replica_id, st.leader_id))
+    # full activity window for a fresh leader (oracle + etcd-raft's
+    # RecentActive=true at becomeLeader): with fused ticks an election
+    # window can elapse in two launches — one ack round-trip — and the
+    # first CheckQuorum against empty lanes deposed every winner
+    st = st._replace(active=_wp(mask[:, None] & _valid(st), 1, st.active))
     any_cc, esc = _pending_cc_scan(st, mask)
     out = out._replace(escalate=out.escalate | jnp.where(esc, ESC_WINDOW, 0))
     st = st._replace(
@@ -630,12 +635,28 @@ def _check_quorum(st, mask) -> DeviceState:
 # ---------------------------------------------------------------------------
 # tick (oracle: Raft.tick)
 # ---------------------------------------------------------------------------
-def _tick(st, out, mask, E, hint=0, hint_high=0) -> Tuple[DeviceState, DeviceOut]:
+def _tick(
+    st, out, mask, E, hint=0, hint_high=0, n=None
+) -> Tuple[DeviceState, DeviceOut]:
+    """Advance the tick timers by ``n`` logical ticks in one slot
+    (multi-tick fusion).
+
+    ``n=1`` is bit-identical to the reference's per-tick stepping; the
+    fused form exists because one launch over all rows costs the same
+    whether a slot carries 1 tick or 10, and election timeouts are tens
+    of ticks.  Encoders cap ``n`` at election_timeout//2 (the same cap
+    the scalar step applies to drained tick batches), so at most ONE
+    timer threshold crossing happens per slot.  Heartbeats coalesce: k
+    firings within the fused span emit one broadcast — the reference
+    coalesces heartbeat bursts the same way [U], and a follower only
+    needs >=1 heartbeat per election window to hold its timer."""
+    if n is None:
+        n = jnp.ones((st.G,), I32)
     lead = mask & (st.role == ROLE_LEADER)
     non = mask & (st.role != ROLE_LEADER)
     # --- leader tick ---------------------------------------------------
-    el = st.election_tick + 1
-    hb = st.heartbeat_tick + 1
+    el = st.election_tick + n
+    hb = st.heartbeat_tick + n
     fired = el >= st.election_timeout
     st = st._replace(
         election_tick=_w(lead, jnp.where(fired, 0, el), st.election_tick),
@@ -651,7 +672,7 @@ def _tick(st, out, mask, E, hint=0, hint_high=0) -> Tuple[DeviceState, DeviceOut
     st = st._replace(heartbeat_tick=_w(hb_fire, 0, st.heartbeat_tick))
     out = _broadcast_heartbeat(st, out, hb_fire, hint, hint_high)
     # --- non-leader tick ----------------------------------------------
-    el2 = st.election_tick + 1
+    el2 = st.election_tick + n
     time_up = el2 >= st.rand_timeout
     nvw = (st.role == ROLE_NON_VOTING) | (st.role == ROLE_WITNESS)
     probe = non & nvw & (st.check_quorum == 1) & time_up
@@ -1188,9 +1209,11 @@ def _process_slot(st, out, msg, slot_i, E):
     )
     mask = mask & _is_hot(mt)
 
-    # LOCAL_TICK short-circuits the gate (oracle: handle)
+    # LOCAL_TICK short-circuits the gate (oracle: handle); log_index
+    # carries the fused tick count (0 on legacy single-tick slots)
     st, out = _tick(
-        st, out, mask & (mt == MT_TICK), E, msg["hint"], msg["hint_high"]
+        st, out, mask & (mt == MT_TICK), E, msg["hint"], msg["hint_high"],
+        n=jnp.maximum(msg["log_index"], 1),
     )
     rest = mask & (mt != MT_TICK)
     st, out, passed = _on_message_term(st, out, msg, rest)

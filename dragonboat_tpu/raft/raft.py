@@ -372,6 +372,14 @@ class Raft:
         self.role = RaftRole.LEADER
         self._reset(self.term)
         self.leader_id = self.replica_id
+        # a fresh leader starts with a FULL activity window (reference:
+        # etcd-raft sets RecentActive=true at becomeLeader): the first
+        # CheckQuorum otherwise races the first ack round-trip — under
+        # the fused-tick engine a whole election window can elapse in
+        # two launches, exactly one ack round-trip, and a hair-trigger
+        # first check deposed every new leader forever
+        for rm in self.all_remotes().values():
+            rm.set_active()
         self._compute_pending_config_change()
         # commit barrier: append an empty entry at the new term
         self._append_entries([Entry(type=EntryType.APPLICATION, cmd=b"")])

@@ -249,3 +249,86 @@ def test_read_index_before_term_commit_is_dropped():
         ctx.low == 5 and ctx.high == 6 for ctx in r.dropped_read_indexes
     ), r.dropped_read_indexes
     assert not r.read_index.has_pending()
+
+
+def test_fused_multi_tick_slot():
+    """Multi-tick fusion: one LOCAL_TICK slot whose log_index carries a
+    count advances timers by n — an election timeout fires in ONE slot,
+    and a leader's k elapsed heartbeat periods coalesce into ONE
+    broadcast (the launch-cost fix that makes 50k-row clusters viable
+    on slow backends, and fewer slots per launch everywhere)."""
+    import jax
+    import numpy as np
+
+    from dragonboat_tpu.ops import kernel as K
+    from dragonboat_tpu.ops.types import (
+        MT_HEARTBEAT,
+        MT_TICK,
+        ROLE_LEADER,
+        make_inbox,
+        make_state,
+    )
+
+    # row 0: single voter, election_timeout 10 + jitter < 10 — a count
+    # of 20 must elect it in one slot
+    G, P, W, M_, E_, O = 2, 3, 8, 2, 1, 16
+    peer_ids = np.zeros((G, P), np.int32)
+    peer_ids[0, 0] = 1
+    peer_ids[1, :3] = [1, 2, 3]
+    st = make_state(
+        G, P, W,
+        shard_ids=np.arange(1, G + 1),
+        replica_ids=np.ones(G),
+        peer_ids=peer_ids,
+        election_timeout=10,
+        heartbeat_timeout=2,
+    )
+    box = make_inbox(G, M_, E_)
+    box = box._replace(
+        mtype=box.mtype.at[:, 0].set(MT_TICK),
+        log_index=box.log_index.at[:, 0].set(20),
+    )
+    new, out = K.step(st, box, out_capacity=O)
+    jax.block_until_ready(new)
+    roles = np.asarray(new.role)
+    assert roles[0] == ROLE_LEADER, "fused ticks never fired the election"
+    # row 1 (3 voters) must have campaigned: vote traffic in the outbox
+    assert int(np.asarray(out.count)[1]) > 0
+
+    # leader heartbeat coalescing: 6 fused ticks at heartbeat_timeout=2
+    # = 3 periods -> exactly ONE heartbeat per peer
+    st2 = new._replace(heartbeat_tick=new.heartbeat_tick * 0)
+    box2 = make_inbox(G, M_, E_)
+    box2 = box2._replace(
+        mtype=box2.mtype.at[:, 0].set(MT_TICK),
+        log_index=box2.log_index.at[:, 0].set(6),
+    )
+    new2, out2 = K.step(st2, box2, out_capacity=O)
+    jax.block_until_ready(new2)
+    from dragonboat_tpu.ops.types import F_MTYPE
+
+    buf = np.asarray(out2.buf[0])
+    n_hb = sum(
+        1 for k in range(int(np.asarray(out2.count)[0]))
+        if buf[k][F_MTYPE] == MT_HEARTBEAT
+    )
+    # a single-voter leader has no peers: zero heartbeats
+    assert n_hb == 0
+
+    # 3-voter leader: force row 1 to leader, then 6 fused ticks at
+    # heartbeat_timeout=2 must emit exactly ONE heartbeat per peer
+    st3 = new._replace(
+        role=new.role.at[1].set(ROLE_LEADER),
+        leader_id=new.leader_id.at[1].set(1),
+        heartbeat_tick=new.heartbeat_tick * 0,
+        election_tick=new.election_tick * 0,
+    )
+    new3, out3 = K.step(st3, box2, out_capacity=O)
+    jax.block_until_ready(new3)
+    buf3 = np.asarray(out3.buf[1])
+    hb_targets = [
+        int(buf3[k][1])
+        for k in range(int(np.asarray(out3.count)[1]))
+        if buf3[k][F_MTYPE] == MT_HEARTBEAT
+    ]
+    assert sorted(hb_targets) == [2, 3], hb_targets

@@ -126,10 +126,11 @@ def run_scale(shards: int, artifact_path: str = "",
     if engine == "colocated":
         # every replica row of every member lives in ONE device state
         capacity = _pow2_at_least(shards * REPLICAS)
-        # budget=4: a launch carries up to 8 deferred ticks = 4
-        # heartbeats per peer lane (heartbeat_rtt=2); budget 2 dropped
-        # half of them plus vote-storm resps (24% routed drops at 1k
-        # shards), so election timers never reset and campaigns looped
+        # multi-tick fusion keeps a row's whole tick batch in ONE slot,
+        # so M=8 leaves seven slots for wire traffic (an M=6 squeeze
+        # starved mixed-residency vote storms onto the host path and
+        # collapsed coverage); budget=4 absorbs a lane's worst launch
+        # even before heartbeat coalescing kicks in
         group = ColocatedEngineGroup(
             capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=32, budget=4
         )
