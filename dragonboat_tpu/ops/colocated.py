@@ -163,6 +163,10 @@ class ColocatedVectorEngine(VectorStepEngine):
         # shrink it to exercise multi-rebase traffic at ordinary scale).
         self._shard_base: Dict[int, int] = {}
         self._rebase_chunk = rebase_chunk
+        # shard -> committed level below which rebase attempts are
+        # suppressed (set when an attempt finds no representable
+        # progress, e.g. a lagging peer lane pins the candidate min)
+        self._rebase_block: Dict[int, int] = {}
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         self.stats.update(
@@ -223,6 +227,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             # a large log re-establishes it via _maybe_rebase_shards
             # before any row can pass the planner's lane bounds
             self._shard_base.pop(shard_id, None)
+            self._rebase_block.pop(shard_id, None)
 
     def _halt_replica(self, g: int) -> None:
         node = self._meta[g].node
@@ -438,21 +443,34 @@ class ColocatedVectorEngine(VectorStepEngine):
             if node.stopped or node.stopping:
                 continue
             r = node.peer.raft
+            shard = node.shard_id
             if (
-                r.log.committed - self._shard_base.get(node.shard_id, 0)
+                r.log.committed - self._shard_base.get(shard, 0)
                 >= self._rebase_chunk
+                and r.log.committed >= self._rebase_block.get(shard, 0)
             ):
-                need.add(node.shard_id)
+                need.add(shard)
         if not need:
             return
-        # progress guard (review finding): compute the candidate base
-        # FIRST and only pay the drain/materialize round-trip when it
-        # actually advances.  The min is bounded by every known row of
-        # the shard — a freshly joined replica at committed 0 or a
-        # leader's laggy peer lane yields candidate <= current, which
-        # must NOT regress the base (healthy rows would blow the int32
-        # spread bound) nor thrash the shard off the device every step.
-        advancing = {}
+        # the trigger uses committed (device-synced every step); the
+        # CANDIDATE base needs fresh peer lanes, which only materialize
+        # refreshes — so pull the shard's rows off the device first,
+        # then decide.  If the candidate cannot advance (a lagging peer
+        # lane or a freshly joined replica pins the min), the base must
+        # neither regress nor be retried every step (review finding:
+        # drain/materialize thrash): back off until committed grows by
+        # another chunk.
+        pairs = []
+        for (shard, _), g in self._row_of.items():
+            meta = self._meta.get(g)
+            if shard in need and meta is not None and not meta.dirty:
+                pairs.append((meta.node, g))
+        self._drain_pending_to_host(pairs)
+        self._materialize_rows([g for _, g in pairs])
+        for _, g in pairs:
+            meta = self._meta.get(g)
+            if meta is not None:
+                meta.dirty = True
         for shard in need:
             rafts = [
                 self._meta[g].node.peer.raft
@@ -465,23 +483,13 @@ class ColocatedVectorEngine(VectorStepEngine):
                 VectorStepEngine._compute_base(self, r) for r in rafts
             )
             if candidate > self._shard_base.get(shard, 0):
-                advancing[shard] = candidate
-        if not advancing:
-            return
-        pairs = []
-        for (shard, _), g in self._row_of.items():
-            meta = self._meta.get(g)
-            if shard in advancing and meta is not None and not meta.dirty:
-                pairs.append((meta.node, g))
-        self._drain_pending_to_host(pairs)
-        self._materialize_rows([g for _, g in pairs])
-        for _, g in pairs:
-            meta = self._meta.get(g)
-            if meta is not None:
-                meta.dirty = True
-        for shard, base in advancing.items():
-            self._shard_base[shard] = base
-            self.stats["shard_rebases"] += 1
+                self._shard_base[shard] = candidate
+                self._rebase_block.pop(shard, None)
+                self.stats["shard_rebases"] += 1
+            else:
+                self._rebase_block[shard] = (
+                    max(r.log.committed for r in rafts) + self._rebase_chunk
+                )
 
     def _plan_device(self, node, si, mirror_leader: bool, g):
         # a replica rejoining a shard whose base already advanced past
