@@ -150,10 +150,16 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._dest_dev = None
         self._rank_dev = None
         # shard -> OrderedDict[(index, term) -> Entry]; bounded FIFO per
-        # shard, depth comfortably past the device ring lifetime so any
-        # entry still routable (ring_ok) is still reconstructible
+        # shard.  Depth must cover BOTH lifetimes an entry is needed
+        # for: the device ring window (8*W) and the stamp-to-consumption
+        # gap of a routed append — the receiver merges one launch after
+        # the sender stamped, and a proposal storm can stamp up to ~M*E
+        # entries per launch in between, evicting the referenced entry
+        # from a W-sized budget (chaos finding: rare fail-stops at
+        # W=8 under full-rate clients).  8*M*E covers several launches
+        # of worst-case append volume.
         self._entry_cache: Dict[int, "OrderedDict[Tuple[int, int], Entry]"] = {}
-        self._cache_depth = 8 * W
+        self._cache_depth = max(8 * W, 8 * M * E)
         # per-SHARD shared index base (the colocated 64-bit story):
         # routed messages carry raw int32 index lanes between rows, so a
         # per-row base would desynchronize them — instead every resident
@@ -167,6 +173,13 @@ class ColocatedVectorEngine(VectorStepEngine):
         # suppressed (set when an attempt finds no representable
         # progress, e.g. a lagging peer lane pins the candidate min)
         self._rebase_block: Dict[int, int] = {}
+        # chaos/fault plug point: (shard_id, replica_id) -> partition
+        # group.  Rows in different groups lose their device route (the
+        # link falls back to the host transport — counted in
+        # routed_dropped as dest<0 — where the usual drop hooks apply);
+        # both sides keep ticking and campaigning, exactly a network
+        # partition.  None = fully connected.
+        self._part_fn = None
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         self.stats.update(
@@ -258,26 +271,63 @@ class ColocatedVectorEngine(VectorStepEngine):
             if (self._host_peers[g] != lay).any():
                 self._host_peers[g] = lay
                 self._tables_dirty = True
-            # publish the uploaded ring window: entries appended on the
-            # HOST path (scalar excursions, WAL replay) can later be
-            # device-route-replicated straight from this row's ring, and
-            # the receiving replica reconstructs payloads from the cache
-            last = r.log.last_index()
-            lo = max(r.log.first_index(), last - self.W + 1)
-            if last >= lo:
-                try:
-                    ents = r.log._get_entries(lo, last + 1, 2**62)
-                except Exception:  # noqa: BLE001 — compacted tails are fine
-                    ents = []
-                self._cache_put(r.shard_id, ents)
+            self._publish_ring_window(r)
+
+    def _publish_ring_window(self, r) -> None:
+        """Publish an uploading row's ring window to the shard cache:
+        entries appended on the HOST path (scalar excursions, WAL
+        replay) can later be device-route-replicated straight from this
+        row's ring, and the receiving replica reconstructs payloads
+        from the cache.  Witness rows must NOT publish — their own log
+        holds stripped metadata entries (no cmd) under the same
+        (index, term) keys; publishing them would overwrite real
+        payloads in the shared cache and silently diverge any replica
+        that reconstructs from it (witness RECEIVERS get the stripped
+        form applied at _cache_lookup instead)."""
+        if r.replica_id in r.witnesses:
+            return
+        last = r.log.last_index()
+        lo = max(r.log.first_index(), last - self.W + 1)
+        if last >= lo:
+            try:
+                ents = r.log._get_entries(lo, last + 1, 2**62)
+            except Exception:  # noqa: BLE001 — compacted tails are fine
+                ents = []
+            self._cache_put(r.shard_id, ents)
 
     def _rebuild_tables(self) -> None:
         dest, rank = build_route_tables(
             self._host_shard, self._host_replica, self._host_peers
         )
+        if self._part_fn is not None:
+            # cut cross-partition links by severing the device route:
+            # the message is left undelivered (dest<0, counted in
+            # routed_dropped) and the sending host re-sends it via its
+            # transport, where the partition's drop hook loses it — the
+            # destination row still ticks, campaigns and answers its
+            # own side, which is what a real network partition does
+            part = np.array([
+                self._part_fn(int(s), int(r)) if s else 0
+                for s, r in zip(self._host_shard, self._host_replica)
+            ])
+            cut = (dest >= 0) & (
+                part[np.clip(dest, 0, len(part) - 1)] != part[:, None]
+            )
+            dest = np.where(cut, -1, dest)
         self._dest_dev = self._put_rows(jnp.asarray(dest))
         self._rank_dev = self._put_rows(jnp.asarray(rank))
         self._tables_dirty = False
+
+    def set_partition(self, fn) -> None:
+        """Install (or clear, with ``None``) a partition-group function
+        ``fn(shard_id, replica_id) -> int``: device routes between rows
+        in different groups are severed until cleared — cross-group
+        messages fall back to each sender's host transport (chaos
+        testing — see _rebuild_tables).  Takes effect from the next
+        launch."""
+        with self._lock:
+            self._part_fn = fn
+            self._tables_dirty = True
 
     # -- entry cache ----------------------------------------------------
     def _cache_put(self, shard_id: int, entries: List[Entry]) -> None:
@@ -323,6 +373,31 @@ class ColocatedVectorEngine(VectorStepEngine):
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
         jax.block_until_ready(self._state)
+
+    def _evict_rows_to_host(self, gs) -> None:
+        """Move resident rows to the host path losing nothing.  Order is
+        a correctness invariant encoded ONCE here: drain each row's
+        routed-but-unconsumed inbox traffic into its node's receive
+        queue FIRST (the next launch's alive mask would destroy it —
+        losing a heartbeat stream turns a brief host excursion into an
+        election storm), then materialize device state into the scalar
+        mirrors, then mark the rows host-authoritative.  Already-dirty
+        rows are skipped wholesale: their scalar side is authoritative
+        and materializing stale device lanes over it would corrupt it.
+        Caller holds the core lock."""
+        pairs = []
+        for g in gs:
+            meta = self._meta.get(g)
+            if meta is not None and not meta.dirty:
+                pairs.append((meta.node, g))
+        if not pairs:
+            return
+        self._drain_pending_to_host(pairs)
+        self._materialize_rows([g for _, g in pairs])
+        for _, g in pairs:
+            meta = self._meta.get(g)
+            if meta is not None:
+                meta.dirty = True
 
     def _drain_pending_to_host(self, pairs) -> None:
         """Decode rows' pending routed-inbox regions into wire Messages
@@ -460,17 +535,9 @@ class ColocatedVectorEngine(VectorStepEngine):
         # neither regress nor be retried every step (review finding:
         # drain/materialize thrash): back off until committed grows by
         # another chunk.
-        pairs = []
-        for (shard, _), g in self._row_of.items():
-            meta = self._meta.get(g)
-            if shard in need and meta is not None and not meta.dirty:
-                pairs.append((meta.node, g))
-        self._drain_pending_to_host(pairs)
-        self._materialize_rows([g for _, g in pairs])
-        for _, g in pairs:
-            meta = self._meta.get(g)
-            if meta is not None:
-                meta.dirty = True
+        self._evict_rows_to_host(
+            [g for (shard, _), g in self._row_of.items() if shard in need]
+        )
         for shard in need:
             rafts = [
                 self._meta[g].node.peer.raft
@@ -487,8 +554,18 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self._rebase_block.pop(shard, None)
                 self.stats["shard_rebases"] += 1
             else:
+                # back off by a FRACTION of the chunk, not a whole one:
+                # a full-chunk block scheduled the retry at ~2x chunk,
+                # which under the default chunk (2^30) lands at/past the
+                # int32 planner ceiling — a transiently lagging peer
+                # then doomed the shard to a whole-shard scalar eviction
+                # even though a valid rebase opened up long before.
+                # chunk//8 keeps the thrash amortized (one materialize
+                # per chunk//8 commit growth) while leaving ~8 retries
+                # of headroom before the ceiling.
                 self._rebase_block[shard] = (
-                    max(r.log.committed for r in rafts) + self._rebase_chunk
+                    max(r.log.committed for r in rafts)
+                    + max(self.W, self._rebase_chunk // 8)
                 )
 
     def _plan_device(self, node, si, mirror_leader: bool, g):
@@ -540,21 +617,11 @@ class ColocatedVectorEngine(VectorStepEngine):
                 continue
             batch.append((node, g, si, plan))
 
-        to_mat = []
-        drain_pairs = []
-        for node, si in host_rows:
-            g = self._row_of.get(self._row_key(node))
-            if g is not None and not self._meta[g].dirty:
-                to_mat.append(g)
-                drain_pairs.append((node, g))
-                self._meta[g].dirty = True
-        # a row leaving the device may hold routed-but-unconsumed inbox
-        # traffic; re-deliver it through the node's receive queue rather
-        # than letting the consumption mask destroy it — losing a
-        # heartbeat stream here is what turns a brief host excursion
-        # into an election storm
-        self._drain_pending_to_host(drain_pairs)
-        self._materialize_rows(to_mat)
+        self._evict_rows_to_host([
+            g
+            for node, _si in host_rows
+            if (g := self._row_of.get(self._row_key(node))) is not None
+        ])
 
         # host path runs under the core lock in colocated mode: update
         # construction for OTHER hosts' rows happens inside launches, so
@@ -853,18 +920,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             # VectorStepEngine._send_snapshots): these rows take a host
             # excursion until the install resolves; drain their routed
             # traffic first so the transition loses no messages
-            gs = sorted(
-                {t[0] for t in below if self._meta.get(t[0]) is not None}
-            )
-            pairs = [
-                (self._meta[g].node, g)
-                for g in gs
-                if not self._meta[g].dirty
-            ]
-            self._drain_pending_to_host(pairs)
-            for g in gs:
-                self._meta[g].dirty = True
-            self._materialize_rows(gs)
+            self._evict_rows_to_host(sorted({t[0] for t in below}))
             for g, p, _, pid, ss_index in below:
                 meta = self._meta.get(g)
                 if meta is None or meta.node.stopped:

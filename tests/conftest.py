@@ -22,7 +22,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# DRAGONBOAT_TEST_TPU=1 lets a test run target the real chip (used for
+# the recorded scale artifacts: the CPU backend can't launch a 65k-row
+# program at election cadence; the product backend can) — everything
+# else stays on the virtual 8-device CPU mesh.
+if os.environ.get("DRAGONBOAT_TEST_TPU", "0").lower() not in ("1", "true"):
+    jax.config.update("jax_platforms", "cpu")
 # cache compiled kernels across test processes (the step kernel is large)
 jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -350,3 +350,54 @@ class TestColocatedRebasing:
         finally:
             for nh in nhs.values():
                 nh.close()
+
+
+class TestEntryCachePublishing:
+    """Unit tests on the shared entry cache's publish rules."""
+
+    def test_witness_row_never_publishes_stripped_entries(self):
+        """A witness's own log holds stripped metadata entries under the
+        SAME (index, term) keys as the real ones; letting its upload
+        publish them would overwrite real payloads in the shared cache
+        and silently diverge any replica that reconstructs from it
+        (review finding, r4).  reference: witness metadata replication,
+        raft.go makeMetadataEntry [U]."""
+        from dragonboat_tpu.pb import Entry, EntryType
+        from dragonboat_tpu.raft.raft import Raft
+
+        core = ColocatedEngineGroup(**GEOM)
+        core.factory(None)
+        eng = core.core
+
+        real = [
+            Entry(term=1, index=i, type=EntryType.APPLICATION,
+                  cmd=f"cmd{i}".encode())
+            for i in range(1, 6)
+        ]
+        voter = Raft(1, 1, {1: "a", 2: "b"}, witnesses={3: "c"})
+        voter.log.inmem.merge(real)
+        eng._publish_ring_window(voter)
+        assert eng._cache_lookup(voter, 3, 1).cmd == b"cmd3"
+
+        # the witness replica's log: stripped forms of the same entries
+        witness = Raft(1, 3, {1: "a", 2: "b"}, witnesses={3: "c"},
+                       is_witness=True)
+        witness.log.inmem.merge(
+            [Raft._to_witness_entry(e) for e in real]
+        )
+        eng._publish_ring_window(witness)
+        # real payloads survive: the witness published nothing
+        assert eng._cache_lookup(voter, 3, 1).cmd == b"cmd3"
+        # witness RECEIVERS still get the stripped form at lookup
+        got = eng._cache_lookup(witness, 3, 1)
+        assert got.cmd == b"" and got.type == EntryType.METADATA
+
+    def test_cache_depth_covers_launch_append_volume(self):
+        """Depth must cover the stamp-to-consumption gap of a routed
+        append under a proposal storm (~M*E entries/launch), not just
+        the ring window (chaos finding: rare fail-stops at W=8)."""
+        geom = dict(GEOM)
+        geom.update(W=4, M=8, E=4)
+        core = ColocatedEngineGroup(**geom)
+        core.factory(None)
+        assert core.core._cache_depth >= 8 * 8 * 4

@@ -131,8 +131,13 @@ def run_scale(shards: int, artifact_path: str = "",
         # starved mixed-residency vote storms onto the host path and
         # collapsed coverage); budget=4 absorbs a lane's worst launch
         # even before heartbeat coalescing kicks in
+        # budget 8: at 10k shards the mass-start vote storm overflowed
+        # budget 4 (18% routed drops at launch cadence ~70s — enough
+        # vote responses lost that elections looped; the 1k geometry
+        # settled fine at 4).  The wider regions live on device only.
         group = ColocatedEngineGroup(
-            capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=32, budget=4
+            capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=32,
+            budget=int(os.environ.get("SCALE_BUDGET", "8")),
         )
 
         def make_factory(rid):
@@ -211,12 +216,19 @@ def run_scale(shards: int, artifact_path: str = "",
             )
             st = (group.core.stats if engine == "colocated"
                   else nhs[1].engine.step_engine.stats)
+            tbreak = "/".join(
+                str(st.get(k, 0) // 1000)
+                for k in ("t_coalesce_ms", "t_plan_ms", "t_upload_ms",
+                          "t_device_ms", "t_detail_ms", "t_updates_ms",
+                          "t_persist_ms")
+            )
             print(f"leader coverage {covered}/{shards} "
                   f"({round(time.time() - t0, 1)}s) "
                   f"launches={st.get('launches', st['device_steps'])} "
                   f"esc={st['escalations']} host={st['host_rows_stepped']} "
                   f"routed={st.get('routed_delivered', 0)}/"
-                  f"drop={st.get('routed_dropped', 0)}", flush=True)
+                  f"drop={st.get('routed_dropped', 0)} "
+                  f"t[c/p/u/d/dt/up/ps]={tbreak}s", flush=True)
             if covered == shards:
                 break
             time.sleep(2.0)
