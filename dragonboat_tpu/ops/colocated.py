@@ -61,23 +61,27 @@ from . import sync as S
 from .engine import (
     VectorStepEngine,
     _shift_msg_indexes,
+    _F_ANY_LIVE,
+    _F_APPEND,
+    _F_COUNT,
+    _F_ESC,
+    _F_NEED_SS,
     _R_APPEND_LO,
     _R_BARRIER_IDX,
     _R_BARRIER_TERM,
     _R_COUNT,
-    _R_ESC,
-    _R_NEED_SS,
     _R_ROLE,
     _bucket,
     _gather_detail,
+    _gather_vals,
     _split_detail,
-    _summarize,
+    _summarize_flags,
     _tick_bookkeeping,
     _pad_idx,
     _set_remote_snapshot,
 )
 from .route import build_route_tables, route
-from .types import APPEND_LO_NONE, I32, Inbox, make_inbox
+from .types import APPEND_LO_NONE, I32, MT_TICK, Inbox, make_inbox
 
 _log = get_logger("engine")
 
@@ -108,9 +112,12 @@ def _assemble_inbox(host: Inbox, pending: Inbox, alive: jnp.ndarray) -> Inbox:
 @functools.partial(jax.jit, static_argnames=("PB", "E", "budget"))
 def _route_step(old_state, new_state, out, dest, rank, dest_alive,
                 *, PB: int, E: int, budget: int):
-    """Post-launch tail: discard escalated rows' effects, then route the
+    """Post-launch tail: discard escalated rows' effects, route the
     outboxes into the next launch's pending regions (width P*budget,
-    base=0 — host slots are prepended at the next assemble)."""
+    base=0 — host slots are prepended at the next assemble), and compute
+    the per-row flag word + bit-packed delivered mask so the host reads
+    back O(1)-width arrays instead of the full summary/delivered
+    matrices (multi-MB per launch — tens of seconds on the TPU tunnel)."""
     esc = out.escalate != 0
 
     def sel(a, b):
@@ -123,12 +130,71 @@ def _route_step(old_state, new_state, out, dest, rank, dest_alive,
         M=PB, E=E, budget=budget, base=0,
         suppress=esc, dest_alive=dest_alive,
     )
-    return merged, regions, jnp.stack(list(stats)), delivered
+    flags = _summarize_flags(old_state, merged, out)
+    # colocated override of _F_COUNT: only rows with UNdelivered outbox
+    # messages need host decode — a leader whose heartbeats/votes all
+    # scattered into peer rows has nothing host-visible, and during an
+    # election storm that is nearly every row (the buf gather would
+    # otherwise be a ~44 MB readback at 65k rows)
+    G, O = delivered.shape
+    valid = jnp.arange(O)[None, :] < out.count[:, None]
+    undeliv = jnp.any(valid & ~delivered, axis=1)
+    flags = (flags & ~jnp.int32(_F_COUNT)) | jnp.where(
+        undeliv, _F_COUNT, 0
+    ).astype(I32)
+    nwords = (O + 31) // 32
+    shift = jnp.arange(O, dtype=jnp.uint32) % 32
+    word = jnp.arange(O) // 32
+    bits = jnp.where(delivered, jnp.uint32(1) << shift, jnp.uint32(0))
+    packed = jnp.zeros((G, nwords), jnp.uint32)
+    for w in range(nwords):  # nwords is static and tiny (O<=64 -> <=2)
+        packed = packed.at[:, w].set(
+            jnp.sum(jnp.where(word[None, :] == w, bits, 0), axis=1,
+                    dtype=jnp.uint32)
+        )
+    return merged, regions, jnp.stack(list(stats)), packed, flags
 
 
 @jax.jit
 def _zero_inbox_rows(inbox: Inbox, idx) -> Inbox:
     return Inbox(*(getattr(inbox, f).at[idx].set(0) for f in Inbox._fields))
+
+
+@functools.partial(jax.jit, static_argnames=("M", "E"))
+def _host_inbox_from_ticks(tick_counts, *, M: int, E: int) -> Inbox:
+    """Build the host inbox region ON DEVICE from a [G] fused-tick-count
+    vector.  At scale, nearly every row's host region is exactly one
+    count-carrying LOCAL_TICK slot — uploading the dense [G, M(, E)]
+    inbox arrays cost ~28 MB per launch through the TPU tunnel (~100 s,
+    the whole launch budget); the tick vector is 256 KB.  Rows with real
+    host slots (wire messages, proposals, reads, tick-with-read-hint)
+    are scattered over this base by _scatter_inbox_rows."""
+    G = tick_counts.shape[0]
+    z = jnp.zeros((G, M), I32)
+    ze = jnp.zeros((G, M, E), I32)
+    has = tick_counts > 0
+    return Inbox(
+        mtype=z.at[:, 0].set(jnp.where(has, MT_TICK, 0)),
+        from_id=z,
+        term=z,
+        log_term=z,
+        log_index=z.at[:, 0].set(tick_counts),
+        commit=z,
+        reject=z,
+        hint=z,
+        hint_high=z,
+        n_entries=z,
+        ent_term=ze,
+        ent_cc=ze,
+    )
+
+
+@jax.jit
+def _scatter_inbox_rows(host: Inbox, idx, sub: Inbox) -> Inbox:
+    return Inbox(*(
+        getattr(host, f).at[idx].set(getattr(sub, f))
+        for f in Inbox._fields
+    ))
 
 
 class ColocatedVectorEngine(VectorStepEngine):
@@ -182,6 +248,10 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._part_fn = None
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
+        # loop-invariant delivered-bit unpack tables (word index and
+        # in-word shift per outbox slot) — hoisted out of the merge loop
+        self._dw_word = np.arange(self.O) // 32
+        self._dw_shift = (np.arange(self.O) % 32).astype(np.uint32)
         self.stats.update(
             launches=0, routed_delivered=0, routed_host_carried=0,
             routed_dropped=0, coalesced_rows=0, shard_rebases=0,
@@ -356,9 +426,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         rank = self._put_rows(jnp.zeros((G, P), I32))
         full = _assemble_inbox(host, self._pending, alive)
         new_st, out = K.step(st, full, out_capacity=O)
-        _summarize(new_st, out)
         _route_step(st, new_st, out, dest, rank, alive,
                     PB=P * B, E=E, budget=B)
+        host2 = _host_inbox_from_ticks(
+            self._put(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
+        )
         from .engine import _gather_rows, _scatter_rows, _select_rows
 
         _select_rows(self._put(jnp.ones((G,), bool)), st, st)
@@ -368,7 +440,13 @@ class ColocatedVectorEngine(VectorStepEngine):
             sub = _gather_rows(st, idx)
             _scatter_rows(st, idx, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
+            _gather_vals(st, out, idx)
             _zero_inbox_rows(self._pending, idx)
+            _scatter_inbox_rows(
+                host2, idx,
+                Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
+                        for f in host2)),
+            )
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
@@ -681,9 +759,48 @@ class ColocatedVectorEngine(VectorStepEngine):
         msg_rows, staging, prop_rows = self._encode_batch(
             batch, slot_offset=P * B
         )
-        host_inbox, overflow = S.encode_inbox(msg_rows, M, E)
-        assert not overflow, f"planner let oversized rows through: {overflow}"
-        host_inbox = self._put_rows(host_inbox)
+        # compact host-inbox upload: tick-only rows (the overwhelming
+        # majority at scale) ride a [G] count vector built into an inbox
+        # ON DEVICE; only rows with real host slots upload dense rows
+        tick_counts = np.zeros((G,), np.int32)
+        sparse: List[Tuple[int, List]] = []
+        for node, g, si, plan in batch:
+            msgs = msg_rows[g]
+            if not msgs:
+                continue
+            m0 = msgs[0]
+            if (
+                len(msgs) == 1
+                and int(m0.type) == MT_TICK
+                and m0.hint == 0
+                and m0.hint_high == 0
+            ):
+                tick_counts[g] = m0.log_index
+            else:
+                sparse.append((g, msgs))
+        host_inbox = _host_inbox_from_ticks(
+            self._put(jnp.asarray(tick_counts)), M=M, E=E
+        )
+        if sparse:
+            nsb = _bucket(len(sparse))
+            # pad with COPIES of the last real row: _pad_idx repeats its
+            # g, and duplicate .at[idx].set() is only benign when every
+            # duplicate writes identical data (an empty pad row would
+            # race the real one and could zero its messages)
+            batches = (
+                [m for _, m in sparse]
+                + [sparse[-1][1]] * (nsb - len(sparse))
+            )
+            sub, overflow = S.encode_inbox(batches, M, E)
+            assert not overflow, (
+                f"planner let oversized rows through: "
+                f"{[sparse[i][0] for i in overflow]}"
+            )
+            host_inbox = _scatter_inbox_rows(
+                host_inbox,
+                self._put(jnp.asarray(_pad_idx([g for g, _ in sparse]))),
+                self._put(sub),
+            )
 
         if self._tables_dirty:
             self._rebuild_tables()
@@ -708,14 +825,16 @@ class ColocatedVectorEngine(VectorStepEngine):
         with annotate("raft-colocated-step"):
             full = _assemble_inbox(host_inbox, self._pending, alive)
             new_state, out = K.step(old_state, full, out_capacity=self.O)
-            merged, regions, stats_dev, delivered_dev = _route_step(
-                old_state, new_state, out, self._dest_dev, self._rank_dev,
-                alive, PB=P * B, E=E, budget=B,
+            merged, regions, stats_dev, delivered_dev, flags_dev = (
+                _route_step(
+                    old_state, new_state, out, self._dest_dev,
+                    self._rank_dev, alive, PB=P * B, E=E, budget=B,
+                )
             )
-            summary = np.asarray(_summarize(new_state, out))
+            flags = np.asarray(flags_dev)
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
         rstats = np.asarray(stats_dev)
-        delivered = np.asarray(delivered_dev)
+        delivered_bits = np.asarray(delivered_dev)  # [G, ceil(O/32)] u32
         self._pending = regions
         self._state = merged
         self._pending_live = int(rstats[0]) > 0
@@ -731,14 +850,14 @@ class ColocatedVectorEngine(VectorStepEngine):
         esc_batch = [
             (node, g, si)
             for node, g, si, plan in batch
-            if summary[_R_ESC, g] != 0
+            if flags[g] & _F_ESC
         ]
         # resident rows stepped only by routed traffic can escalate too:
         # discard their effects (the routed inputs are raft-safe to lose)
         esc_other = [
             g
             for g, meta in self._meta.items()
-            if alive_np[g] and g not in batch_gs and summary[_R_ESC, g] != 0
+            if alive_np[g] and g not in batch_gs and flags[g] & _F_ESC
         ]
         updates: List[Tuple] = []
         if esc_batch or esc_other:
@@ -768,21 +887,21 @@ class ColocatedVectorEngine(VectorStepEngine):
         for g, meta in self._meta.items():
             if not alive_np[g] or g in batch_gs or g in esc_set:
                 continue
-            s_changed = (summary[:6, g] != self._mirror[:6, g]).any()
-            if (
-                s_changed
-                or summary[_R_COUNT, g] > 0
-                or summary[_R_APPEND_LO, g] != APPEND_LO_NONE
-                or summary[_R_NEED_SS, g]
-            ):
+            if flags[g] & _F_ANY_LIVE:
                 live.append((meta.node, g, None))
 
-        buf_rows = [g for _, g, _ in live if summary[_R_COUNT, g] > 0]
-        append_rows = [
-            g for _, g, _ in live if summary[_R_APPEND_LO, g] != APPEND_LO_NONE
-        ]
+        buf_rows = [g for _, g, _ in live if flags[g] & _F_COUNT]
+        append_rows = [g for _, g, _ in live if flags[g] & _F_APPEND]
         slot_rows = [g for g in prop_rows if g not in esc_set]
-        need_rows = [g for _, g, _ in live if summary[_R_NEED_SS, g]]
+        need_rows = [g for _, g, _ in live if flags[g] & _F_NEED_SS]
+        slot_set = set(slot_rows)
+        # rows whose VALUES the merge loop reads: anything flagged or
+        # carrying proposal slots (the rest only tick)
+        sum_rows = [
+            g for _, g, _ in live
+            if (flags[g] & _F_ANY_LIVE) or g in slot_set
+        ]
+        _t0 = _time.perf_counter()
         if buf_rows or append_rows or slot_rows or need_rows:
             b = _bucket(
                 max(len(buf_rows), len(append_rows), len(slot_rows),
@@ -795,24 +914,34 @@ class ColocatedVectorEngine(VectorStepEngine):
                 if rows:
                     idx4[row_i, : len(rows)] = rows
                     idx4[row_i, len(rows):] = rows[-1]
-            _t0 = _time.perf_counter()
             flat = np.asarray(
-                _gather_detail(new_state, out, self._put(jnp.asarray(idx4)))
+                _gather_detail(merged, out, self._put(jnp.asarray(idx4)))
             )
             # the kernel ran on the ASSEMBLED inbox (host slots + routed
             # regions), so the out slot arrays are M + P*B wide
             (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
-             ring_c) = _split_detail(flat, self.O, M + P * B, E, P, self.W)
-            self.stats["t_detail_ms"] += int(
-                (_time.perf_counter() - _t0) * 1000
-            )
+             ring_c) = _split_detail(
+                flat, self.O, M + P * B, E, P, self.W)
         else:
             buf_np = slot_base = slot_term = ent_drop = need_np = None
             ring_t = ring_c = None
+        if sum_rows:
+            vals_np = np.asarray(
+                _gather_vals(
+                    merged, out,
+                    self._put(jnp.asarray(_pad_idx(sum_rows))),
+                )
+            )
+        else:
+            vals_np = None
+        self.stats["t_detail_ms"] += int(
+            (_time.perf_counter() - _t0) * 1000
+        )
         buf_at = {g: k for k, g in enumerate(buf_rows)}
         ring_at = {g: k for k, g in enumerate(append_rows)}
         slot_at = {g: k for k, g in enumerate(slot_rows)}
         need_at = {g: k for k, g in enumerate(need_rows)}
+        sum_at = {g: k for k, g in enumerate(sum_rows)}
 
         from .engine import SLOT_DROPPED
 
@@ -824,21 +953,18 @@ class ColocatedVectorEngine(VectorStepEngine):
                 continue
             r = node.peer.raft
             base = int(self._base[g])  # the shard's shared base
+            if si is not None:
+                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
+            if g not in sum_at:
+                # no flags, no slots: the row only ticked
+                continue
+            sv = vals_np[sum_at[g]]
             term, vote, committed, leader, role, last = (
-                int(summary[i, g]) for i in range(6)
+                int(sv[i]) for i in range(6)
             )
             committed += base
             last += base
-            changed = (
-                summary[:6, g] != self._mirror[:6, g]
-            ).any() or summary[_R_COUNT, g] > 0
-            appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
-            if si is not None:
-                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
-            if not (
-                changed or appended or summary[_R_NEED_SS, g] or g in slot_at
-            ):
-                continue
+            appended = bool(flags[g] & _F_APPEND)
             # scalar sync BEFORE the merge: the noop-barrier-vs-lost-
             # payload distinction in _merge_appends needs the POST-step
             # role (a row that just won its election self-appends the
@@ -848,13 +974,13 @@ class ColocatedVectorEngine(VectorStepEngine):
             if appended:
                 try:
                     stamped = self._merge_appends(
-                        r, g, int(summary[_R_APPEND_LO, g]) + base, last,
+                        r, g, int(sv[_R_APPEND_LO]) + base, last,
                         staging.get(g, {}), slot_at, slot_base, slot_term,
                         ent_drop, ring_t[ring_at[g]], ring_c[ring_at[g]],
                         fallback=self._cache_lookup,
                         barrier=(
-                            int(summary[_R_BARRIER_IDX, g]) + base,
-                            int(summary[_R_BARRIER_TERM, g]),
+                            int(sv[_R_BARRIER_IDX]) + base,
+                            int(sv[_R_BARRIER_TERM]),
                         ),
                         base=base,
                     )
@@ -881,9 +1007,13 @@ class ColocatedVectorEngine(VectorStepEngine):
             ):
                 node.drop_device_reads()
             if g in buf_at:
+                bits = delivered_bits[g]
+                dr = (
+                    (bits[self._dw_word] >> self._dw_shift) & 1
+                ).astype(bool)
                 self._attach_messages(
-                    r, node, buf_np[buf_at[g]], int(summary[_R_COUNT, g]),
-                    staging.get(g, {}), delivered_row=delivered[g],
+                    r, node, buf_np[buf_at[g]], int(sv[_R_COUNT]),
+                    staging.get(g, {}), delivered_row=dr,
                     base=base,
                 )
             if g in slot_at:
@@ -902,7 +1032,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             u = node.peer.get_update(last_applied=node.sm.last_applied)
             node.dispatch_dropped(u)
             updates.append((node, u))
-            self._mirror[:6, g] = summary[:6, g]
+            self._mirror[:6, g] = sv[:6]
             node._check_leader_change()
         self.stats["t_updates_ms"] += int((_time.perf_counter() - _t0) * 1000)
 

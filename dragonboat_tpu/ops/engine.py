@@ -73,10 +73,22 @@ _log = get_logger("engine")
 
 _HOT_SET = frozenset(HOT_TYPES)
 
-# readback row indices of the _summarize stack
+# readback row indices of the per-row VALUES block (_gather_detail's
+# idx_sum part); 0-5 double as the [6, G] host mirror's row indices
 _R_TERM, _R_VOTE, _R_COMMIT, _R_LEADER, _R_ROLE, _R_LAST = range(6)
-_R_COUNT, _R_ESC, _R_APPEND_LO, _R_NEED_SS = 6, 7, 8, 9
-_R_BARRIER_IDX, _R_BARRIER_TERM = 10, 11
+_R_COUNT, _R_APPEND_LO = 6, 7
+_R_BARRIER_IDX, _R_BARRIER_TERM = 8, 9
+N_VALS = 10
+
+# per-row flag bits of the _summarize_flags readback — the ONLY
+# full-width [G] readback a launch performs.  Everything row-valued
+# (terms, counts, outboxes, rings) is gathered afterwards for flagged
+# rows only: at 65k rows the old [12, G] summary + [G, O] delivered
+# readbacks were ~5 MB per launch, which on a remote-device link (the
+# TPU tunnel) costs tens of seconds — the flags word is 256 KB and the
+# steady-state gather is a few rows.
+_F_CHANGED, _F_COUNT, _F_APPEND, _F_NEED_SS, _F_ESC = 1, 2, 4, 8, 16
+_F_ANY_LIVE = _F_CHANGED | _F_COUNT | _F_APPEND | _F_NEED_SS
 
 
 def _bucket(n: int) -> int:
@@ -115,31 +127,55 @@ def _gather_rows(state: DeviceState, idx) -> DeviceState:
 
 
 @jax.jit
-def _summarize(state: DeviceState, out) -> jnp.ndarray:
+def _summarize_flags(old: DeviceState, new: DeviceState, out) -> jnp.ndarray:
+    """Per-row flag word (see _F_*) — the one full-width readback."""
+    changed = (
+        (new.term != old.term)
+        | (new.vote != old.vote)
+        | (new.committed != old.committed)
+        | (new.leader_id != old.leader_id)
+        | (new.role != old.role)
+        | (new.last_index != old.last_index)
+    )
+    f = jnp.where(changed, _F_CHANGED, 0)
+    f = f | jnp.where(out.count > 0, _F_COUNT, 0)
+    f = f | jnp.where(out.append_lo != APPEND_LO_NONE, _F_APPEND, 0)
+    f = f | jnp.where(jnp.any(out.need_snapshot == 1, axis=1), _F_NEED_SS, 0)
+    f = f | jnp.where(out.escalate != 0, _F_ESC, 0)
+    return f.astype(I32)
+
+
+@jax.jit
+def _gather_vals(state, out, idx):
+    """Per-row VALUES block (_R_* order) for flagged rows — replaces the
+    old full-width summary readback.  Split from _gather_detail because
+    their cardinalities differ wildly: during an election storm most
+    rows change state (values needed) while few carry host-relevant
+    outbox bytes; one fused gather padded the huge buf part to the
+    values cardinality (~44 MB readbacks at 65k rows)."""
     return jnp.stack(
         [
-            state.term,
-            state.vote,
-            state.committed,
-            state.leader_id,
-            state.role,
-            state.last_index,
-            out.count,
-            out.escalate,
-            out.append_lo,
-            jnp.any(out.need_snapshot == 1, axis=1).astype(I32),
-            out.barrier_idx,
-            out.barrier_term,
-        ]
+            state.term[idx],
+            state.vote[idx],
+            state.committed[idx],
+            state.leader_id[idx],
+            state.role[idx],
+            state.last_index[idx],
+            out.count[idx],
+            out.append_lo[idx],
+            out.barrier_idx[idx],
+            out.barrier_term[idx],
+        ],
+        axis=1,
     )
 
 
 @jax.jit
 def _gather_detail(state, out, idx4):
-    """All post-step detail reads in ONE dispatch and ONE [b, K] readback
-    array: the four equal-length index sets travel as a stacked [4, b]
-    transfer, and the seven flattened results concatenate on axis 1 so the
-    host issues a single D2H copy (latency floor is round-trips, not
+    """All heavy post-step detail reads in ONE dispatch and ONE [b, K]
+    readback array: the four equal-length index sets travel as a stacked
+    [4, b] transfer, and the flattened results concatenate on axis 1 so
+    the host issues a single D2H copy (latency floor is round-trips, not
     bytes)."""
     idx_buf, idx_slot, idx_need, idx_ring = idx4
     b = idx_buf.shape[0]
@@ -371,7 +407,7 @@ class VectorStepEngine(IStepEngine):
         st = self._state
         inbox = self._put_rows(make_inbox(self.capacity, self.M, self.E))
         _, out = K.step(st, inbox, out_capacity=self.O)
-        _summarize(st, out)
+        _summarize_flags(st, st, out)
         _select_rows(self._put_rows(jnp.ones((self.capacity,), bool)), st, st)
         b = 1
         while b <= self.capacity:
@@ -379,6 +415,7 @@ class VectorStepEngine(IStepEngine):
             sub = _gather_rows(st, idx)
             _scatter_rows(st, idx, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
+            _gather_vals(st, out, self._put(jnp.zeros((b,), jnp.int32)))
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
@@ -920,7 +957,7 @@ class VectorStepEngine(IStepEngine):
 
         with annotate("raft-device-step"):
             new_state, out = K.step(old_state, inbox, out_capacity=self.O)
-            summary = np.asarray(_summarize(new_state, out))
+            flags = np.asarray(_summarize_flags(old_state, new_state, out))
         self.stats["device_steps"] += 1
         self.stats["device_rows_stepped"] += len(batch)
 
@@ -928,7 +965,7 @@ class VectorStepEngine(IStepEngine):
         esc_rows = [
             (node, g, si)
             for node, g, si, plan in batch
-            if summary[_R_ESC, g] != 0
+            if flags[g] & _F_ESC
         ]
         updates: List[Tuple] = []
         if esc_rows:
@@ -959,17 +996,23 @@ class VectorStepEngine(IStepEngine):
         # per-step latency floor is dispatch round-trips, which on remote
         # device links cost far more than the extra padded bytes) -------
         live = [(node, g, si) for node, g, si, plan in batch if g not in esc_set]
-        buf_rows = [g for _, g, _ in live if summary[_R_COUNT, g] > 0]
-        append_rows = [
-            g for _, g, _ in live if summary[_R_APPEND_LO, g] != APPEND_LO_NONE
-        ]
+        buf_rows = [g for _, g, _ in live if flags[g] & _F_COUNT]
+        append_rows = [g for _, g, _ in live if flags[g] & _F_APPEND]
         slot_rows = [g for g in prop_rows if g not in esc_set]
-        need_rows = [g for _, g, _ in live if summary[_R_NEED_SS, g]]
+        need_rows = [g for _, g, _ in live if flags[g] & _F_NEED_SS]
+        # rows whose VALUES the merge loop reads: anything flagged or
+        # carrying proposal slots (the rest only tick)
+        slot_set = set(slot_rows)
+        sum_rows = [
+            g for _, g, _ in live
+            if (flags[g] & _F_ANY_LIVE) or g in slot_set
+        ]
         if buf_rows or append_rows or slot_rows or need_rows:
             # pad all four index sets to ONE bucket so the fused gather
             # compiles per bucket size, not per size combination
             b = _bucket(
-                max(len(buf_rows), len(append_rows), len(slot_rows), len(need_rows))
+                max(len(buf_rows), len(append_rows), len(slot_rows),
+                    len(need_rows))
             )
             idx4 = np.zeros((4, b), np.int32)
             for row_i, rows in enumerate(
@@ -987,10 +1030,20 @@ class VectorStepEngine(IStepEngine):
         else:
             buf_np = slot_base = slot_term = ent_drop = need_np = None
             ring_t = ring_c = None
+        if sum_rows:
+            vals_np = np.asarray(
+                _gather_vals(
+                    new_state, out,
+                    self._put(jnp.asarray(_pad_idx(sum_rows))),
+                )
+            )
+        else:
+            vals_np = None
         buf_at = {g: k for k, g in enumerate(buf_rows)}
         ring_at = {g: k for k, g in enumerate(append_rows)}
         slot_at = {g: k for k, g in enumerate(slot_rows)}
         need_at = {g: k for k, g in enumerate(need_rows)}
+        sum_at = {g: k for k, g in enumerate(sum_rows)}
 
         # ---- per-row update construction -----------------------------
         # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
@@ -998,30 +1051,24 @@ class VectorStepEngine(IStepEngine):
         for node, g, si in live:
             r = node.peer.raft
             base = int(self._base[g])
+            # tick bookkeeping (mirrors Node.step_with_inputs)
+            _tick_bookkeeping(node, si.ticks + si.gc_ticks)
+            if g not in sum_at:
+                # no flags, no slots: the row only ticked
+                continue
+            sv = vals_np[sum_at[g]]
             term, vote, committed, leader, role, last = (
-                int(summary[i, g]) for i in range(6)
+                int(sv[i]) for i in range(6)
             )
             committed += base
             last += base
-            changed = (
-                summary[:6, g] != self._mirror[:6, g]
-            ).any() or summary[_R_COUNT, g] > 0
-            appended = summary[_R_APPEND_LO, g] != APPEND_LO_NONE
-            # tick bookkeeping (mirrors Node.step_with_inputs)
-            _tick_bookkeeping(node, si.ticks + si.gc_ticks)
-            if not (
-                changed
-                or appended
-                or summary[_R_NEED_SS, g]
-                or g in slot_at
-            ):
-                continue
+            appended = bool(flags[g] & _F_APPEND)
             # 1. append reconstruction
             if appended:
                 self._merge_appends(
                     r,
                     g,
-                    int(summary[_R_APPEND_LO, g]) + base,
+                    int(sv[_R_APPEND_LO]) + base,
                     last,
                     staging.get(g, {}),
                     slot_at,
@@ -1049,7 +1096,7 @@ class VectorStepEngine(IStepEngine):
                     r,
                     node,
                     buf_np[buf_at[g]],
-                    int(summary[_R_COUNT, g]),
+                    int(sv[_R_COUNT]),
                     staging.get(g, {}),
                     base=base,
                 )
@@ -1072,7 +1119,7 @@ class VectorStepEngine(IStepEngine):
             u = node.peer.get_update(last_applied=node.sm.last_applied)
             node.dispatch_dropped(u)
             updates.append((node, u))
-            self._mirror[:6, g] = summary[:6, g]
+            self._mirror[:6, g] = sv[:6]
             node._check_leader_change()
 
         lanes = [t for t in snapshot_sends if t[2] is not None]

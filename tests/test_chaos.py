@@ -59,7 +59,10 @@ class Cluster:
         for rid in self.ADDRS:
             self.start(rid)
         for rid, nh in self.nhs.items():
-            nh.start_replica(self.ADDRS, False, KVStore, shard_config(rid))
+            nh.start_replica(self.ADDRS, False, KVStore, self.config(rid))
+
+    def config(self, rid):
+        return shard_config(rid)
 
     def _dir(self, rid):
         return f"/tmp/nh-chaos-{rid}"
@@ -73,7 +76,7 @@ class Cluster:
 
     def restart(self, rid):
         self.start(rid)
-        self.nhs[rid].start_replica(self.ADDRS, False, KVStore, shard_config(rid))
+        self.nhs[rid].start_replica(self.ADDRS, False, KVStore, self.config(rid))
 
     def partition(self, side_a):
         """Messages between side_a and the rest are dropped, both ways."""

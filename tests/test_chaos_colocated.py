@@ -26,7 +26,6 @@ and campaigning, exactly a network partition.
 """
 import os
 import random
-import shutil
 import threading
 import time
 
@@ -41,7 +40,6 @@ from dragonboat_tpu import (
 )
 from dragonboat_tpu.ops.colocated import ColocatedEngineGroup
 from dragonboat_tpu.storage.tan import tan_logdb_factory
-from dragonboat_tpu.transport.inproc import reset_inproc_network
 
 from test_chaos import Cluster, chaos_client
 from test_nodehost import KVStore, set_cmd, wait_for_leader
@@ -49,7 +47,7 @@ from test_nodehost import KVStore, set_cmd, wait_for_leader
 ADDRS = {1: "colo-chaos-1", 2: "colo-chaos-2", 3: "colo-chaos-3"}
 
 # small ring window so eviction pressure is reachable in test time:
-# entry cache depth is 8*W = 64 entries per shard
+# entry cache depth is max(8*W, 8*M*E) = 256 entries per shard
 GEOM = dict(capacity=16, P=5, W=8, M=8, E=4, O=32, budget=4)
 
 
@@ -72,19 +70,13 @@ class ColocatedCluster(Cluster):
 
     def __init__(self):
         self.group = ColocatedEngineGroup(**GEOM)
-        reset_inproc_network()
-        for rid in self.ADDRS:
-            shutil.rmtree(self._dir(rid), ignore_errors=True)
-        self.nhs = {}
-        for rid in self.ADDRS:
-            self.start(rid)
-        for rid, nh in self.nhs.items():
-            nh.start_replica(
-                self.ADDRS, False, KVStore, colo_chaos_config(rid)
-            )
+        super().__init__()
 
     def _dir(self, rid):
         return f"/tmp/nh-cchaos-{rid}"
+
+    def config(self, rid):
+        return colo_chaos_config(rid)
 
     def start(self, rid):
         self.nhs[rid] = NodeHost(
@@ -98,12 +90,6 @@ class ColocatedCluster(Cluster):
                     step_engine_factory=self.group.factory,
                 ),
             )
-        )
-
-    def restart(self, rid):
-        self.start(rid)
-        self.nhs[rid].start_replica(
-            self.ADDRS, False, KVStore, colo_chaos_config(rid)
         )
 
     def partition(self, side_a):
@@ -171,21 +157,21 @@ class TestColocatedChaos:
 
     def test_entry_cache_eviction_pressure(self):
         """Slow follower + append storm past the cache depth (VERDICT r3
-        weak-#8): partition one member out, commit several times the
-        per-shard entry-cache depth (8*W = 64 here), heal, and require
-        full catch-up with ZERO fail-stops — stale appends must fall to
-        the host path (ring_ok / route tables), never fabricate entries
-        or halt the replica."""
+        weak-#8): partition one member out, commit past the per-shard
+        entry-cache depth (256 here), heal, and require full catch-up
+        with ZERO fail-stops — stale appends must fall to the host path
+        (ring_ok / route tables), never fabricate entries or halt the
+        replica."""
         cluster = ColocatedCluster()
         acked = {}
         try:
             wait_for_leader(cluster.nhs)
             cluster.partition([3])
-            # storm: ~4x the 64-entry cache depth while rid 3 is deaf
+            # storm: past the 256-entry cache depth while rid 3 is deaf
             majority = [1, 2]
             done = 0
-            deadline = time.time() + 120.0
-            while done < 256 and time.time() < deadline:
+            deadline = time.time() + 150.0
+            while done < 300 and time.time() < deadline:
                 rid = majority[done % 2]
                 try:
                     nh = cluster.nhs[rid]
@@ -197,7 +183,7 @@ class TestColocatedChaos:
                     done += 1
                 except Exception:
                     time.sleep(0.05)
-            assert done >= 256, f"storm stalled at {done}"
+            assert done >= 300, f"storm stalled at {done}"
             cluster.heal()
             cluster.settle_and_check_agreement(acked, timeout=90.0)
             st = cluster.stats()
