@@ -424,13 +424,17 @@ class ColocatedVectorEngine(VectorStepEngine):
         alive = self._put_rows(jnp.zeros((G,), bool))
         dest = self._put_rows(jnp.full((G, P), -1, I32))
         rank = self._put_rows(jnp.zeros((G, P), I32))
-        full = _assemble_inbox(host, self._pending, alive)
+        # warm the REAL launch signature: host inbox built on device
+        # from the (row-sharded) tick vector — warming with a host-side
+        # make_inbox would key different executables (committed-ness /
+        # sharding) and the first production launch would recompile
+        host2 = _host_inbox_from_ticks(
+            self._put_rows(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
+        )
+        full = _assemble_inbox(host2, self._pending, alive)
         new_st, out = K.step(st, full, out_capacity=O)
         _route_step(st, new_st, out, dest, rank, alive,
                     PB=P * B, E=E, budget=B)
-        host2 = _host_inbox_from_ticks(
-            self._put(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
-        )
         from .engine import _gather_rows, _scatter_rows, _select_rows
 
         _select_rows(self._put(jnp.ones((G,), bool)), st, st)
@@ -444,8 +448,8 @@ class ColocatedVectorEngine(VectorStepEngine):
             _zero_inbox_rows(self._pending, idx)
             _scatter_inbox_rows(
                 host2, idx,
-                Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
-                        for f in host2)),
+                self._put(Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
+                                  for f in host2))),
             )
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
@@ -779,7 +783,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             else:
                 sparse.append((g, msgs))
         host_inbox = _host_inbox_from_ticks(
-            self._put(jnp.asarray(tick_counts)), M=M, E=E
+            self._put_rows(jnp.asarray(tick_counts)), M=M, E=E
         )
         if sparse:
             nsb = _bucket(len(sparse))
@@ -793,8 +797,8 @@ class ColocatedVectorEngine(VectorStepEngine):
             )
             sub, overflow = S.encode_inbox(batches, M, E)
             assert not overflow, (
-                f"planner let oversized rows through: "
-                f"{[sparse[i][0] for i in overflow]}"
+                "planner let oversized rows through: "
+                f"{[sparse[i][0] for i in overflow if i < len(sparse)]}"
             )
             host_inbox = _scatter_inbox_rows(
                 host_inbox,

@@ -185,9 +185,13 @@ def _col(arr, slot):
 
 
 def _set_col(arr, slot, mask, val):
-    g = jnp.arange(arr.shape[0])
-    cur = arr[g, slot]
-    return arr.at[g, slot].set(jnp.where(mask, val, cur))
+    # one-hot select, NOT arr.at[arange(G), slot].set(...): a scatter
+    # with per-row data-dependent indices lowers to a serial per-row
+    # loop on TPU (measured ~100 us/row — it serialized the whole
+    # kernel); a [G, P] where() vectorizes
+    onehot = jnp.arange(arr.shape[1])[None, :] == slot[:, None]
+    val = jnp.broadcast_to(jnp.asarray(val, arr.dtype), slot.shape)
+    return jnp.where(onehot & mask[:, None], val[:, None], arr)
 
 
 # ---------------------------------------------------------------------------
@@ -234,14 +238,15 @@ def _last_term(st):
 
 
 def _ring_append_one(st, mask, idx, term, cc):
-    """Write (term, cc) for log position idx where mask."""
+    """Write (term, cc) for log position idx where mask.  One-hot
+    select over W (see _set_col: data-dependent scatter serializes)."""
     wm = st.W - 1
-    g = jnp.arange(st.G)
     pos = jnp.clip(idx, 0, None) & wm
-    rt = st.ring_term.at[g, pos].set(
-        jnp.where(mask, term, st.ring_term[g, pos])
-    )
-    rc = st.ring_cc.at[g, pos].set(jnp.where(mask, cc, st.ring_cc[g, pos]))
+    sel = (jnp.arange(st.W)[None, :] == pos[:, None]) & mask[:, None]
+    term = jnp.broadcast_to(jnp.asarray(term, st.ring_term.dtype), pos.shape)
+    cc = jnp.broadcast_to(jnp.asarray(cc, st.ring_cc.dtype), pos.shape)
+    rt = jnp.where(sel, term[:, None], st.ring_term)
+    rc = jnp.where(sel, cc[:, None], st.ring_cc)
     return st._replace(ring_term=rt, ring_cc=rc)
 
 
@@ -307,11 +312,10 @@ def _emit(
     idx = out.count
     can = mask & (idx < O)
     overflow = mask & (idx >= O)
-    g = jnp.arange(G)
     pos = jnp.clip(idx, 0, O - 1)
-    buf = out.buf.at[g, pos].set(
-        jnp.where(can[:, None], row, out.buf[g, pos])
-    )
+    # one-hot select over O (see _set_col: scatter serializes)
+    sel = (jnp.arange(O)[None, :] == pos[:, None]) & can[:, None]
+    buf = jnp.where(sel[:, :, None], row[:, None, :], out.buf)
     return out._replace(
         buf=buf,
         count=out.count + can.astype(I32),
@@ -380,9 +384,10 @@ def _become_candidate(st, mask) -> DeviceState:
 
 
 def _grant_self(st, mask):
-    g = jnp.arange(st.G)
-    cur = st.granted[g, st.self_slot]
-    return st.granted.at[g, st.self_slot].set(jnp.where(mask, 1, cur))
+    sel = (
+        jnp.arange(st.granted.shape[1])[None, :] == st.self_slot[:, None]
+    ) & mask[:, None]
+    return jnp.where(sel, 1, st.granted)
 
 
 def _vote_quorum(st):
@@ -453,11 +458,12 @@ def _send_replicate(st, out, mask, slot, E) -> Tuple[DeviceState, DeviceOut]:
     prev = nxt - 1
     # compacted below the resolvable boundary -> snapshot path
     need_ss = m & (prev < st.first_index - 1)
-    g = jnp.arange(st.G)
-    ns = out.need_snapshot.at[g, slot].set(
-        jnp.where(need_ss, 1, out.need_snapshot[g, slot])
+    sel = (
+        jnp.arange(out.need_snapshot.shape[1])[None, :] == slot[:, None]
+    ) & need_ss[:, None]
+    out = out._replace(
+        need_snapshot=jnp.where(sel, 1, out.need_snapshot)
     )
-    out = out._replace(need_snapshot=ns)
     # hold the remote paused until the host starts the snapshot stream
     st = st._replace(rstate=_set_col(st.rstate, slot, need_ss, RS_WAIT))
     prev_term, known, esc = _log_term(st, prev)
