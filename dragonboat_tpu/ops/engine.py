@@ -543,6 +543,24 @@ class VectorStepEngine(IStepEngine):
             return None
         if si.read_indexes and not mirror_leader:
             return None
+        if node.quiesce.enabled:
+            # QUIESCE enter-hints never touch raft state (node.py applies
+            # them via quiesce_hint() only) — consume them HERE instead
+            # of bouncing the row to the scalar path: at 10k shards the
+            # post-election quiesce wave otherwise broadcasts a cold
+            # wire type to every peer of every quiescing shard (~P x
+            # shards host excursions + re-uploads, measured as ~96k host
+            # steps during the r4 scale run's propose phase).  Safe
+            # against the host-fallback double-processing rule: hints
+            # are removed from si.received, and the scalar step's only
+            # handling of them is the same quiesce_hint() call.
+            kept = []
+            for m in si.received:
+                if int(m.type) == int(MessageType.QUIESCE):
+                    node.quiesce.quiesce_hint()
+                else:
+                    kept.append(m)
+            si.received = kept
         if node.quiesce.enabled and node.quiesce.is_quiesced() and (
             si.received or si.proposals
         ):
