@@ -72,6 +72,7 @@ from .engine import (
     _R_COUNT,
     _R_ROLE,
     _bucket,
+    _pos_map,
     _gather_detail,
     _gather_vals,
     _split_detail,
@@ -156,8 +157,15 @@ def _route_step(old_state, new_state, out, dest, rank, dest_alive,
 
 
 @jax.jit
-def _zero_inbox_rows(inbox: Inbox, idx) -> Inbox:
-    return Inbox(*(getattr(inbox, f).at[idx].set(0) for f in Inbox._fields))
+def _zero_inbox_rows(inbox: Inbox, mask) -> Inbox:
+    """Zero the inbox rows where ``mask`` ([G] bool) — mask-select, not
+    a data-dependent scatter (serial on TPU; see _scatter_rows)."""
+
+    def z(a):
+        m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, 0, a)
+
+    return Inbox(*(z(getattr(inbox, f)) for f in Inbox._fields))
 
 
 @functools.partial(jax.jit, static_argnames=("M", "E"))
@@ -190,10 +198,18 @@ def _host_inbox_from_ticks(tick_counts, *, M: int, E: int) -> Inbox:
 
 
 @jax.jit
-def _scatter_inbox_rows(host: Inbox, idx, sub: Inbox) -> Inbox:
+def _scatter_inbox_rows(host: Inbox, pos, sub: Inbox) -> Inbox:
+    """Place sub's rows at pos (a [G] position map, -1 = keep) — gather
+    + where, not a data-dependent scatter (serial on TPU)."""
+
+    def place(a, b):
+        take = jnp.clip(pos, 0, b.shape[0] - 1)
+        picked = b[take]
+        m = (pos >= 0).reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, picked, a)
+
     return Inbox(*(
-        getattr(host, f).at[idx].set(getattr(sub, f))
-        for f in Inbox._fields
+        place(getattr(host, f), getattr(sub, f)) for f in Inbox._fields
     ))
 
 
@@ -438,16 +454,18 @@ class ColocatedVectorEngine(VectorStepEngine):
         from .engine import _gather_rows, _scatter_rows, _select_rows
 
         _select_rows(self._put(jnp.ones((G,), bool)), st, st)
+        pos0 = self._put_rows(jnp.full((G,), -1, jnp.int32))
+        mask0 = self._put_rows(jnp.zeros((G,), bool))
+        _zero_inbox_rows(self._pending, mask0)
         b = 1
         while b <= G:
             idx = self._put(jnp.zeros((b,), jnp.int32))
             sub = _gather_rows(st, idx)
-            _scatter_rows(st, idx, sub)
+            _scatter_rows(st, pos0, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             _gather_vals(st, out, idx)
-            _zero_inbox_rows(self._pending, idx)
             _scatter_inbox_rows(
-                host2, idx,
+                host2, pos0,
                 self._put(Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
                                   for f in host2))),
             )
@@ -543,7 +561,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         # (review finding: healthy replicas fail-stopped on the shifted
         # replicates); the host-excursion path only survived it because
         # drained rows stayed dirty through the next launch's alive mask.
-        self._pending = _zero_inbox_rows(self._pending, idx)
+        mask = np.zeros((self.capacity,), bool)
+        mask[[g for _, g in pairs]] = True
+        self._pending = _zero_inbox_rows(
+            self._pending, self._put_rows(jnp.asarray(mask))
+        )
 
     # -- the colocated step --------------------------------------------
     def step_shards(self, nodes, worker_id: int) -> None:
@@ -802,7 +824,9 @@ class ColocatedVectorEngine(VectorStepEngine):
             )
             host_inbox = _scatter_inbox_rows(
                 host_inbox,
-                self._put(jnp.asarray(_pad_idx([g for g, _ in sparse]))),
+                self._put_rows(jnp.asarray(
+                    _pos_map(G, [g for g, _ in sparse])
+                )),
                 self._put(sub),
             )
 
