@@ -111,6 +111,7 @@ class Node:
         "_applied_since_snapshot", "_retired_snapshots", "_apply_lock",
         "_sm_close_lock", "notify_work", "engine_apply_ready",
         "log_reader", "sm", "_stop_event", "peer", "quiesce",
+        "wake", "parked_at_tick",
     )
 
     def __init__(
@@ -270,10 +271,61 @@ class Node:
         self.quiesce = QuiesceManager(
             enabled=config.quiesce, election_timeout=config.election_rtt
         )
+        # quiesce tick-parking (see NodeHost._ticker_main): a parked
+        # node's logical clock freezes; any producer calls wake() to
+        # rejoin the active tick set and be granted the elapsed ticks
+        self.wake: Optional[Callable[[], None]] = None
+        self.parked_at_tick = 0
 
     # ------------------------------------------------------------------
     # public-API-side entry points (any thread)
     # ------------------------------------------------------------------
+    def _wake(self) -> None:
+        w = self.wake
+        if w is not None:
+            w()
+
+    def grant_ticks(self, n: int) -> None:
+        """Credit ticks that elapsed while parked (quiesce tick-parking):
+        up to one election window becomes raft ticks; the REST IS
+        DISCARDED — for this shard, parked time simply did not pass.
+        Crediting it to the gc-only clock would jump tick_count past the
+        deadline of the very request whose wake granted the ticks
+        (review finding: a request to a long-parked shard timed out
+        instantly); parking requires no outstanding futures, so no
+        deadline needs the parked interval."""
+        if n <= 0:
+            return
+        with self._qlock:
+            room = self.config.election_rtt - self._pending_ticks
+            self._pending_ticks += min(n, max(0, room))
+
+    def is_parkable(self) -> bool:
+        """True when the ticker may park this node: quiesced with no
+        queued inputs, no undrained ticks, and NO outstanding request
+        futures of any kind — a parked clock never GCs deadlines, so a
+        future left pending would block its caller forever (review
+        finding: the table must mirror has_work, not just the two hot
+        tables).  Lock-free reads — a producer racing in also calls
+        wake(), which unparks immediately."""
+        return (
+            self.quiesce.enabled
+            and self.quiesce.quiesced
+            and not self._pending_ticks
+            and not self._received
+            and not self._proposals
+            and not self._read_indexes
+            and not self._config_changes
+            and not self._cc_to_apply
+            and not self._snapshot_reqs
+            and not self._leader_transfers
+            and not self.pending_proposal._pending
+            and not self.pending_read_index._pending
+            and not self.pending_config_change._pending
+            and not self.pending_snapshot._pending
+            and not self.pending_leader_transfer._pending
+        )
+
     def add_tick(self) -> None:
         with self._qlock:
             # cap the backlog at one election window: a node stalled past
@@ -304,6 +356,7 @@ class Node:
         )
         with self._qlock:
             self._proposals.append(entry)
+        self._wake()
         return rs
 
     def propose_session_op(self, session: Session, timeout_ticks: int) -> RequestState:
@@ -312,12 +365,14 @@ class Node:
         )
         with self._qlock:
             self._proposals.append(entry)
+        self._wake()
         return rs
 
     def read_index(self, timeout_ticks: int) -> RequestState:
         ctx, rs = self.pending_read_index.read(self.tick_count + timeout_ticks)
         with self._qlock:
             self._read_indexes.append(ctx)
+        self._wake()
         return rs
 
     def request_config_change(
@@ -328,12 +383,14 @@ class Node:
         )
         with self._qlock:
             self._config_changes.append((key, cc))
+        self._wake()
         return rs
 
     def request_snapshot(self, overhead: int, timeout_ticks: int) -> RequestState:
         rs = self.pending_snapshot.request(self.tick_count + timeout_ticks)
         with self._qlock:
             self._snapshot_reqs.append((rs.key, overhead))
+        self._wake()
         return rs
 
     def request_leader_transfer(self, target: int, timeout_ticks: int) -> RequestState:
@@ -342,6 +399,7 @@ class Node:
         )
         with self._qlock:
             self._leader_transfers.append(target)
+        self._wake()
         return rs
 
     def enqueue_received(self, m: Message) -> None:
@@ -349,6 +407,7 @@ class Node:
             return  # a stopped replica drains nothing; don't grow the queue
         with self._qlock:
             self._received.append(m)
+        self._wake()
 
     def enqueue_config_change_result(self, cc, accepted: bool) -> None:
         """Called from the apply worker; consumed by step (single-writer

@@ -7,6 +7,7 @@ live here.
 """
 from __future__ import annotations
 
+import functools
 import itertools
 import threading
 import time
@@ -83,6 +84,14 @@ class NodeHost:
         config.validate()
         self.config = config
         self._nodes: Dict[int, Node] = {}  # shard_id -> node (one replica/shard)
+        # quiesce tick-parking: quiesced-idle nodes leave the active
+        # tick set entirely (their logical clocks freeze) and rejoin via
+        # node.wake() when any producer touches them — the host-side
+        # analogue of the reference's 'millions of idle groups cost ~0'
+        # (quiesce + workReady [U]); at 50k rows the flat per-tick
+        # fan-out alone was ~1M lock-ops/sec of pure Python
+        self._parked: Dict[int, Node] = {}  # shard_id -> parked node
+        self._global_ticks = 0
         self._nodes_lock = threading.RLock()
         self._closed = False
 
@@ -230,6 +239,7 @@ class NodeHost:
         with self._nodes_lock:
             nodes = list(self._nodes.values())
             self._nodes.clear()
+            self._parked.clear()
         # announce shutdown BEFORE unregistering: step engines must stop
         # letting these replicas participate (win elections, route
         # appends) while the teardown drains — in colocated mode a
@@ -257,11 +267,51 @@ class NodeHost:
         while not self._ticker_stop.wait(period):
             if self._ticks_paused:
                 continue
+            self._global_ticks += 1
             with self._nodes_lock:
-                nodes = list(self._nodes.values())
+                nodes = [
+                    n for sid, n in self._nodes.items()
+                    if sid not in self._parked
+                ]
+            ready = []
             for n in nodes:
+                if n.is_parkable():
+                    with self._nodes_lock:
+                        # re-check under the lock: a producer may have
+                        # raced a wake() between the test and the park,
+                        # and stop_shard may have removed the node — a
+                        # stale _parked entry would block all ticks to a
+                        # later start_replica of the same shard id
+                        if (
+                            n.is_parkable()
+                            and self._nodes.get(n.shard_id) is n
+                        ):
+                            n.parked_at_tick = self._global_ticks
+                            self._parked[n.shard_id] = n
+                            continue
                 n.add_tick()
-            self.engine.notify_many([n.shard_id for n in nodes])
+                ready.append(n.shard_id)
+            if ready:
+                self.engine.notify_many(ready)
+
+    def _wake_node(self, node) -> None:
+        """Producer-side unpark (node.wake): rejoin the active tick set
+        and credit the ticks that elapsed while parked."""
+        if node.shard_id not in self._parked:
+            # lock-free fast path: wake() rides EVERY producer call
+            # (propose, enqueue_received, ...); taking the host-global
+            # lock per message would reintroduce the very contention
+            # parking removes.  The race is safe: a producer appends to
+            # the node's queue BEFORE calling wake, so the ticker's
+            # under-lock is_parkable re-check sees the entry and
+            # declines to park.
+            return
+        with self._nodes_lock:
+            n = self._parked.pop(node.shard_id, None)
+        if n is not None:
+            n.grant_ticks(self._global_ticks - n.parked_at_tick)
+            if n.notify_work is not None:
+                n.notify_work()
 
     def pause_ticks(self) -> None:
         """Suspend the logical clock (mass-start tooling).
@@ -316,12 +366,14 @@ class NodeHost:
                 registry=self.registry,
             )
             self._nodes[config.shard_id] = node
+            node.wake = functools.partial(self._wake_node, node)
             self.engine.register(node)
         self.events.node_ready(NodeInfoEvent(config.shard_id, config.replica_id))
 
     def stop_shard(self, shard_id: int) -> None:
         with self._nodes_lock:
             node = self._nodes.pop(shard_id, None)
+            self._parked.pop(shard_id, None)
         if node is None:
             raise ShardNotFound(f"shard {shard_id}")
         self.engine.unregister(shard_id)

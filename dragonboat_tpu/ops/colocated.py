@@ -72,6 +72,7 @@ from .engine import (
     _R_COUNT,
     _R_ROLE,
     _bucket,
+    _place_rows,
     _pos_map,
     _gather_detail,
     _gather_vals,
@@ -199,17 +200,11 @@ def _host_inbox_from_ticks(tick_counts, *, M: int, E: int) -> Inbox:
 
 @jax.jit
 def _scatter_inbox_rows(host: Inbox, pos, sub: Inbox) -> Inbox:
-    """Place sub's rows at pos (a [G] position map, -1 = keep) — gather
-    + where, not a data-dependent scatter (serial on TPU)."""
-
-    def place(a, b):
-        take = jnp.clip(pos, 0, b.shape[0] - 1)
-        picked = b[take]
-        m = (pos >= 0).reshape((-1,) + (1,) * (a.ndim - 1))
-        return jnp.where(m, picked, a)
-
+    """Place sub's rows at pos (a [G] position map, -1 = keep) — the
+    shared pos-map gather-select (see engine._place_rows)."""
     return Inbox(*(
-        place(getattr(host, f), getattr(sub, f)) for f in Inbox._fields
+        _place_rows(getattr(host, f), getattr(sub, f), pos)
+        for f in Inbox._fields
     ))
 
 

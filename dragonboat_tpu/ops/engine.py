@@ -107,24 +107,24 @@ def _pad_idx(idx: Sequence[int]) -> np.ndarray:
     return out
 
 
+def _place_rows(a, b, pos):
+    """a's row g := b[pos[g]] where pos[g] >= 0, else unchanged — the
+    pos-map gather-select shared by every row placement (NOT
+    a.at[idx].set(): a scatter with data-dependent row indices lowers
+    to a serial per-row loop on TPU, the same pathology as
+    kernel._set_col; row uploads were ~seconds per launch)."""
+    take = jnp.clip(pos, 0, b.shape[0] - 1)
+    picked = b[take]
+    m = (pos >= 0).reshape((-1,) + (1,) * (a.ndim - 1))
+    return jnp.where(m, picked, a)
+
+
 @jax.jit
 def _scatter_rows(state: DeviceState, pos, sub: DeviceState) -> DeviceState:
     """Place sub's rows into state at the rows marked by ``pos`` — a
     [G] int32 position map (pos[g] = row of ``sub`` to take, -1 = keep
-    state's row).  Implemented as gather + where, NOT a.at[idx].set():
-    a scatter with data-dependent row indices lowers to a serial
-    per-row loop on TPU (the same pathology as kernel._set_col; row
-    uploads were ~seconds per launch), while the gather-select
-    vectorizes — the device-side traffic is one full-state sweep,
-    microseconds at 65k rows."""
-
-    def place(a, b):
-        take = jnp.clip(pos, 0, b.shape[0] - 1)
-        picked = b[take]
-        m = (pos >= 0).reshape((-1,) + (1,) * (a.ndim - 1))
-        return jnp.where(m, picked, a)
-
-    return jax.tree.map(place, state, sub)
+    state's row)."""
+    return jax.tree.map(lambda a, b: _place_rows(a, b, pos), state, sub)
 
 
 def _pos_map(G: int, gs) -> np.ndarray:

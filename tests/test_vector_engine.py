@@ -286,13 +286,23 @@ class TestVectorQuiesce:
                 raise AssertionError(
                     f"no quiet window while quiesced: {sent0} -> {sent1}"
                 )
-            # the logical clock still advances for a quiesced device row
-            # (future GC depends on it — advisor finding): ticks are
-            # swallowed before the device, but bookkeeping must run
+            # r4 semantics: a quiesced-IDLE node parks out of the tick
+            # set entirely and its logical clock FREEZES (parking
+            # requires no outstanding futures, so no deadline depends
+            # on it — see Node.is_parkable); a producer wakes it and
+            # the clock resumes
+            deadline = time.time() + 20.0
+            while time.time() < deadline and not all(
+                1 in nh._parked for nh in nhs.values()
+            ):
+                time.sleep(0.1)
+            assert all(1 in nh._parked for nh in nhs.values())
             tc0 = {r: nh._nodes[1].tick_count for r, nh in nhs.items()}
             time.sleep(0.5)
             tc1 = {r: nh._nodes[1].tick_count for r, nh in nhs.items()}
-            assert all(tc1[r] > tc0[r] for r in nhs), (tc0, tc1)
+            assert tc0 == tc1, (tc0, tc1)  # frozen while parked
+            propose_r(nhs[1], s, set_cmd("q1", b"w"))  # wakes the shard
+            assert 1 not in nhs[1]._parked
             # a proposal wakes the shard and commits
             propose_r(nhs[2], s, set_cmd("q1", b"w"), deadline=15.0)
             assert read_r(nhs[3], 1, "q1") == b"w"
