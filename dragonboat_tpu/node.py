@@ -549,7 +549,7 @@ class Node:
         for _ in range(ticks):
             self.tick_count += 1
             was_quiesced = self.quiesce.quiesced
-            if self.quiesce.tick():
+            if self.quiesce.tick(busy=self.peer.raft.catching_up_peers()):
                 if not was_quiesced:  # newly entered: drag peers along
                     self.broadcast_quiesce_enter()
                 self.peer.quiesced_tick()

@@ -66,6 +66,7 @@ from .engine import (
     _F_COUNT,
     _F_ESC,
     _F_NEED_SS,
+    _F_PEERS_BEHIND,
     _R_APPEND_LO,
     _R_BARRIER_IDX,
     _R_BARRIER_TERM,
@@ -376,6 +377,18 @@ class ColocatedVectorEngine(VectorStepEngine):
                 ents = []
             self._cache_put(r.shard_id, ents)
 
+    def _on_save_failure(self, pairs) -> None:
+        super()._on_save_failure(pairs)
+        # evict the failing nodes' rows NOW (we hold the core lock:
+        # colocated persist runs inside _step_colocated) so no further
+        # device launch routes acks for appends their WAL cannot hold;
+        # the scalar path only sends after a successful save
+        self._evict_rows_to_host([
+            g
+            for node, _u in pairs
+            if (g := self._row_of.get(self._row_key(node))) is not None
+        ])
+
     def _rebuild_tables(self) -> None:
         dest, rank = build_route_tables(
             self._host_shard, self._host_replica, self._host_peers
@@ -417,7 +430,14 @@ class ColocatedVectorEngine(VectorStepEngine):
             od[(e.index, e.term)] = e
             od.move_to_end((e.index, e.term))
         while len(od) > self._cache_depth:
-            od.popitem(last=False)
+            # evict the LOWEST index, not the FIFO-oldest: a follower
+            # catch-up re-inserts evicted low keys one batch at a time,
+            # and FIFO eviction then rolls a wave through the insert
+            # order that eventually eats the NEWEST entries — the very
+            # ones the leader's ring can still device-route, fail-
+            # stopping the follower at the last ring-window hop (r4
+            # chaos finding: wedged at last-W+2 after a 300-entry lag)
+            od.pop(min(od))
 
     def _cache_lookup(self, r, idx: int, term: int) -> Optional[Entry]:
         od = self._entry_cache.get(r.shard_id)
@@ -759,16 +779,10 @@ class ColocatedVectorEngine(VectorStepEngine):
                 for node, g, si, plan in batch:
                     _tick_bookkeeping(node, si.ticks + si.gc_ticks)
 
+        self._drain_update_retries(updates)
         if updates:
             _t0 = _time.perf_counter()
-            by_db: Dict[int, Tuple] = {}
-            for node, u in updates:
-                by_db.setdefault(id(node.logdb), (node.logdb, []))[1].append(u)
-            for db, us in by_db.values():
-                db.save_raft_state(us, worker_id)
-            for node, u in updates:
-                if node.process_update(u):
-                    node.engine_apply_ready(node.shard_id)
+            self._persist_and_process(updates, worker_id)
             self.stats["t_persist_ms"] += int(
                 (_time.perf_counter() - _t0) * 1000
             )
@@ -855,6 +869,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 )
             )
             flags = np.asarray(flags_dev)
+        self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
         rstats = np.asarray(stats_dev)
         delivered_bits = np.asarray(delivered_dev)  # [G, ceil(O/32)] u32
@@ -893,6 +908,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 meta = self._meta.get(g)
                 if meta is not None:
                     meta.dirty = True
+                    meta.set_escalation_hold(meta.node.config)
             for node, g, si in esc_batch:
                 if self._meta.get(g) is None or node.stopped:
                     continue
@@ -972,7 +988,17 @@ class ColocatedVectorEngine(VectorStepEngine):
         # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
         snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
         for node, g, si in live:
-            if node.stopped or node.stopping or self._meta.get(g) is None:
+            # a STOPPING node still merges and persists this launch's
+            # results: its device acks were already routed to peers in
+            # this very launch, and dropping the corresponding append
+            # persist would let an acked entry vanish on restart — the
+            # follower then wedges forever on the by-design
+            # reject<=match floor (r4 chaos finding: kill racing a
+            # launch left a replica acked-at-23 with a WAL at 22).
+            # Only truly STOPPED nodes (logdb closing) are skipped; the
+            # alive mask already keeps stopping rows out of the NEXT
+            # launch.
+            if node.stopped or self._meta.get(g) is None:
                 continue
             r = node.peer.raft
             base = int(self._base[g])  # the shard's shared base

@@ -55,6 +55,7 @@ from . import kernel as K
 from . import sync as S
 from .types import (
     APPEND_LO_NONE,
+    ROLE_LEADER as ROLE_LEADER_I,
     N_FIELDS as N_FIELDS_BUF,
     F_LOG_INDEX,
     F_MTYPE,
@@ -88,6 +89,10 @@ N_VALS = 10
 # TPU tunnel) costs tens of seconds — the flags word is 256 KB and the
 # steady-state gather is a few rows.
 _F_CHANGED, _F_COUNT, _F_APPEND, _F_NEED_SS, _F_ESC = 1, 2, 4, 8, 16
+# leader row with a peer lane still behind its log: quiesce entry is
+# blocked while set (see QuiesceManager.tick(busy=...)) — the scalar
+# remotes of a resident row are stale, so this must come off the device
+_F_PEERS_BEHIND = 32
 _F_ANY_LIVE = _F_CHANGED | _F_COUNT | _F_APPEND | _F_NEED_SS
 
 
@@ -165,6 +170,13 @@ def _summarize_flags(old: DeviceState, new: DeviceState, out) -> jnp.ndarray:
     f = f | jnp.where(out.append_lo != APPEND_LO_NONE, _F_APPEND, 0)
     f = f | jnp.where(jnp.any(out.need_snapshot == 1, axis=1), _F_NEED_SS, 0)
     f = f | jnp.where(out.escalate != 0, _F_ESC, 0)
+    peer_lane = (new.peer_id != 0) & (
+        jnp.arange(new.peer_id.shape[1])[None, :] != new.self_slot[:, None]
+    )
+    behind = (new.role == ROLE_LEADER_I) & jnp.any(
+        peer_lane & (new.match < new.last_index[:, None]), axis=1
+    )
+    f = f | jnp.where(behind, _F_PEERS_BEHIND, 0)
     return f.astype(I32)
 
 
@@ -285,13 +297,29 @@ def _tick_bookkeeping(node, ticks: int) -> None:
 
 
 class _RowMeta:
-    __slots__ = ("node", "dirty")
+    __slots__ = ("node", "dirty", "esc_hold")
 
     def __init__(self, node):
         self.node = node
         # dirty = the scalar Raft is authoritative and the device row is
         # stale (fresh rows, cold-stepped rows, escalated rows)
         self.dirty = True
+        # steps to HOLD the row on the scalar path after an escalation.
+        # (set via set_escalation_hold so both engines share the
+        # formula.)
+        # An escalation triggered by ROUTED-ONLY inputs discards those
+        # inputs (raft-safe for SAFETY, not for liveness): re-uploading
+        # immediately starves the scalar of the wire round-trip it needs
+        # to act — observed as an infinite probe->reject->escalate loop
+        # when a resident leader's next_idx walked below its ring window
+        # (r4 colocated chaos: a healed follower never caught up; ~3k
+        # ESC_WINDOW escalations doing nothing).  A few held steps let
+        # real wire traffic reach the scalar, which then probes from the
+        # full authoritative log.
+        self.esc_hold = 0
+
+    def set_escalation_hold(self, config) -> None:
+        self.esc_hold = max(4, 2 * config.heartbeat_rtt + 2)
 
 
 class VectorStepEngine(IStepEngine):
@@ -363,12 +391,32 @@ class VectorStepEngine(IStepEngine):
         self._warned_full = False
         # host mirrors of the summary scalars (term/vote/commit/...)
         self._mirror = np.zeros((6, capacity), np.int64)
+        # updates whose batched WAL save failed: their nodes re-emit on a
+        # later step (peer.commit never ran, so get_update regenerates
+        # the same entries/commits) — but device rows only construct
+        # updates when FLAGGED, so a failed save must force re-emission
+        # explicitly or the batch is silently lost (r4 colocated chaos
+        # finding: WAL-fault injection skipped apply batches and
+        # diverged a replica's SM)
+        self._update_retry: "set" = set()
+        self._retry_lock = threading.Lock()
+        # nodes whose last save FAILED: their rows are held on the
+        # scalar path (save-before-send) until a save succeeds — on the
+        # colocated engine a resident row's acks are device-routed in
+        # the same launch as the append, so letting it keep stepping on
+        # the device while its WAL is faulty would repeatedly expose
+        # acked-but-unpersisted entries (review finding)
+        self._save_quarantine: "set" = set()
+        # device-synced "leader has a lagging peer" bit per row (the
+        # scalar remotes of resident rows are stale) — quiesce gate
+        self._behind = np.zeros((capacity,), bool)
         self.stats = {
             "device_steps": 0,
             "device_rows_stepped": 0,
             "host_rows_stepped": 0,
             "escalations": 0,
             "divergence_halts": 0,
+            "save_failures": 0,
             "device_reads": 0,
         }
         self._warm()
@@ -569,6 +617,12 @@ class VectorStepEngine(IStepEngine):
             return None
         if si.read_indexes and not mirror_leader:
             return None
+        if node in self._save_quarantine:
+            return None  # WAL faulting: scalar path is save-before-send
+        meta = self._meta.get(g)
+        if meta is not None and meta.esc_hold > 0:
+            meta.esc_hold -= 1
+            return None  # post-escalation scalar hold (see _RowMeta)
         if node.quiesce.enabled:
             # QUIESCE enter-hints never touch raft state (node.py applies
             # them via quiesce_hint() only) — consume them HERE instead
@@ -713,7 +767,13 @@ class VectorStepEngine(IStepEngine):
             ticks = 0
             for _ in range(si.ticks):
                 was_quiesced = node.quiesce.quiesced
-                if node.quiesce.tick():
+                if node.quiesce.tick(
+                    busy=(
+                        node.peer.raft.catching_up_peers()
+                        if self._meta[g].dirty
+                        else bool(self._behind[g])
+                    )
+                ):
                     if not was_quiesced:
                         node.broadcast_quiesce_enter()
                 else:
@@ -920,11 +980,77 @@ class VectorStepEngine(IStepEngine):
                 if batch:
                     updates.extend(self._device_step(batch))
 
+        self._drain_update_retries(updates, owned={id(n) for n in nodes})
         if updates:
-            self.logdb.save_raft_state([u for _, u in updates], worker_id)
-            for node, u in updates:
+            self._persist_and_process(updates, worker_id)
+
+    def _drain_update_retries(self, updates, owned=None) -> None:
+        """Re-emit updates for nodes whose last batched save failed.
+        ``owned`` restricts the drain to nodes this worker may touch
+        (the ExecEngine partitions shards over workers); unrestricted
+        callers (the colocated engine, which owns everything under its
+        core lock) pass None."""
+        if not self._update_retry:
+            return
+        with self._retry_lock:
+            if owned is None:
+                retry, self._update_retry = self._update_retry, set()
+            else:
+                retry = {n for n in self._update_retry if id(n) in owned}
+                self._update_retry -= retry
+        have = {id(n) for n, _ in updates}
+        for node in retry:
+            if node.stopped or id(node) in have:
+                continue
+            u = node.peer.get_update(last_applied=node.sm.last_applied)
+            if u is not None:
+                node.dispatch_dropped(u)
+                updates.append((node, u))
+
+    def _persist_and_process(self, updates, worker_id: int) -> None:
+        """save -> send/apply with per-LogDB fault isolation.  A failed
+        batched save loses nothing: peer.commit(u) never ran for those
+        nodes, so their entries/commits re-emit via _drain_update_retries
+        on a later step; other LogDBs' batches still save and process
+        (one member's disk fault must not stall the cluster)."""
+        by_db: Dict[int, Tuple] = {}
+        for node, u in updates:
+            by_db.setdefault(id(node.logdb), (node.logdb, []))[1].append(
+                (node, u)
+            )
+        for db, pairs in by_db.values():
+            try:
+                db.save_raft_state([u for _, u in pairs], worker_id)
+            except Exception:  # noqa: BLE001
+                self.stats["save_failures"] += 1
+                _log.exception(
+                    "batched save failed for %d update(s); will re-emit",
+                    len(pairs),
+                )
+                self._on_save_failure(pairs)
+                continue
+            self._on_save_ok(pairs)
+            for node, u in pairs:
                 if node.process_update(u):
                     node.engine_apply_ready(node.shard_id)
+
+    def _on_save_failure(self, pairs) -> None:
+        """Queue re-emission and quarantine the nodes to the scalar
+        path until a save succeeds (see _save_quarantine)."""
+        with self._retry_lock:
+            for node, _u in pairs:
+                self._update_retry.add(node)
+                self._save_quarantine.add(node)
+        for node, _u in pairs:
+            if node.notify_work is not None:
+                node.notify_work()
+
+    def _on_save_ok(self, pairs) -> None:
+        if not self._save_quarantine:
+            return
+        with self._retry_lock:
+            for node, _u in pairs:
+                self._save_quarantine.discard(node)
 
     def _encode_batch(self, batch, slot_offset: int = 0):
         """Plans -> (per-row Message lists, staging, proposal rows).
@@ -1004,6 +1130,7 @@ class VectorStepEngine(IStepEngine):
         with annotate("raft-device-step"):
             new_state, out = K.step(old_state, inbox, out_capacity=self.O)
             flags = np.asarray(_summarize_flags(old_state, new_state, out))
+        self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["device_steps"] += 1
         self.stats["device_rows_stepped"] += len(batch)
 
@@ -1028,6 +1155,7 @@ class VectorStepEngine(IStepEngine):
                 if meta is None:  # halted + detached during materialize
                     continue
                 meta.dirty = True
+                meta.set_escalation_hold(node.config)
                 # quiesce note: _plan_device already consumed this step's
                 # quiesce ticks; the replay re-ticks the manager, which can
                 # only make the shard quiesce EARLIER — benign for a perf
@@ -1322,6 +1450,16 @@ class VectorStepEngine(IStepEngine):
                 node.handle_device_read_resp(msg)
                 continue
             if msg.type == MessageType.REPLICATE and n_ent > 0:
+                if msg.log_term == 0 and msg.log_index > 0:
+                    # below-ring send (see kernel._send_replicate): the
+                    # device couldn't resolve the prev term; stamp it
+                    # from the authoritative log
+                    try:
+                        msg = dataclasses.replace(
+                            msg, log_term=r.log.term(msg.log_index)
+                        )
+                    except Exception:  # noqa: BLE001 — compacted: drop
+                        continue
                 ents = self._replicate_payload(r, msg, n_ent)
                 if ents is None:
                     continue  # stale vs final log; dropping is raft-safe

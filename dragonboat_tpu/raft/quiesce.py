@@ -23,9 +23,20 @@ class QuiesceManager:
     def is_quiesced(self) -> bool:
         return self.quiesced
 
-    def tick(self) -> bool:
-        """Advance one tick; returns True if (now) quiesced."""
+    def tick(self, busy: bool = False) -> bool:
+        """Advance one tick; returns True if (now) quiesced.
+
+        ``busy`` blocks ENTRY (and resets the idle window) without
+        counting as wake-the-peers activity: a leader with a follower
+        still behind must keep heartbeating/probing — entering quiesce
+        mid-catch-up strands the follower forever, since nobody
+        generates the activity that would exit it (r4 colocated chaos
+        finding: heal -> cluster idles out before the slow follower
+        caught up)."""
         if not self.enabled:
+            return False
+        if busy and not self.quiesced:
+            self.idle_ticks = 0
             return False
         self.idle_ticks += 1
         if self.exit_grace > 0:

@@ -227,6 +227,22 @@ class Raft:
         out.update(self.witnesses)
         return out
 
+    def catching_up_peers(self) -> bool:
+        """Leader-side: any peer whose match is still behind our log —
+        used to BLOCK quiesce entry (entering quiesce mid-catch-up
+        strands the follower: nobody generates the activity that would
+        exit it).  reference: quiesce is activity-based in quiesce.go
+        [U]; an active catch-up generates that activity there, but a
+        stalled one must not idle the shard out here either."""
+        if self.role != RaftRole.LEADER:
+            return False
+        last = self.log.last_index()
+        for group in (self.remotes, self.non_votings, self.witnesses):
+            for pid, rm in group.items():
+                if pid != self.replica_id and rm.match < last:
+                    return True
+        return False
+
     def get_remote(self, replica_id: int) -> Optional[Remote]:
         r = self.remotes.get(replica_id)
         if r is None:

@@ -206,9 +206,32 @@ class Cluster:
                 continue
             shard, rid = key
             dev = S.decode_out_row(out_np, g, shard, rid)
+
+            def fixup(m):
+                # below-ring REPLICATE: the kernel emits log_term=0 as
+                # a host-fixup marker and the engine stamps the true
+                # prev term from the authoritative log before the
+                # message hits the wire (engine._attach_messages);
+                # apply the same fixup here so parity compares what
+                # peers would actually SEE
+                import dataclasses as _dc
+                if (
+                    m.type == MessageType.REPLICATE
+                    and m.log_term == 0
+                    and m.log_index > 0
+                ):
+                    r = self.rafts[key]
+                    try:
+                        return _dc.replace(
+                            m, log_term=r.log.term(m.log_index)
+                        )
+                    except Exception:  # noqa: BLE001
+                        return m
+                return m
+
             want = sorted(msg_key(m) for m in oracle_out[key])
             got = sorted(
-                msg_key(m)[:-1] + (n,)
+                msg_key(fixup(m))[:-1] + (n,)
                 for (m, n, _src) in dev
                 # self-addressed READ_INDEX_RESP is the kernel's
                 # host-coordination side channel (device ReadIndex);
