@@ -377,6 +377,16 @@ class ColocatedVectorEngine(VectorStepEngine):
                 ents = []
             self._cache_put(r.shard_id, ents)
 
+    def _demote_row_to_host(self, node) -> None:
+        g = self._row_of.get(self._row_key(node))
+        if g is None:
+            return
+        meta = self._meta.get(g)
+        if meta is None or meta.dirty:
+            return
+        self._evict_rows_to_host([g])  # drains pending routed traffic
+        meta.set_escalation_hold(node.config)
+
     def _on_save_failure(self, pairs) -> None:
         super()._on_save_failure(pairs)
         # evict the failing nodes' rows NOW (we hold the core lock:

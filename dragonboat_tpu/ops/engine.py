@@ -990,9 +990,17 @@ class VectorStepEngine(IStepEngine):
         (the ExecEngine partitions shards over workers); unrestricted
         callers (the colocated engine, which owns everything under its
         core lock) pass None."""
-        if not self._update_retry:
-            return
         with self._retry_lock:
+            # prune stopped nodes from both sets: a killed member's dead
+            # Node object must not be leaked (or consulted) forever
+            self._save_quarantine = {
+                n for n in self._save_quarantine if not n.stopped
+            }
+            self._update_retry = {
+                n for n in self._update_retry if not n.stopped
+            }
+            if not self._update_retry:
+                return
             if owned is None:
                 retry, self._update_retry = self._update_retry, set()
             else:
@@ -1006,6 +1014,21 @@ class VectorStepEngine(IStepEngine):
             if u is not None:
                 node.dispatch_dropped(u)
                 updates.append((node, u))
+
+    def _demote_row_to_host(self, node) -> None:
+        """Pull a resident row back to scalar authority with a short
+        hold — used when the device path hits something only the full
+        host log can resolve (e.g. a below-ring send whose prev index
+        the host has compacted)."""
+        g = self._row_of.get(self._row_key(node))
+        if g is None:
+            return
+        meta = self._meta.get(g)
+        if meta is None or meta.dirty:
+            return
+        self._materialize_rows([g])
+        meta.dirty = True
+        meta.set_escalation_hold(node.config)
 
     def _persist_and_process(self, updates, worker_id: int) -> None:
         """save -> send/apply with per-LogDB fault isolation.  A failed
@@ -1458,7 +1481,14 @@ class VectorStepEngine(IStepEngine):
                         msg = dataclasses.replace(
                             msg, log_term=r.log.term(msg.log_index)
                         )
-                    except Exception:  # noqa: BLE001 — compacted: drop
+                    except Exception:  # noqa: BLE001
+                        # prev compacted on the host: nothing below the
+                        # ring is sendable and the device's next_idx
+                        # already advanced — demote the row so the
+                        # SCALAR path (full log + its own snapshot
+                        # machinery) drives this follower; silently
+                        # dropping starves it (review finding)
+                        self._demote_row_to_host(node)
                         continue
                 ents = self._replicate_payload(r, msg, n_ent)
                 if ents is None:

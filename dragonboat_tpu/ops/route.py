@@ -183,6 +183,7 @@ def route(
     to = buf[:, :, F_TO]
     n_ent = buf[:, :, F_N_ENTRIES]
     log_index = buf[:, :, F_LOG_INDEX]
+    log_term = buf[:, :, F_LOG_TERM]
 
     valid = jnp.arange(O)[None, :] < out.count[:, None]
     n_suppressed = jnp.zeros((), I32)
@@ -206,9 +207,17 @@ def route(
     is_repl = mtype == MT_REPLICATE
     carries = is_repl & (n_ent > 0)
     win_lo = jnp.maximum(state.first_index, state.last_index - (W - 1))
+    # a log_term=0 marker on a nonzero prev is the kernel's below-ring
+    # HOST-FIXUP request (_send_replicate): the true prev term must be
+    # stamped by the sender's host before delivery.  The entries-only
+    # window check passes at prev == win_lo - 1 (entries start at
+    # prev+1), so without this the one-below-window REPLICATE would be
+    # device-delivered with a fake prev term (review finding).
+    marker = is_repl & (log_index > 0) & (log_term == 0)
     ring_ok = ~carries | (
         (log_index + 1 >= win_lo[:, None])
         & (log_index + n_ent <= state.last_index[:, None])
+        & ~marker
     )
 
     # host-only classes: forwarded PROPOSE (cmd bytes never reach the

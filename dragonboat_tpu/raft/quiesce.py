@@ -12,12 +12,14 @@ from ..pb import Message, MessageType
 
 
 class QuiesceManager:
-    __slots__ = ("enabled", "threshold", "idle_ticks", "quiesced", "exit_grace")
+    __slots__ = ("enabled", "threshold", "idle_ticks", "quiesced",
+                 "exit_grace", "busy_ticks")
     def __init__(self, enabled: bool, election_timeout: int, threshold_mult: int = 10):
         self.enabled = enabled
         self.threshold = election_timeout * threshold_mult
         self.idle_ticks = 0
         self.quiesced = False
+        self.busy_ticks = 0
         self.exit_grace = 0
 
     def is_quiesced(self) -> bool:
@@ -36,8 +38,18 @@ class QuiesceManager:
         if not self.enabled:
             return False
         if busy and not self.quiesced:
-            self.idle_ticks = 0
-            return False
+            # BOUNDED hold: an active catch-up clears busy within a few
+            # windows; a permanently dead peer never will, and holding
+            # forever would defeat 'idle groups cost nothing' for every
+            # shard with a down member (review finding).  After 3
+            # windows the shard quiesces anyway — the returning peer's
+            # first message is activity and wakes it.
+            self.busy_ticks += 1
+            if self.busy_ticks < 3 * self.threshold:
+                self.idle_ticks = 0
+                return False
+        else:
+            self.busy_ticks = 0
         self.idle_ticks += 1
         if self.exit_grace > 0:
             self.exit_grace -= 1
@@ -62,6 +74,7 @@ class QuiesceManager:
             return False
         was = self.quiesced
         self.idle_ticks = 0
+        self.busy_ticks = 0
         if self.quiesced:
             self.quiesced = False
             self.exit_grace = self.threshold
