@@ -14,6 +14,19 @@ callbacks, no dynamic shapes, no data-dependent Python control flow —
 so XLA compiles it to a single fused program that scales to 100k+ rows
 (BASELINE north star).
 
+Lane packing: the public layout keeps G (rows) on the MAJOR axis —
+``[G, P]`` peer slots, ``[G, W]`` ring, ``[G, M]`` inboxes — because
+that is the natural host-side indexing.  On TPU the MINOR axis maps to
+the 128-wide lane dimension, so a [G, P] int32 operand with P=3..8 pads
+the lanes 16-42x and every pass over the state moved that much dead
+HBM traffic (the r4 ledger's residual ~1 us/row/slot).  The kernel
+therefore runs **G-last internally**: ``step`` transposes the state,
+inbox and outbox to ``[P, G]`` / ``[W, G]`` / ``[M, G]`` /
+``[O, N_FIELDS, G]`` at the boundary (two cheap contiguous copies,
+~100 MB/launch at 300k rows) and every per-slot op streams fully packed
+lanes.  All helpers in this file expect the INTERNAL layout; the
+``step`` contract (external layout in/out) is unchanged.
+
 Escalation contract: if a row needs anything the device cannot resolve
 (log term outside the W-ring, outbox overflow, a cold message type) its
 ESC bit is set in ``out.escalate``; the host replays that row's inbox on
@@ -30,23 +43,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from .types import (
+    APPEND_LO_NONE,
     DeviceOut,
     DeviceState,
     ESC_COLD,
     ESC_INVARIANT,
     ESC_OVERFLOW,
     ESC_WINDOW,
-    F_COMMIT,
-    F_HINT,
-    F_HINT_HIGH,
-    F_LOG_INDEX,
-    F_LOG_TERM,
-    F_MTYPE,
-    F_N_ENTRIES,
-    F_REJECT,
     F_SRC_SLOT,
-    F_TERM,
-    F_TO,
     HOT_TYPES,
     I32,
     Inbox,
@@ -88,17 +92,94 @@ from .types import (
     make_out,
 )
 
+# ---------------------------------------------------------------------------
+# internal (G-last) layout plumbing
+# ---------------------------------------------------------------------------
+# state fields that carry a per-peer or per-ring axis; everything else is [G]
+_PEER_FIELDS = (
+    "peer_id",
+    "peer_kind",
+    "match",
+    "next_idx",
+    "rstate",
+    "snap_index",
+    "active",
+    "granted",
+)
+_RING_FIELDS = ("ring_term", "ring_cc")
+
+
+def _state_to_internal(st: DeviceState) -> DeviceState:
+    """[G, P] -> [P, G], [G, W] -> [W, G]; [G] fields untouched."""
+    return st._replace(
+        **{f: getattr(st, f).T for f in _PEER_FIELDS + _RING_FIELDS}
+    )
+
+
+# the transpose is its own inverse
+_state_from_internal = _state_to_internal
+
+
+def _inbox_to_internal(ib: Inbox) -> Inbox:
+    """[G, M] -> [M, G]; [G, M, E] -> [M, E, G]."""
+    return Inbox(
+        **{
+            f: (
+                getattr(ib, f).transpose(1, 2, 0)
+                if getattr(ib, f).ndim == 3
+                else getattr(ib, f).T
+            )
+            for f in Inbox._fields
+        }
+    )
+
+
+def _make_out_internal(G: int, P: int, M: int, E: int, O: int) -> DeviceOut:
+    # derived from the canonical external constructor so sentinel values
+    # (SLOT_UNUSED, APPEND_LO_NONE, barrier -1) have one source of truth;
+    # under jit the transposes of fresh constants fold away
+    return _out_to_internal(make_out(G, P, M, E, O))
+
+
+def _out_to_internal(out: DeviceOut) -> DeviceOut:
+    return out._replace(
+        buf=out.buf.transpose(1, 2, 0),
+        need_snapshot=out.need_snapshot.T,
+        slot_base=out.slot_base.T,
+        slot_term=out.slot_term.T,
+        ent_drop=out.ent_drop.transpose(1, 2, 0),
+    )
+
+
+def _out_from_internal(out: DeviceOut) -> DeviceOut:
+    return out._replace(
+        buf=out.buf.transpose(2, 0, 1),
+        need_snapshot=out.need_snapshot.T,
+        slot_base=out.slot_base.T,
+        slot_term=out.slot_term.T,
+        ent_drop=out.ent_drop.transpose(2, 0, 1),
+    )
+
+
+def _P(st: DeviceState) -> int:
+    """Peer-slot count in the internal [P, G] layout (st.P reads shape[1],
+    which is G here)."""
+    return st.peer_id.shape[0]
+
+
+def _W(st: DeviceState) -> int:
+    return st.ring_term.shape[0]
+
 
 def _w(mask, new, old):
-    """Masked field update; mask is [G], fields are [G] or [G, ...]."""
-    if old.ndim > 1:
-        mask = mask.reshape(mask.shape + (1,) * (old.ndim - 1))
+    """Masked field update; mask is [G], fields are [G] or [..., G] — the
+    trailing-G layout makes mask broadcasting automatic."""
     return jnp.where(mask, new, old)
 
 
-def _wp(mask_gp, new, old):
-    """Masked per-(row, peer) update; mask is [G, P]."""
-    return jnp.where(mask_gp, new, old)
+def _wp(mask_pg, new, old):
+    """Masked per-(peer, row) update; mask is [P, G]."""
+    return jnp.where(mask_pg, new, old)
 
 
 # ---------------------------------------------------------------------------
@@ -125,7 +206,8 @@ def _jitter(shard_id, replica_id, seq, span):
 
 
 def reset_timeout(st: DeviceState, mask) -> DeviceState:
-    """oracle: Raft._reset_randomized_timeout."""
+    """oracle: Raft._reset_randomized_timeout.  Touches only [G] fields,
+    so it works on both the external and internal layouts."""
     seq = st.timeout_seq + 1
     rt = st.election_timeout + _jitter(
         st.shard_id, st.replica_id, seq, st.election_timeout
@@ -137,7 +219,7 @@ def reset_timeout(st: DeviceState, mask) -> DeviceState:
 
 
 # ---------------------------------------------------------------------------
-# peer-slot helpers
+# peer-slot helpers (internal layout: peer arrays are [P, G])
 # ---------------------------------------------------------------------------
 def _valid(st):
     return st.peer_id != 0
@@ -151,7 +233,7 @@ def _voters(st):
 
 
 def _num_voters(st):
-    return jnp.sum(_voters(st), axis=1).astype(I32)
+    return jnp.sum(_voters(st), axis=0).astype(I32)
 
 
 def _quorum(st):
@@ -159,53 +241,72 @@ def _quorum(st):
 
 
 def _self_kind(st):
-    g = jnp.arange(st.G)
-    return st.peer_kind[g, st.self_slot]
+    return _col(st.peer_kind, st.self_slot)
 
 
 def _self_is_voter(st):
     """True when this replica currently appears as a voter slot."""
-    g = jnp.arange(st.G)
-    return (st.peer_id[g, st.self_slot] == st.replica_id) & (
+    return (_col(st.peer_id, st.self_slot) == st.replica_id) & (
         _self_kind(st) == KIND_VOTER
     )
 
 
 def _slot_of(st, pid):
     """Peer-axis slot holding replica ``pid`` [G] -> (slot [G], found [G])."""
-    hit = (st.peer_id == pid[:, None]) & _valid(st) & (pid[:, None] != 0)
-    found = jnp.any(hit, axis=1)
-    slot = jnp.argmax(hit, axis=1).astype(I32)
+    hit = (st.peer_id == pid) & _valid(st) & (pid != 0)
+    found = jnp.any(hit, axis=0)
+    slot = jnp.argmax(hit, axis=0).astype(I32)
     return slot, found
 
 
 def _col(arr, slot):
-    """arr[g, slot[g]] for [G, P] arr."""
-    return jnp.take_along_axis(arr, slot[:, None], axis=1)[:, 0]
+    """arr[slot[g], g] for [P, G] arr.
+
+    One-hot select, NOT take_along_axis: a gather with per-lane
+    data-dependent indices costs ~3.3 ms per call at 300k lanes on TPU
+    (measured r5 — it dominates the whole slot pass), while the one-hot
+    multiply-reduce over the small leading axis is fused elementwise
+    work and effectively free."""
+    onehot = jnp.arange(arr.shape[0])[:, None] == slot[None, :]
+    return jnp.sum(jnp.where(onehot, arr, 0), axis=0)
+
+
+def _permute0(a, order):
+    """a[order[j, g], ..., g] — per-lane permutation along axis 0 via
+    one-hot select (see _col: per-lane gathers serialize on TPU).
+    ``a`` is [M, G] or [M, E, G]; ``order`` is [M, G]."""
+    M = order.shape[0]
+    # sel[i, j, g] = (order[j, g] == i)
+    sel = order[None, :, :] == jnp.arange(M, dtype=order.dtype)[:, None, None]
+    if a.ndim == 2:
+        return jnp.sum(jnp.where(sel, a[:, None, :], 0), axis=0)
+    # [M, E, G]: broadcast sel over E
+    return jnp.sum(
+        jnp.where(sel[:, :, None, :], a[:, None, :, :], 0), axis=0
+    )
 
 
 def _set_col(arr, slot, mask, val):
-    # one-hot select, NOT arr.at[arange(G), slot].set(...): a scatter
+    # one-hot select, NOT arr.at[slot, arange(G)].set(...): a scatter
     # with per-row data-dependent indices lowers to a serial per-row
     # loop on TPU (measured ~100 us/row — it serialized the whole
-    # kernel); a [G, P] where() vectorizes
-    onehot = jnp.arange(arr.shape[1])[None, :] == slot[:, None]
+    # kernel); a [P, G] where() vectorizes
+    onehot = jnp.arange(arr.shape[0])[:, None] == slot[None, :]
     val = jnp.broadcast_to(jnp.asarray(val, arr.dtype), slot.shape)
-    return jnp.where(onehot & mask[:, None], val[:, None], arr)
+    return jnp.where(onehot & mask, val, arr)
 
 
 # ---------------------------------------------------------------------------
-# log-term ring
+# log-term ring (internal layout: ring arrays are [W, G])
 # ---------------------------------------------------------------------------
 def _win_lo(st):
-    return jnp.maximum(st.first_index, st.last_index - (st.W - 1))
+    return jnp.maximum(st.first_index, st.last_index - (_W(st) - 1))
 
 
 def _ring_at(st, idx):
-    wm = st.W - 1
-    g = jnp.arange(st.G)
-    safe = jnp.clip(idx, 0, None)
-    return st.ring_term[g, safe & wm], st.ring_cc[g, safe & wm]
+    wm = _W(st) - 1
+    safe = jnp.clip(idx, 0, None) & wm
+    return _col(st.ring_term, safe), _col(st.ring_cc, safe)
 
 
 def _log_term(st, idx):
@@ -240,13 +341,13 @@ def _last_term(st):
 def _ring_append_one(st, mask, idx, term, cc):
     """Write (term, cc) for log position idx where mask.  One-hot
     select over W (see _set_col: data-dependent scatter serializes)."""
-    wm = st.W - 1
+    wm = _W(st) - 1
     pos = jnp.clip(idx, 0, None) & wm
-    sel = (jnp.arange(st.W)[None, :] == pos[:, None]) & mask[:, None]
+    sel = (jnp.arange(_W(st))[:, None] == pos[None, :]) & mask
     term = jnp.broadcast_to(jnp.asarray(term, st.ring_term.dtype), pos.shape)
     cc = jnp.broadcast_to(jnp.asarray(cc, st.ring_cc.dtype), pos.shape)
-    rt = jnp.where(sel, term[:, None], st.ring_term)
-    rc = jnp.where(sel, cc[:, None], st.ring_cc)
+    rt = jnp.where(sel, term, st.ring_term)
+    rc = jnp.where(sel, cc, st.ring_cc)
     return st._replace(ring_term=rt, ring_cc=rc)
 
 
@@ -254,22 +355,22 @@ def _pending_cc_scan(st, mask):
     """Any config-change bit in (committed, last_index]?  Used by
     become_leader (oracle: _compute_pending_config_change).  Escalates if
     the uncommitted tail extends below the ring window."""
-    W = st.W
-    idxs = jnp.arange(W)[None, :]  # ring positions
+    W = _W(st)
+    idxs = jnp.arange(W)[:, None]  # ring positions, [W, 1]
     # log index currently stored at ring position j:
     # the ring holds indexes in [win_lo, last]; position j holds the unique
     # index in that range congruent to j mod W.
-    lo = _win_lo(st)[:, None]
-    last = st.last_index[:, None]
+    lo = _win_lo(st)[None, :]
+    last = st.last_index[None, :]
     cand = lo + ((idxs - lo) & (W - 1))
-    in_tail = (cand > st.committed[:, None]) & (cand <= last)
-    any_cc = jnp.any(in_tail & (st.ring_cc == 1), axis=1)
+    in_tail = (cand > st.committed[None, :]) & (cand <= last)
+    any_cc = jnp.any(in_tail & (st.ring_cc == 1), axis=0)
     esc = mask & (st.committed + 1 < _win_lo(st)) & (st.committed < st.last_index)
     return any_cc, esc
 
 
 # ---------------------------------------------------------------------------
-# outbox emission
+# outbox emission (internal layout: buf is [O, N_FIELDS, G])
 # ---------------------------------------------------------------------------
 def _emit(
     out: DeviceOut,
@@ -288,7 +389,7 @@ def _emit(
     src_slot=-1,
 ) -> DeviceOut:
     """Append one message per masked row (oracle: Raft._send)."""
-    G, O = out.buf.shape[0], out.buf.shape[1]
+    O, G = out.buf.shape[0], out.buf.shape[2]
 
     def bc(v):
         return jnp.broadcast_to(jnp.asarray(v, I32), (G,))
@@ -307,15 +408,15 @@ def _emit(
             bc(n_entries),
             bc(src_slot),
         ],
-        axis=1,
-    )  # [G, N_FIELDS]
+        axis=0,
+    )  # [N_FIELDS, G]
     idx = out.count
     can = mask & (idx < O)
     overflow = mask & (idx >= O)
     pos = jnp.clip(idx, 0, O - 1)
     # one-hot select over O (see _set_col: scatter serializes)
-    sel = (jnp.arange(O)[None, :] == pos[:, None]) & can[:, None]
-    buf = jnp.where(sel[:, :, None], row[:, None, :], out.buf)
+    sel = (jnp.arange(O)[:, None] == pos[None, :]) & can  # [O, G]
+    buf = jnp.where(sel[:, None, :], row[None, :, :], out.buf)
     return out._replace(
         buf=buf,
         count=out.count + can.astype(I32),
@@ -340,11 +441,11 @@ def _reset(st: DeviceState, mask, new_term) -> DeviceState:
     )
     st = reset_timeout(st, mask)
     # remotes: rm.reset(last+1); self slot keeps match=last
-    mgp = mask[:, None] & _valid(st)
+    mgp = mask & _valid(st)
     is_self = (
-        jnp.arange(st.P)[None, :] == st.self_slot[:, None]
+        jnp.arange(_P(st))[:, None] == st.self_slot[None, :]
     ) & mgp
-    last = st.last_index[:, None]
+    last = st.last_index[None, :]
     return st._replace(
         match=_wp(mgp, jnp.where(is_self, last, 0), st.match),
         next_idx=_wp(mgp, last + 1, st.next_idx),
@@ -385,18 +486,18 @@ def _become_candidate(st, mask) -> DeviceState:
 
 def _grant_self(st, mask):
     sel = (
-        jnp.arange(st.granted.shape[1])[None, :] == st.self_slot[:, None]
-    ) & mask[:, None]
+        jnp.arange(st.granted.shape[0])[:, None] == st.self_slot[None, :]
+    ) & mask
     return jnp.where(sel, 1, st.granted)
 
 
 def _vote_quorum(st):
-    n = jnp.sum(_voters(st) & (st.granted == 1), axis=1).astype(I32)
+    n = jnp.sum(_voters(st) & (st.granted == 1), axis=0).astype(I32)
     return n >= _quorum(st)
 
 
 def _vote_rejected(st):
-    n = jnp.sum(_voters(st) & (st.granted == 2), axis=1).astype(I32)
+    n = jnp.sum(_voters(st) & (st.granted == 2), axis=0).astype(I32)
     return n >= _quorum(st)
 
 
@@ -411,9 +512,8 @@ def _append_one(st, out, mask, cc) -> Tuple[DeviceState, DeviceOut]:
     )
     st = _ring_append_one(st, mask, new_last, st.term, cc)
     st = st._replace(last_index=_w(mask, new_last, st.last_index))
-    g = jnp.arange(st.G)
-    self_match = st.match[g, st.self_slot]
-    self_next = st.next_idx[g, st.self_slot]
+    self_match = _col(st.match, st.self_slot)
+    self_next = _col(st.next_idx, st.self_slot)
     st = st._replace(
         match=_set_col(
             st.match, st.self_slot, mask, jnp.maximum(self_match, new_last)
@@ -429,9 +529,9 @@ def _try_commit(st, out, mask) -> Tuple[DeviceState, DeviceOut, jnp.ndarray]:
     """oracle: try_commit — sorted-match quorum + current-term-only gate."""
     voters = _voters(st)
     eff = jnp.where(voters, st.match, -1)
-    s = jnp.sort(eff, axis=1)  # ascending; non-voters sink to the left
+    s = jnp.sort(eff, axis=0)  # ascending; non-voters sink to the top
     q = _quorum(st)
-    qidx = jnp.take_along_axis(s, (st.P - q)[:, None], axis=1)[:, 0]
+    qidx = _col(s, _P(st) - q)
     higher = mask & (qidx > st.committed)
     ok, esc = _match_term(st, qidx, st.term)
     out = out._replace(
@@ -459,8 +559,8 @@ def _send_replicate(st, out, mask, slot, E) -> Tuple[DeviceState, DeviceOut]:
     # compacted below the resolvable boundary -> snapshot path
     need_ss = m & (prev < st.first_index - 1)
     sel = (
-        jnp.arange(out.need_snapshot.shape[1])[None, :] == slot[:, None]
-    ) & need_ss[:, None]
+        jnp.arange(out.need_snapshot.shape[0])[:, None] == slot[None, :]
+    ) & need_ss
     out = out._replace(
         need_snapshot=jnp.where(sel, 1, out.need_snapshot)
     )
@@ -504,9 +604,9 @@ def _send_replicate(st, out, mask, slot, E) -> Tuple[DeviceState, DeviceOut]:
 
 
 def _broadcast_replicate(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
-    for p in range(st.P):
+    for p in range(_P(st)):
         slot = jnp.full((st.G,), p, I32)
-        pm = mask & _valid(st)[:, p] & (st.self_slot != p)
+        pm = mask & _valid(st)[p] & (st.self_slot != p)
         st, out = _send_replicate(st, out, pm, slot, E)
     return st, out
 
@@ -516,15 +616,15 @@ def _broadcast_heartbeat(st, out, mask, hint=0, hint_high=0) -> DeviceOut:
     pending read-index ctx ([G] or scalar): tick slots get the host's
     latest pending ctx, READ_INDEX slots their own (the device
     ReadIndex hot path — see engine)."""
-    for p in range(st.P):
-        pm = mask & _valid(st)[:, p] & (st.self_slot != p)
+    for p in range(_P(st)):
+        pm = mask & _valid(st)[p] & (st.self_slot != p)
         out = _emit(
             out,
             pm,
             mtype=MT_HEARTBEAT,
-            to=st.peer_id[:, p],
+            to=st.peer_id[p],
             term=st.term,
-            commit=jnp.minimum(st.match[:, p], st.committed),
+            commit=jnp.minimum(st.match[p], st.committed),
             hint=hint,
             hint_high=hint_high,
         )
@@ -540,7 +640,7 @@ def _become_leader(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
     # RecentActive=true at becomeLeader): with fused ticks an election
     # window can elapse in two launches — one ack round-trip — and the
     # first CheckQuorum against empty lanes deposed every winner
-    st = st._replace(active=_wp(mask[:, None] & _valid(st), 1, st.active))
+    st = st._replace(active=_wp(mask & _valid(st), 1, st.active))
     any_cc, esc = _pending_cc_scan(st, mask)
     out = out._replace(escalate=out.escalate | jnp.where(esc, ESC_WINDOW, 0))
     st = st._replace(
@@ -576,17 +676,17 @@ def _campaign(st, out, mask, pre, transfer, E) -> Tuple[DeviceState, DeviceOut]:
     out = out._replace(
         escalate=out.escalate | jnp.where(bcast_pre & lt_esc, ESC_WINDOW, 0)
     )
-    for p in range(st.P):
+    for p in range(_P(st)):
         pm = (
             bcast_pre
-            & _voters(st)[:, p]
+            & _voters(st)[p]
             & (st.self_slot != p)
         )
         out = _emit(
             out,
             pm,
             mtype=MT_REQUEST_PREVOTE,
-            to=st.peer_id[:, p],
+            to=st.peer_id[p],
             term=st.term + 1,
             log_index=st.last_index,
             log_term=lt,
@@ -602,13 +702,13 @@ def _campaign(st, out, mask, pre, transfer, E) -> Tuple[DeviceState, DeviceOut]:
         escalate=out.escalate | jnp.where(bcast & lt2_esc, ESC_WINDOW, 0)
     )
     hint = jnp.where(transfer, st.replica_id, 0)
-    for p in range(st.P):
-        pm = bcast & _voters(st)[:, p] & (st.self_slot != p)
+    for p in range(_P(st)):
+        pm = bcast & _voters(st)[p] & (st.self_slot != p)
         out = _emit(
             out,
             pm,
             mtype=MT_REQUEST_VOTE,
-            to=st.peer_id[:, p],
+            to=st.peer_id[p],
             term=st.term,
             log_index=st.last_index,
             log_term=lt2,
@@ -636,10 +736,10 @@ def _handle_election(st, out, mask, hint, E):
 # ---------------------------------------------------------------------------
 def _check_quorum(st, mask) -> DeviceState:
     voters = _voters(st)
-    is_self = jnp.arange(st.P)[None, :] == st.self_slot[:, None]
-    cnt = 1 + jnp.sum(voters & ~is_self & (st.active == 1), axis=1).astype(I32)
+    is_self = jnp.arange(_P(st))[:, None] == st.self_slot[None, :]
+    cnt = 1 + jnp.sum(voters & ~is_self & (st.active == 1), axis=0).astype(I32)
     st = st._replace(
-        active=_wp(mask[:, None] & voters, 0, st.active)
+        active=_wp(mask & voters, 0, st.active)
     )
     down = mask & (cnt < _quorum(st))
     return _become_follower(st, down, st.term, 0)
@@ -813,7 +913,7 @@ def _handle_request_prevote(st, out, msg, mask):
 # ---------------------------------------------------------------------------
 def _handle_replicate(st, out, msg, mask, slot_i):
     """oracle: _handle_replicate (follower log append + log matching)."""
-    E = int(msg["ent_term"].shape[1])
+    E = int(msg["ent_term"].shape[0])
     stale = mask & (msg["log_index"] < st.committed)
     out = _emit(
         out,
@@ -836,7 +936,7 @@ def _handle_replicate(st, out, msg, mask, slot_i):
     conflict_esc = jnp.zeros((st.G,), bool)
     for i in reversed(range(E)):
         idx = msg["log_index"] + 1 + i
-        et = msg["ent_term"][:, i]
+        et = msg["ent_term"][i]
         mt_ok, e_esc = _match_term(st, idx, et)
         has = ok & (i < n)
         conflict_off = jnp.where(has & ~mt_ok, i, conflict_off)
@@ -866,7 +966,7 @@ def _handle_replicate(st, out, msg, mask, slot_i):
         idx = msg["log_index"] + 1 + i
         wmask = has_conflict & (i >= conflict_off) & (i < n)
         st = _ring_append_one(
-            st, wmask, idx, msg["ent_term"][:, i], msg["ent_cc"][:, i]
+            st, wmask, idx, msg["ent_term"][i], msg["ent_cc"][i]
         )
     st = st._replace(
         last_index=_w(has_conflict, last_new, st.last_index)
@@ -1149,10 +1249,10 @@ def _handle_propose(st, out, msg, mask, slot_i, E):
     ent_drop = out.ent_drop
     for i in range(E):
         has = accept & (i < n)
-        is_cc = msg["ent_cc"][:, i] == 1
+        is_cc = msg["ent_cc"][i] == 1
         dropped = has & is_cc & (st.pending_cc == 1)
-        ent_drop = ent_drop.at[:, slot_i, i].set(
-            jnp.where(dropped, 1, ent_drop[:, slot_i, i])
+        ent_drop = ent_drop.at[slot_i, i].set(
+            jnp.where(dropped, 1, ent_drop[slot_i, i])
         )
         put = has & ~dropped
         st = st._replace(
@@ -1171,9 +1271,9 @@ def _handle_propose(st, out, msg, mask, slot_i, E):
     sb = jnp.where(
         accept,
         base,
-        jnp.where(drop_all, SLOT_DROPPED, out.slot_base[:, slot_i]),
+        jnp.where(drop_all, SLOT_DROPPED, out.slot_base[slot_i]),
     )
-    stm = jnp.where(accept, st.term, out.slot_term[:, slot_i])
+    stm = jnp.where(accept, st.term, out.slot_term[slot_i])
     # follower: forward to the leader; candidate/no-leader: drop
     foll = mask & (
         (st.role == ROLE_FOLLOWER)
@@ -1197,8 +1297,8 @@ def _handle_propose(st, out, msg, mask, slot_i, E):
     )
     sb = jnp.where(dropped_f, SLOT_DROPPED, sb)
     out = out._replace(
-        slot_base=out.slot_base.at[:, slot_i].set(sb),
-        slot_term=out.slot_term.at[:, slot_i].set(stm),
+        slot_base=out.slot_base.at[slot_i].set(sb),
+        slot_term=out.slot_term.at[slot_i].set(stm),
     )
     return st, out
 
@@ -1214,6 +1314,9 @@ def _is_hot(mt):
 
 
 def _process_slot(st, out, msg, slot_i, E):
+    """One inbox slot for every row.  INTERNAL layout: state peer/ring
+    arrays [P, G]/[W, G], out.buf [O, N_FIELDS, G], msg fields [G]
+    (``ent_term``/``ent_cc`` are [E, G])."""
     mask = (msg["mtype"] != 0) & (out.escalate == 0)
     mt = msg["mtype"]
     # cold types escalate the whole row
@@ -1332,10 +1435,11 @@ def _process_slot(st, out, msg, slot_i, E):
 
 
 def _slot_view(inbox: Inbox, i):
-    """Slot i of every row ([G] / [G, E] views); i may be traced."""
+    """Slot i of every row ([G] / [E, G] views) from an INTERNAL-layout
+    inbox ([M, G] / [M, E, G]); i may be traced."""
 
     def ix(a):
-        return lax.dynamic_index_in_dim(a, i, axis=1, keepdims=False)
+        return lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False)
 
     return {
         "mtype": ix(inbox.mtype),
@@ -1361,40 +1465,46 @@ def step(
     host wrapper (ops/engine.py) owns staging, payload logs and the
     escalation replay.
 
-    Slots run under ``lax.fori_loop`` so the compiled program contains
+    External layout in and out (``[G, ...]`` everywhere); internally the
+    whole loop runs G-last so int32 operands pack the 128-lane axis
+    instead of padding it 16-42x (see the module docstring).
+
+    Slots run under ``lax.while_loop`` so the compiled program contains
     ONE slot body regardless of M — compile time stays flat and XLA
     still fuses the whole body into a few kernels per slot iteration.
     """
     G, P, M, E = state.G, state.P, inbox.M, inbox.E
-    out = make_out(G, P, M, E, out_capacity)
-    # inherit the state's varying-ness (shard_map vma) so the fori_loop
-    # carry types match when the step runs sharded over the groups axis
+    state = _state_to_internal(state)
+    out = _make_out_internal(G, P, M, E, out_capacity)
+    # inherit the state's varying-ness (shard_map vma) so the loop carry
+    # types match when the step runs sharded over the groups axis; every
+    # out array is G-trailing, so a bare [G] zero broadcasts onto all
     zero = state.term * 0  # [G]
-    out = jax.tree.map(
-        lambda a: a + zero.reshape((G,) + (1,) * (a.ndim - 1)), out
-    )
+    out = jax.tree.map(lambda a: a + zero, out)
 
-    # slot compaction: a slot pass costs ~70 ms at 65k rows on a v5e
-    # regardless of content, and the assembled colocated inbox is
-    # mostly-empty routed lanes (P*budget + M slots, typically 2-6
-    # occupied).  Stable-sort each row's occupied slots to the front
-    # (empty slots are exact no-ops in _process_slot, and the stable
-    # key preserves the replay order of the occupied ones), then run
-    # only as many passes as the BUSIEST row needs.  The while_loop's
-    # data-dependent trip count replaces M static iterations.
-    occ = inbox.mtype != 0
-    order = jnp.argsort(jnp.where(occ, 0, 1), axis=1, stable=True)
+    # slot compaction: a slot pass costs the same whether the slot is
+    # empty or not, and the assembled colocated inbox is mostly-empty
+    # routed lanes (P*budget + M slots, typically 2-6 occupied).
+    # Stable-sort each row's occupied slots to the front (empty slots
+    # are exact no-ops in _process_slot, and the stable key preserves
+    # the replay order of the occupied ones), then run only as many
+    # passes as the BUSIEST row needs.  The while_loop's data-dependent
+    # trip count replaces M static iterations.
+    cin = _inbox_to_internal(inbox)
+    occ = cin.mtype != 0  # [M, G]
+    order = jnp.argsort(jnp.where(occ, 0, 1), axis=0, stable=True)
 
     def compact(a):
-        o = order.reshape(order.shape + (1,) * (a.ndim - 2))
-        return jnp.take_along_axis(a, jnp.broadcast_to(o, a.shape), axis=1)
+        # one-hot permutation, not take_along_axis (per-lane gathers
+        # serialize on TPU — see _col)
+        return _permute0(a, order)
 
-    cin = Inbox(*(compact(getattr(inbox, f)) for f in Inbox._fields))
+    cin = Inbox(*(compact(getattr(cin, f)) for f in Inbox._fields))
     # IMPORTANT: out's slot arrays (slot_base/slot_term/ent_drop and
     # src_slot lanes) are reported in COMPACTED coordinates; map them
     # back to the original slot indices afterwards so the host staging
     # keys still match.
-    n_occ = jnp.max(jnp.sum(occ.astype(jnp.int32), axis=1))
+    n_occ = jnp.max(jnp.sum(occ.astype(jnp.int32), axis=0))
 
     def cond(carry):
         i, _st, _o = carry
@@ -1407,25 +1517,25 @@ def step(
 
     _, state, out = lax.while_loop(cond, body, (jnp.int32(0), state, out))
     # un-compact the per-slot output arrays back to caller coordinates:
-    # compacted slot j of row g corresponds to original slot order[g, j]
-    inv = jnp.argsort(order, axis=1, stable=True)
+    # compacted slot j of row g corresponds to original slot order[j, g]
+    inv = jnp.argsort(order, axis=0, stable=True)
 
     def uncompact(a):
-        o = inv.reshape(inv.shape + (1,) * (a.ndim - 2))
-        return jnp.take_along_axis(a, jnp.broadcast_to(o, a.shape), axis=1)
+        return _permute0(a, inv)
 
     # src_slot values inside the outbox buffer index COMPACTED slots;
     # translate through order so the host sees original coordinates
-    src = out.buf[:, :, F_SRC_SLOT]
+    src = out.buf[:, F_SRC_SLOT, :]  # [O, G]
     src_ok = src >= 0
-    src_orig = jnp.take_along_axis(
-        order, jnp.clip(src, 0, M - 1), axis=1
-    )
-    buf = out.buf.at[:, :, F_SRC_SLOT].set(jnp.where(src_ok, src_orig, src))
+    srcc = jnp.clip(src, 0, M - 1)
+    # src_orig[o, g] = order[srcc[o, g], g] — one-hot select over M
+    sel = srcc[None, :, :] == jnp.arange(M, dtype=srcc.dtype)[:, None, None]
+    src_orig = jnp.sum(jnp.where(sel, order[:, None, :], 0), axis=0)
+    buf = out.buf.at[:, F_SRC_SLOT, :].set(jnp.where(src_ok, src_orig, src))
     out = out._replace(
         buf=buf,
         slot_base=uncompact(out.slot_base),
         slot_term=uncompact(out.slot_term),
         ent_drop=uncompact(out.ent_drop),
     )
-    return state, out
+    return _state_from_internal(state), _out_from_internal(out)

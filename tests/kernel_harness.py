@@ -35,15 +35,22 @@ O = 64
 
 
 def eager_step(state, inbox):
-    """Un-jitted slot-by-slot reference run of the kernel (debug aid)."""
-    out = T.make_out(state.G, state.P, inbox.M, inbox.E, O)
+    """Un-jitted slot-by-slot reference run of the kernel (debug aid).
+
+    _process_slot expects the kernel's INTERNAL G-last layout; transpose
+    at the boundary exactly as K.step does."""
+    state = K._state_to_internal(state)
+    out = K._make_out_internal(
+        state.G, state.peer_id.shape[0], inbox.M, inbox.E, O
+    )
+    cin = K._inbox_to_internal(inbox)
     for i in range(inbox.M):
         msg = {
-            k: jnp.asarray(np.asarray(getattr(inbox, k))[:, i])
-            for k in inbox._fields
+            k: jnp.asarray(np.asarray(getattr(cin, k))[i])
+            for k in cin._fields
         }
         state, out = K._process_slot(state, out, msg, i, inbox.E)
-    return state, out
+    return K._state_from_internal(state), K._out_from_internal(out)
 
 
 def msg_key(m: Message) -> tuple:
