@@ -171,11 +171,14 @@ def route(
             f"must equal M={M} (the inbox IS the region layout)"
         )
 
-    # NOTE on lowering: everything here is gathers, reductions and a
-    # reshape-concat — deliberately NO arbitrary-index scatter.  TPU
-    # lowers scatters with data-dependent indices to a serial loop (a
-    # measured ~20x slowdown of this routine at 300k rows); gathers
-    # vectorize.  The direct-mapped slot layout makes the inbox exactly
+    # NOTE on lowering: NO arbitrary-index scatter anywhere (TPU lowers
+    # data-dependent scatters to a serial loop — measured ~20x) and
+    # per-ELEMENT gathers are avoided too (~18 ns/element serialized,
+    # measured r5 — a dozen [G,P,B] field gathers dominated the round).
+    # The only gather left is ONE cross-row gather of packed per-sender
+    # rows (row gathers amortize to ~1 ns/element); everything else is
+    # one-hot select / reduce over a small axis.  The direct-mapped slot
+    # layout makes the inbox exactly
     # ``concat([prefill, region(r=0), ..., region(r=P-1)], axis=1)``.
 
     buf = out.buf
@@ -198,10 +201,22 @@ def route(
         & (state.peer_id[:, None, :] != 0)
     )  # [G, O, P]
     found = jnp.any(hits, axis=2)
-    p_star = jnp.argmax(hits, axis=2).astype(I32)  # [G, O]
-    dest = jnp.take_along_axis(dest_row, p_star, axis=1)  # [G, O]
     routable = valid & found
-    on_device = routable & (dest >= 0)
+
+    # per-peer destination facts, [G, P] (static tables — elementwise)
+    dest_ge0 = dest_row >= 0
+    dest_not_self = dest_row != jnp.arange(G)[:, None]
+    if dest_alive is not None:
+        # [G, P] per-element gather over the static table: tiny next to
+        # the per-message alternative (dest_alive[dest] was [G, O])
+        alive_tab = dest_alive[jnp.clip(dest_row, 0, G - 1)] & dest_ge0
+    else:
+        alive_tab = dest_ge0
+
+    def at_pstar(tab):  # tab [G, P] -> per-message [G, O] via the one-hot
+        return jnp.any(hits & tab[:, None, :], axis=2)
+
+    on_device = routable & at_pstar(dest_ge0)
 
     # deliverability per MESSAGE (sender side; used for selection + stats)
     is_repl = mtype == MT_REPLICATE
@@ -224,12 +239,7 @@ def route(
     # device) and self-addressed coordination messages; plus messages
     # whose destination row is currently host-authoritative (dirty)
     not_propose = mtype != MT_PROPOSE
-    not_self = dest != jnp.arange(G)[:, None]
-    if dest_alive is not None:
-        dst_ok = dest_alive[jnp.clip(dest, 0, G - 1)] & (dest >= 0)
-    else:
-        dst_ok = dest >= 0
-    msg_ok = not_propose & not_self & dst_ok
+    msg_ok = not_propose & at_pstar(dest_not_self) & at_pstar(alive_tab)
 
     # per-sender emission index toward each peer slot, counted over
     # DELIVERABLE messages only — host-carried/ring-stale messages must
@@ -238,39 +248,93 @@ def route(
     deliverable = valid & ring_ok & msg_ok  # [G, O]
     oh = (hits & deliverable[:, :, None]).astype(I32)  # [G, O, P]
     k_excl = jnp.cumsum(oh, axis=1) - oh
-    k = jnp.take_along_axis(k_excl, p_star[:, :, None], axis=2)[:, :, 0]
+    k = jnp.sum(jnp.where(hits, k_excl, 0), axis=2)  # k_excl at p_star
 
-    # o_sel[g, p, b] = outbox index of g's b-th deliverable message to
-    # peer slot p (selection is pure argmax over one-hot masks, no scatter)
+    # SENDER-side selection + packing.  m_b (at most one outbox slot per
+    # (g, p, b)) doubles as the one-hot selector for every field — no
+    # o_sel index materialization, no per-element field gathers.
     sendable = hits & deliverable[:, :, None]  # [G, O, P]
-    o_cols = []
-    f_cols = []
+    sel_b = []
     for b in range(B):
-        m_b = sendable & (k_excl == b)  # at most one o per (g, p)
-        f_cols.append(jnp.any(m_b, axis=1))          # [G, P]
-        o_cols.append(jnp.argmax(m_b, axis=1))       # [G, P]
-    o_sel = jnp.stack(o_cols, axis=2).astype(I32)    # [G, P, B]
-    o_found = jnp.stack(f_cols, axis=2)              # [G, P, B]
+        sel_b.append(sendable & (k_excl == b))
+    send_sel = jnp.stack(sel_b, axis=3)  # [G, O, P, B]
+    pick_found = jnp.any(send_sel, axis=1)  # [G, P, B]
+
+    def pick(col):  # [G, P, B]: buf[g, o_sel[g,p,b], col] via one-hot
+        return jnp.sum(
+            jnp.where(send_sel, buf[:, :, col][:, :, None, None], 0),
+            axis=1,
+        )
+
+    wire_cols = (
+        F_MTYPE, F_TERM, F_LOG_TERM, F_LOG_INDEX, F_COMMIT,
+        F_REJECT, F_HINT, F_HINT_HIGH, F_N_ENTRIES,
+    )
+    picked = {c: pick(c) for c in wire_cols}
+
+    # REPLICATE payload, sender-side: ring terms/cc at [li+1, li+n] via
+    # one-hot over the W ring positions (per-element ring gathers were
+    # the single most expensive op of the old route)
+    li_pb = picked[F_LOG_INDEX]
+    n_pb = picked[F_N_ENTRIES]
+    repl_pb = pick_found & (picked[F_MTYPE] == MT_REPLICATE)
+    wm = W - 1
+    went = []
+    for e in range(E):
+        pos = (jnp.clip(li_pb + 1 + e, 0, None) & wm)  # [G, P, B]
+        selw = (
+            pos[:, :, :, None] == jnp.arange(W)[None, None, None, :]
+        )  # [G, P, B, W]
+        has_e = repl_pb & (e < n_pb)
+        et = jnp.sum(
+            jnp.where(selw, state.ring_term[:, None, None, :], 0), axis=3
+        )
+        ec = jnp.sum(
+            jnp.where(selw, state.ring_cc[:, None, None, :], 0), axis=3
+        )
+        went.append((
+            jnp.where(has_e, et, 0), jnp.where(has_e, ec, 0),
+        ))
+    ent_term_s = jnp.stack([t for t, _ in went], axis=3)  # [G, P, B, E]
+    ent_cc_s = jnp.stack([c for _, c in went], axis=3)
+
+    # pack everything a receiver needs into one row per (sender, slot):
+    # 9 wire fields + found + from_id + E terms + E cc bits
+    from_pb = jnp.broadcast_to(
+        state.replica_id[:, None, None], (G, P, B)
+    )
+    pack = jnp.stack(
+        [picked[c] for c in wire_cols]
+        + [pick_found.astype(I32), from_pb],
+        axis=3,
+    )  # [G, P, B, 11]
+    # packed-row layout (single source of truth for the unpack below)
+    IDX_FOUND = len(wire_cols)      # found flag
+    IDX_FROM = len(wire_cols) + 1   # sender replica id
+    KF = len(wire_cols) + 2         # ent_term starts here
+    pack = jnp.concatenate([pack, ent_term_s, ent_cc_s], axis=3)
+    KT = KF + 2 * E
+    packr = pack.reshape(G * P, B * KT)
 
     # dest-side assembly: for dest d, region r is fed by the replica in
     # d's peer slot r; in THAT sender's table, d occupies slot
-    # rank_in_dest[d, r] (the mapping is symmetric by construction)
+    # rank_in_dest[d, r] (the mapping is symmetric by construction).
+    # ONE cross-row row-gather moves the packed rows.
     src = dest_row                                   # [G, P] (as dest view)
     src_ok = src >= 0
     src_c = jnp.clip(src, 0, G - 1)
-    p_back = rank_in_dest                            # [G, P]
-
-    sel_o = o_sel[src_c, p_back]                     # [G, P, B]
-    sel_found = o_found[src_c, p_back] & src_ok[:, :, None]
+    flat = (src_c * P + rank_in_dest).reshape(-1)    # [G*P]
+    region = packr[flat].reshape(G, P, B, KT)
     # region r of row d must not be fed by d itself (its own slot)
-    not_self = src_c != jnp.arange(G)[:, None]
-    sel_found = sel_found & not_self[:, :, None]
+    not_self_d = src_c != jnp.arange(G)[:, None]
+    sel_found = (
+        (region[:, :, :, IDX_FOUND] != 0)
+        & src_ok[:, :, None]
+        & not_self_d[:, :, None]
+    )  # [G, P, B]
 
-    src_rb = jnp.broadcast_to(src_c[:, :, None], (G, P, B))
-
-    def field(col):  # [G, P, B] gather of one outbox field
-        v = buf[src_rb, sel_o, col]
-        return jnp.where(sel_found, v, 0).reshape(G, P * B)
+    def field(i):  # unpack + mask + flatten one received field
+        return jnp.where(sel_found, region[:, :, :, i], 0).reshape(G, P * B)
 
     if base_inbox is None:
         base_inbox = make_prefill(state, M, E, tick=False)
@@ -279,35 +343,23 @@ def route(
         "reject", "hint", "hint_high", "n_entries",
     )}
 
+    col_at = {c: i for i, c in enumerate(wire_cols)}
+
     def asm(name, col):
-        return jnp.concatenate([pre[name], field(col)], axis=1)
+        return jnp.concatenate([pre[name], field(col_at[col])], axis=1)
 
-    li_rb = buf[src_rb, sel_o, F_LOG_INDEX]
-    n_rb = buf[src_rb, sel_o, F_N_ENTRIES]
-    mt_rb = buf[src_rb, sel_o, F_MTYPE]
-    # REPLICATE payload: the sender's ring terms/cc at [li+1, li+n]
-    idxs = li_rb[:, :, :, None] + 1 + jnp.arange(E)[None, None, None, :]
-    # per-element gather ring_term[src, pos] (gathers vectorize on TPU)
-    flat_src = jnp.broadcast_to(
-        src_rb[:, :, :, None], (G, P, B, E)
-    ).reshape(-1)
-    flat_pos = (jnp.clip(idxs, 0, None) & (W - 1)).reshape(-1)
-    ent_term = state.ring_term[flat_src, flat_pos].reshape(G, P, B, E)
-    ent_cc = state.ring_cc[flat_src, flat_pos].reshape(G, P, B, E)
-    ent_mask = (
-        sel_found
-        & (mt_rb == MT_REPLICATE)
-    )[:, :, :, None] & (jnp.arange(E)[None, None, None, :] < n_rb[:, :, :, None])
-    ent_term = jnp.where(ent_mask, ent_term, 0).reshape(G, P * B, E)
-    ent_cc = jnp.where(ent_mask, ent_cc, 0).reshape(G, P * B, E)
-
-    from_rb = jnp.where(
-        sel_found, state.replica_id[src_c][:, :, None], 0
-    ).reshape(G, P * B)
+    ent_term = jnp.where(
+        sel_found[:, :, :, None], region[:, :, :, KF:KF + E], 0
+    ).reshape(G, P * B, E)
+    ent_cc = jnp.where(
+        sel_found[:, :, :, None], region[:, :, :, KF + E:KT], 0
+    ).reshape(G, P * B, E)
 
     inbox = Inbox(
         mtype=asm("mtype", F_MTYPE),
-        from_id=jnp.concatenate([pre["from_id"], from_rb], axis=1),
+        from_id=jnp.concatenate(
+            [pre["from_id"], field(IDX_FROM)], axis=1
+        ),
         term=asm("term", F_TERM),
         log_term=asm("log_term", F_LOG_TERM),
         log_index=asm("log_index", F_LOG_INDEX),
@@ -327,7 +379,9 @@ def route(
     delivered = valid & found & ring_ok & msg_ok & in_budget  # [G, O]
     stats = RouteStats(
         delivered=jnp.sum(sel_found, dtype=I32),
-        dropped_off_device=jnp.sum(routable & (dest < 0), dtype=I32),
+        dropped_off_device=jnp.sum(
+            routable & ~at_pstar(dest_ge0), dtype=I32
+        ),
         dropped_budget=jnp.sum(
             on_device & msg_ok & ring_ok & ~in_budget, dtype=I32
         ),
