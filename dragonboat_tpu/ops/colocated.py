@@ -384,7 +384,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         meta = self._meta.get(g)
         if meta is None or meta.dirty:
             return
-        self._evict_rows_to_host([g])  # drains pending routed traffic
+        self._evict_rows_to_host([g], "demote")  # drains pending routed traffic
         meta.set_escalation_hold(node.config)
 
     def _on_save_failure(self, pairs) -> None:
@@ -397,7 +397,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             g
             for node, _u in pairs
             if (g := self._row_of.get(self._row_key(node))) is not None
-        ])
+        ], "save_failure")
 
     def _rebuild_tables(self) -> None:
         dest, rank = build_route_tables(
@@ -499,7 +499,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         _set_remote_snapshot(st, one, one, one)
         jax.block_until_ready(self._state)
 
-    def _evict_rows_to_host(self, gs) -> None:
+    def _evict_rows_to_host(self, gs, cause: str = "other") -> None:
         """Move resident rows to the host path losing nothing.  Order is
         a correctness invariant encoded ONCE here: drain each row's
         routed-but-unconsumed inbox traffic into its node's receive
@@ -517,6 +517,9 @@ class ColocatedVectorEngine(VectorStepEngine):
                 pairs.append((meta.node, g))
         if not pairs:
             return
+        self.stats[f"evict_{cause}"] = (
+            self.stats.get(f"evict_{cause}", 0) + len(pairs)
+        )
         self._drain_pending_to_host(pairs)
         self._materialize_rows([g for _, g in pairs])
         for _, g in pairs:
@@ -665,7 +668,8 @@ class ColocatedVectorEngine(VectorStepEngine):
         # drain/materialize thrash): back off until committed grows by
         # another chunk.
         self._evict_rows_to_host(
-            [g for (shard, _), g in self._row_of.items() if shard in need]
+            [g for (shard, _), g in self._row_of.items() if shard in need],
+            "rebase",
         )
         for shard in need:
             rafts = [
@@ -750,7 +754,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             g
             for node, _si in host_rows
             if (g := self._row_of.get(self._row_key(node))) is not None
-        ])
+        ], "host_plan")
 
         # host path runs under the core lock in colocated mode: update
         # construction for OTHER hosts' rows happens inside launches, so
@@ -1109,7 +1113,9 @@ class ColocatedVectorEngine(VectorStepEngine):
             # VectorStepEngine._send_snapshots): these rows take a host
             # excursion until the install resolves; drain their routed
             # traffic first so the transition loses no messages
-            self._evict_rows_to_host(sorted({t[0] for t in below}))
+            self._evict_rows_to_host(
+                sorted({t[0] for t in below}), "snapshot_below"
+            )
             for g, p, _, pid, ss_index in below:
                 meta = self._meta.get(g)
                 if meta is None or meta.node.stopped:

@@ -789,25 +789,34 @@ class VectorStepEngine(IStepEngine):
         """Scalar -> device for dirty rows (batched scatter)."""
         if not rows:
             return
+        import time as _time
+
+        _t0 = _time.perf_counter()
         for _, r in rows:
             if r.role == RaftRole.LEADER and r.check_quorum:
                 self._cq_grace(r)
         bases = [int(self._base[g]) for g, _ in rows]
+        # padding happens in numpy INSIDE state_from_rafts: the old
+        # eager jnp slice/repeat/concat per field compiled ~93 tiny
+        # programs per new bucket shape on the remote TPU link
         sub = S.state_from_rafts(
-            [r for _, r in rows], self.P, self.W, bases=bases
+            [r for _, r in rows], self.P, self.W, bases=bases,
+            pad_to=_bucket(len(rows)),
         )
-        pad = _bucket(len(rows))
-        if pad > len(rows):
-            sub = jax.tree.map(
-                lambda a: jnp.concatenate(
-                    [a, jnp.repeat(a[-1:], pad - a.shape[0], axis=0)]
-                ),
-                sub,
-            )
+        self.stats["uploaded_rows"] = (
+            self.stats.get("uploaded_rows", 0) + len(rows)
+        )
+        self.stats["t_up_pack_ms"] = self.stats.get(
+            "t_up_pack_ms", 0
+        ) + int((_time.perf_counter() - _t0) * 1000)
+        _t0 = _time.perf_counter()
         pos = self._put_rows(jnp.asarray(
             _pos_map(self.capacity, [g for g, _ in rows])
         ))
         self._state = _scatter_rows(self._state, pos, self._put(sub))
+        self.stats["t_up_scatter_ms"] = self.stats.get(
+            "t_up_scatter_ms", 0
+        ) + int((_time.perf_counter() - _t0) * 1000)
         for k, (g, r) in enumerate(rows):
             # the mirror holds what the DEVICE holds: index rows shifted
             self._mirror[_R_TERM, g] = r.term

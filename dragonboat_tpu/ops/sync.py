@@ -39,7 +39,7 @@ from .types import (
     KIND_VOTER,
     KIND_WITNESS,
     Inbox,
-    make_state,
+    make_state_np,
 )
 
 import jax.numpy as jnp
@@ -60,6 +60,7 @@ def peer_layout(raft: Raft) -> List[Tuple[int, int]]:
 def state_from_rafts(
     rafts: Sequence[Raft], P: int, W: int,
     bases: Optional[Sequence[int]] = None,
+    pad_to: int = 0,
 ) -> DeviceState:
     """Pack oracles into a DeviceState, copying the full volatile state
     (not just a fresh boot) so escalated rows can return to the device.
@@ -71,9 +72,18 @@ def state_from_rafts(
     a rebased window).  Each base MUST be a multiple of W so the ring
     slot of an index is invariant under the shift ((abs-base) % W ==
     abs % W), and must not exceed any live index quantity of its row.
+
+    ``pad_to``: pad the row axis to this length by repeating the last
+    row, IN NUMPY — callers used to pad with eager jnp slice/repeat/
+    concat per field, and on a remote TPU link every first-per-shape
+    eager op is a fresh tiny compile (~31 fields x 3 ops x ~0.4 s ate
+    46% of the r4 10k-shard election as "upload" time).
     """
     G = len(rafts)
-    st = make_state(
+    # pure-NUMPY staging end to end: make_state_np never touches the
+    # device, so packing costs no device->host readbacks (31 per batch
+    # before — the dominant upload cost on a remote TPU link, r4 SCALE)
+    base_cols = make_state_np(
         G,
         P,
         W,
@@ -84,7 +94,7 @@ def state_from_rafts(
     )
     # int64 staging: absolute indexes may exceed int32 before the shift
     cols: Dict[str, np.ndarray] = {
-        k: np.array(getattr(st, k), dtype=np.int64) for k in st._fields
+        k: v.astype(np.int64) for k, v in base_cols.items()
     }
     for g, r in enumerate(rafts):
         _fill_row(cols, g, r, P, W)
@@ -107,7 +117,12 @@ def state_from_rafts(
             raise OverflowError(
                 f"state field {k} exceeds int32 after rebase"
             )
-        out[k] = v.astype(np.int32)
+        v = v.astype(np.int32)
+        if pad_to > v.shape[0]:
+            v = np.concatenate(
+                [v, np.repeat(v[-1:], pad_to - v.shape[0], axis=0)]
+            )
+        out[k] = v
     return DeviceState(**{k: jnp.asarray(v) for k, v in out.items()})
 
 

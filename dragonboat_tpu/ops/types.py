@@ -245,7 +245,19 @@ class DeviceOut(NamedTuple):
 # ---------------------------------------------------------------------------
 # constructors
 # ---------------------------------------------------------------------------
-def make_state(
+def _splitmix32_np(x: np.ndarray) -> np.ndarray:
+    """Numpy mirror of kernel._splitmix32 — bit-identical uint32 math."""
+    with np.errstate(over="ignore"):
+        z = x.astype(np.uint32) + np.uint32(0x9E3779B9)
+        z = z ^ (z >> np.uint32(16))
+        z = z * np.uint32(0x85EBCA6B)
+        z = z ^ (z >> np.uint32(13))
+        z = z * np.uint32(0xC2B2AE35)
+        z = z ^ (z >> np.uint32(16))
+    return z
+
+
+def make_state_np(
     G: int,
     P: int,
     W: int,
@@ -258,12 +270,16 @@ def make_state(
     heartbeat_timeout: int = 1,
     check_quorum: bool = False,
     pre_vote: bool = False,
-) -> DeviceState:
-    """Fresh state for G rows.
+) -> dict:
+    """``make_state`` as a pure-NUMPY field dict (same values bit for
+    bit, including the constructor timeout jitter).
 
-    ``peer_ids`` is [G, P] with 0 marking empty slots; ``replica_ids`` must
-    appear in their own row's slots.  Fresh rows start as followers at
-    term 0 with an empty log, exactly like ``Raft.__init__``.
+    This exists for the host staging path: ``state_from_rafts`` packs
+    scalar oracles on the host and must never round-trip through the
+    device — building jnp arrays here and reading them back cost ~31
+    device->host readbacks per upload batch, which on a remote TPU link
+    was the single largest launch cost at scale (r4 SCALE:
+    t_upload_ms = 46% of a 10k-shard election).
     """
     if W & (W - 1):
         raise ValueError(f"W must be a power of two, got {W}")
@@ -286,43 +302,67 @@ def make_state(
         np.int32
     )
     valid = peer_ids != 0
-    st = DeviceState(
-        shard_id=jnp.asarray(shard_ids),
-        replica_id=jnp.asarray(replica_ids),
-        self_slot=jnp.asarray(self_slot),
-        election_timeout=jnp.full((G,), election_timeout, I32),
-        heartbeat_timeout=jnp.full((G,), heartbeat_timeout, I32),
-        check_quorum=jnp.full((G,), int(check_quorum), I32),
-        pre_vote=jnp.full((G,), int(pre_vote), I32),
-        term=jnp.asarray(zg),
-        vote=jnp.asarray(zg),
-        leader_id=jnp.asarray(zg),
-        role=jnp.asarray(_initial_roles(replica_ids, peer_ids, peer_kinds)),
-        committed=jnp.asarray(zg),
-        last_index=jnp.asarray(zg),
-        first_index=jnp.ones((G,), I32),
-        base_term=jnp.asarray(zg),
-        election_tick=jnp.asarray(zg),
-        heartbeat_tick=jnp.asarray(zg),
-        rand_timeout=jnp.full((G,), election_timeout, I32),
-        timeout_seq=jnp.asarray(zg),
-        pending_cc=jnp.asarray(zg),
-        transfer_target=jnp.asarray(zg),
-        peer_id=jnp.asarray(peer_ids),
-        peer_kind=jnp.asarray(np.where(valid, peer_kinds, 0)),
-        match=jnp.asarray(zgp),
-        next_idx=jnp.asarray(np.where(valid, 1, 0).astype(np.int32)),
-        rstate=jnp.asarray(zgp),
-        snap_index=jnp.asarray(zgp),
-        active=jnp.asarray(zgp),
-        granted=jnp.asarray(zgp),
-        ring_term=jnp.zeros((G, W), I32),
-        ring_cc=jnp.zeros((G, W), I32),
+    et = np.full((G,), election_timeout, np.int32)
+    # match Raft.__init__: the constructor resets the randomized timeout
+    # once (kernel.reset_timeout with seq 0 -> 1), in numpy
+    seq = np.ones((G,), np.int32)
+    h = _splitmix32_np(
+        (shard_ids.astype(np.uint32) << np.uint32(24))
+        ^ (replica_ids.astype(np.uint32) << np.uint32(8))
+        ^ seq.astype(np.uint32)
     )
-    # match Raft.__init__: the constructor resets the randomized timeout once
-    from .kernel import reset_timeout  # local import to avoid cycle
+    rand_timeout = (et + (h % et.astype(np.uint32)).astype(np.int32)).astype(
+        np.int32
+    )
+    return dict(
+        shard_id=shard_ids,
+        replica_id=replica_ids,
+        self_slot=self_slot,
+        election_timeout=et,
+        heartbeat_timeout=np.full((G,), heartbeat_timeout, np.int32),
+        check_quorum=np.full((G,), int(check_quorum), np.int32),
+        pre_vote=np.full((G,), int(pre_vote), np.int32),
+        term=zg.copy(),
+        vote=zg.copy(),
+        leader_id=zg.copy(),
+        role=_initial_roles(replica_ids, peer_ids, peer_kinds),
+        committed=zg.copy(),
+        last_index=zg.copy(),
+        first_index=np.ones((G,), np.int32),
+        base_term=zg.copy(),
+        election_tick=zg.copy(),
+        heartbeat_tick=zg.copy(),
+        rand_timeout=rand_timeout,
+        timeout_seq=seq,
+        pending_cc=zg.copy(),
+        transfer_target=zg.copy(),
+        peer_id=peer_ids,
+        peer_kind=np.where(valid, peer_kinds, 0).astype(np.int32),
+        match=zgp.copy(),
+        next_idx=np.where(valid, 1, 0).astype(np.int32),
+        rstate=zgp.copy(),
+        snap_index=zgp.copy(),
+        active=zgp.copy(),
+        granted=zgp.copy(),
+        ring_term=np.zeros((G, W), np.int32),
+        ring_cc=np.zeros((G, W), np.int32),
+    )
 
-    return reset_timeout(st, jnp.ones((G,), bool))
+
+def make_state(
+    G: int,
+    P: int,
+    W: int,
+    **kw,
+) -> DeviceState:
+    """Fresh state for G rows.
+
+    ``peer_ids`` is [G, P] with 0 marking empty slots; ``replica_ids`` must
+    appear in their own row's slots.  Fresh rows start as followers at
+    term 0 with an empty log, exactly like ``Raft.__init__``.
+    """
+    cols = make_state_np(G, P, W, **kw)
+    return DeviceState(**{k: jnp.asarray(v) for k, v in cols.items()})
 
 
 def _initial_roles(replica_ids, peer_ids, peer_kinds):
