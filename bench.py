@@ -408,9 +408,27 @@ def phase_c(jax, SHARDS: int, duration: float, *, inflight: int = 4,
                         pending.append((rs, _time.time(), s))
                         by_shard[s] = by_shard.get(s, 0) + 1
                         issued += 1
-                if not issued:
-                    _time.sleep(0.001)
+                # unconditional yield: a spin loop here steals the one
+                # CPU from the engine threads under test (review
+                # finding); completions arrive per engine generation
+                # (ms-scale), so a 1 ms pace costs no throughput
+                _time.sleep(0.001)
                 counts[w] = done
+            # drain the in-flight tail so late commits are counted and
+            # no live futures outlast NodeHost close (review finding)
+            drain_end = _time.time() + 10.0
+            while pending and _time.time() < drain_end:
+                still = []
+                for rs, t_sub, s in pending:
+                    if rs._event.is_set():
+                        if rs.code == 1:
+                            done += 1
+                    else:
+                        still.append((rs, t_sub, s))
+                pending = still
+                if pending:
+                    _time.sleep(0.01)
+            counts[w] = done
 
         # cycle-exact latency probe: a dedicated thread issuing SERIAL
         # sync proposals to a few shards under the full ambient load —

@@ -516,7 +516,12 @@ class Node:
         if self.quiesce.enabled:
             for m in received:
                 if m.type == MessageType.QUIESCE:
-                    self.quiesce.quiesce_hint()
+                    # no-leader gate (QuiesceManager.tick block=): never
+                    # join a peer's quiesce while leaderless — parking a
+                    # shard mid-election freezes the churn that would
+                    # produce the leader
+                    if self.peer.raft.leader_id:
+                        self.quiesce.quiesce_hint()
                 elif self.quiesce.record_activity(m.type):
                     self._poke_peers_out_of_quiesce()
             if proposals or read_indexes or config_changes or transfers:
@@ -549,7 +554,10 @@ class Node:
         for _ in range(ticks):
             self.tick_count += 1
             was_quiesced = self.quiesce.quiesced
-            if self.quiesce.tick(busy=self.peer.raft.catching_up_peers()):
+            if self.quiesce.tick(
+                busy=self.peer.raft.catching_up_peers(),
+                block=self.peer.raft.leader_id == 0,
+            ):
                 if not was_quiesced:  # newly entered: drag peers along
                     self.broadcast_quiesce_enter()
                 self.peer.quiesced_tick()

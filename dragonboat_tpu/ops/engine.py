@@ -637,7 +637,16 @@ class VectorStepEngine(IStepEngine):
             kept = []
             for m in si.received:
                 if int(m.type) == int(MessageType.QUIESCE):
-                    node.quiesce.quiesce_hint()
+                    # no-leader gate (see QuiesceManager.tick block=):
+                    # joining a peer's quiesce while this node knows no
+                    # leader can park a shard mid-election
+                    leader = (
+                        node.peer.raft.leader_id
+                        if self._meta[g].dirty
+                        else int(self._mirror[_R_LEADER, g])
+                    )
+                    if leader:
+                        node.quiesce.quiesce_hint()
                 else:
                     kept.append(m)
             si.received = kept
@@ -765,15 +774,15 @@ class VectorStepEngine(IStepEngine):
             if si.proposals:
                 node.quiesce.record_activity(MessageType.PROPOSE)
             ticks = 0
+            if self._meta[g].dirty:
+                busy = node.peer.raft.catching_up_peers()
+                no_leader = node.peer.raft.leader_id == 0
+            else:
+                busy = bool(self._behind[g])
+                no_leader = int(self._mirror[_R_LEADER, g]) == 0
             for _ in range(si.ticks):
                 was_quiesced = node.quiesce.quiesced
-                if node.quiesce.tick(
-                    busy=(
-                        node.peer.raft.catching_up_peers()
-                        if self._meta[g].dirty
-                        else bool(self._behind[g])
-                    )
-                ):
+                if node.quiesce.tick(busy=busy, block=no_leader):
                     if not was_quiesced:
                         node.broadcast_quiesce_enter()
                 else:
@@ -806,9 +815,12 @@ class VectorStepEngine(IStepEngine):
         self.stats["uploaded_rows"] = (
             self.stats.get("uploaded_rows", 0) + len(rows)
         )
+        # float ms: mass start streams thousands of sub-ms batches and
+        # int truncation would hide exactly the cost this counter exists
+        # to expose (review finding)
         self.stats["t_up_pack_ms"] = self.stats.get(
             "t_up_pack_ms", 0
-        ) + int((_time.perf_counter() - _t0) * 1000)
+        ) + (_time.perf_counter() - _t0) * 1000.0
         _t0 = _time.perf_counter()
         pos = self._put_rows(jnp.asarray(
             _pos_map(self.capacity, [g for g, _ in rows])
@@ -816,7 +828,7 @@ class VectorStepEngine(IStepEngine):
         self._state = _scatter_rows(self._state, pos, self._put(sub))
         self.stats["t_up_scatter_ms"] = self.stats.get(
             "t_up_scatter_ms", 0
-        ) + int((_time.perf_counter() - _t0) * 1000)
+        ) + (_time.perf_counter() - _t0) * 1000.0
         for k, (g, r) in enumerate(rows):
             # the mirror holds what the DEVICE holds: index rows shifted
             self._mirror[_R_TERM, g] = r.term

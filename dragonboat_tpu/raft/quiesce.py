@@ -25,7 +25,7 @@ class QuiesceManager:
     def is_quiesced(self) -> bool:
         return self.quiesced
 
-    def tick(self, busy: bool = False) -> bool:
+    def tick(self, busy: bool = False, block: bool = False) -> bool:
         """Advance one tick; returns True if (now) quiesced.
 
         ``busy`` blocks ENTRY (and resets the idle window) without
@@ -34,8 +34,20 @@ class QuiesceManager:
         mid-catch-up strands the follower forever, since nobody
         generates the activity that would exit it (r4 colocated chaos
         finding: heal -> cluster idles out before the slow follower
-        caught up)."""
+        caught up).
+
+        ``block`` blocks entry UNBOUNDEDLY (no 3-window give-up): a
+        shard with NO KNOWN LEADER must never quiesce — its election
+        churn is the only thing that can produce a leader, and parking
+        it freezes that churn forever (r5 finding: colocated elections
+        are device-routed and invisible to this manager, so a shard
+        still electing at the idle threshold quiesced+parked mid-churn
+        and slept leaderless for good)."""
         if not self.enabled:
+            return False
+        if block and not self.quiesced:
+            self.idle_ticks = 0
+            self.busy_ticks = 0
             return False
         if busy and not self.quiesced:
             # BOUNDED hold: an active catch-up clears busy within a few

@@ -592,3 +592,51 @@ class TestRateLimits:
         assert not q.is_quiesced()
         q.quiesce_hint()
         assert q.is_quiesced()  # honored: idle >= threshold//2, no grace
+
+    def test_quiesce_block_never_enters(self):
+        """``block=True`` (no known leader) must prevent quiesce entry
+        UNBOUNDEDLY — the 3-window busy give-up would re-park a shard
+        still mid-election (r5 finding: colocated election traffic is
+        device-routed and invisible to the manager, so a leaderless
+        shard hit the idle threshold while electing, parked, and slept
+        forever)."""
+        from dragonboat_tpu.raft.quiesce import QuiesceManager
+
+        q = QuiesceManager(enabled=True, election_timeout=10)  # threshold 100
+        for _ in range(10 * q.threshold):  # far past the 3-window hold
+            assert not q.tick(block=True)
+        assert not q.is_quiesced() and q.idle_ticks == 0
+        # leader appears -> ordinary idle accounting resumes
+        for _ in range(q.threshold):
+            q.tick()
+        assert q.is_quiesced()
+
+    def test_leaderless_node_never_quiesces(self):
+        """node.step_with_inputs' tick path: a raft node with no known
+        leader must not enter quiesce no matter how long it idles (its
+        own campaigns are outbound and never count as activity)."""
+        from test_nodehost import KVStore, make_nodehost, shard_config
+        from dragonboat_tpu.transport.inproc import reset_inproc_network
+        import shutil as _sh
+
+        reset_inproc_network()
+        for rid in (1,):
+            _sh.rmtree(f"/tmp/nh-{rid}", ignore_errors=True)
+        nh = make_nodehost(1)
+        try:
+            # two-member shard with only ONE member started: quorum is
+            # unreachable, so the node campaigns forever with no leader
+            nh.start_replica(
+                {1: "nh-1", 2: "nh-2"}, False, KVStore,
+                shard_config(1, quiesce=True, election_rtt=10),
+            )
+            node = nh._nodes[1]
+            deadline = time.time() + 8.0
+            while time.time() < deadline:
+                assert not node.quiesce.is_quiesced()
+                assert 1 not in nh._parked
+                time.sleep(0.2)
+            # it kept electing the whole time (terms advanced)
+            assert node.peer.raft.term >= 2
+        finally:
+            nh.close()

