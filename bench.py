@@ -414,8 +414,10 @@ def phase_c(jax, SHARDS: int, duration: float, *, inflight: int = 4,
                 # (ms-scale), so a 1 ms pace costs no throughput
                 _time.sleep(0.001)
                 counts[w] = done
-            # drain the in-flight tail so late commits are counted and
-            # no live futures outlast NodeHost close (review finding)
+            # drain the in-flight tail so late commits are counted;
+            # failures count as errors exactly like the main loop, and
+            # anything STILL unset after the drain window is recorded
+            # as an error too (it will be terminated at NodeHost close)
             drain_end = _time.time() + 10.0
             while pending and _time.time() < drain_end:
                 still = []
@@ -423,11 +425,14 @@ def phase_c(jax, SHARDS: int, duration: float, *, inflight: int = 4,
                     if rs._event.is_set():
                         if rs.code == 1:
                             done += 1
+                        else:
+                            errors[w] += 1
                     else:
                         still.append((rs, t_sub, s))
                 pending = still
                 if pending:
                     _time.sleep(0.01)
+            errors[w] += len(pending)
             counts[w] = done
 
         # cycle-exact latency probe: a dedicated thread issuing SERIAL

@@ -105,6 +105,7 @@ class Node:
         "_qlock", "_received", "_proposals", "_read_indexes",
         "_config_changes", "_cc_to_apply", "_snapshot_reqs",
         "_leader_transfers", "_pending_ticks", "_gc_only_ticks",
+        "_ticks_in", "_ticks_taken",
         "pending_proposal", "pending_read_index", "pending_config_change",
         "pending_snapshot", "pending_leader_transfer", "device_reads",
         "tick_count", "leader_id", "stopped", "stopping", "_snapshotting",
@@ -152,6 +153,14 @@ class Node:
         self._leader_transfers: list = []  # target
         self._pending_ticks = 0
         self._gc_only_ticks = 0  # dropped by the backlog cap; clock-only
+        # single-writer tick lane: the HOST TICKER is the only writer of
+        # _ticks_in and the owning step worker the only writer of
+        # _ticks_taken, so the per-tick fan-out needs NO lock — at 50k
+        # rows the per-node _qlock acquisition in add_tick was the
+        # largest single host cost of the r5 scale run (the cap and
+        # gc-overflow accounting moved to drain_step_inputs)
+        self._ticks_in = 0
+        self._ticks_taken = 0
 
         # --- pending futures --------------------------------------------
         # keys must be unique across NODE INCARNATIONS, not just within
@@ -312,6 +321,7 @@ class Node:
             self.quiesce.enabled
             and self.quiesce.quiesced
             and not self._pending_ticks
+            and self._ticks_in == self._ticks_taken
             and not self._received
             and not self._proposals
             and not self._read_indexes
@@ -327,20 +337,13 @@ class Node:
         )
 
     def add_tick(self) -> None:
-        with self._qlock:
-            # cap the backlog at one election window: a node stalled past
-            # that (e.g. behind a one-off XLA compile) would otherwise
-            # replay several CheckQuorum/election windows back-to-back
-            # with no wall time for responses between them — combined
-            # with the per-step cap in step_with_inputs this bounds the
-            # quorum check to at most once per drained backlog.  Dropped
-            # ticks slow only the RAFT clock (liveness-safe); they still
-            # count toward the logical clock via gc_ticks so pending-
-            # future deadlines don't stretch in wall time during stalls.
-            if self._pending_ticks < self.config.election_rtt:
-                self._pending_ticks += 1
-            else:
-                self._gc_only_ticks += 1
+        # LOCK-FREE: the host ticker is this counter's only writer (a
+        # read-modify-write by a single thread is safe under the GIL);
+        # the election-window backlog cap and gc-overflow accounting
+        # moved to drain_step_inputs, where the backlog is consumed — at
+        # 50k rows the per-node _qlock acquisition here was the largest
+        # single host cost of the r5 scale run
+        self._ticks_in += 1
 
     def propose(
         self, session: Session, cmd: bytes, timeout_ticks: int
@@ -424,18 +427,24 @@ class Node:
             self._pending_ticks += n
 
     def has_work(self) -> bool:
-        with self._qlock:
-            if (
-                self._received
-                or self._proposals
-                or self._read_indexes
-                or self._config_changes
-                or self._cc_to_apply
-                or self._snapshot_reqs
-                or self._leader_transfers
-                or self._pending_ticks
-            ):
-                return True
+        # lock-free reads: each container's truthiness/len is atomic
+        # under the GIL, and has_work is only ever a HINT (the drain
+        # under _qlock is the linearization point) — the colocated
+        # coalesce scan calls this once per resident node per launch
+        # generation, and the lock acquisition alone was ~60% of a
+        # 294 s coalesce bill at 50k rows (SCALE_r05)
+        if (
+            self._received
+            or self._proposals
+            or self._read_indexes
+            or self._config_changes
+            or self._cc_to_apply
+            or self._snapshot_reqs
+            or self._leader_transfers
+            or self._pending_ticks
+            or self._ticks_in != self._ticks_taken
+        ):
+            return True
         return self.peer.has_update()
 
     # ------------------------------------------------------------------
@@ -445,12 +454,25 @@ class Node:
         """Atomically drain every input queue (the first half of stepNode;
         split out so a vectorized step engine can route drained inputs to
         the device or replay them on the scalar peer — ops/engine.py)."""
+        # consume the lock-free ticker lane first (this step worker is
+        # _ticks_taken's only writer).  The raft-clock backlog is capped
+        # at one election window: a node stalled past that (e.g. behind
+        # a one-off XLA compile) must not replay several CheckQuorum/
+        # election windows back-to-back with no wall time for responses
+        # between them.  Dropped ticks slow only the RAFT clock
+        # (liveness-safe); they still advance the logical clock via
+        # gc_ticks so pending-future deadlines don't stretch.
+        lane = self._ticks_in - self._ticks_taken
+        self._ticks_taken += lane
         with self._qlock:
             # swap, don't copy: non-empty queue lists hand over
             # wholesale and fresh empties replace them; empty inputs
             # stay the shared () from StepInputs.__init__
+            total = self._pending_ticks + lane
+            cap = self.config.election_rtt
             si = StepInputs(
-                ticks=self._pending_ticks, gc_ticks=self._gc_only_ticks
+                ticks=min(total, cap),
+                gc_ticks=self._gc_only_ticks + max(0, total - cap),
             )
             if self._received:
                 si.received = self._received

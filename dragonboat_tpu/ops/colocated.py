@@ -112,7 +112,23 @@ def _assemble_inbox(host: Inbox, pending: Inbox, alive: jnp.ndarray) -> Inbox:
                    for f in Inbox._fields))
 
 
-@functools.partial(jax.jit, static_argnames=("PB", "E", "budget"))
+@functools.partial(jax.jit, static_argnames=("out_capacity",),
+                   donate_argnums=(1, 2))
+def _assemble_and_step(state, host: Inbox, pending: Inbox, alive,
+                       *, out_capacity: int):
+    """Fused inbox assembly + kernel step in ONE program, with the host
+    and pending inboxes DONATED: the remote TPU service frees device
+    garbage lazily and a fast launch cadence at 65k-row geometry
+    out-allocated it (r5 finding — RESOURCE_EXHAUSTED mid-election);
+    fusing avoids materializing the assembled inbox as a host-held
+    buffer and donation lets the runtime reuse the inbox allocations
+    instead of growing the heap every generation."""
+    full = _assemble_inbox(host, pending, alive)
+    return K.step(state, full, out_capacity=out_capacity)
+
+
+@functools.partial(jax.jit, static_argnames=("PB", "E", "budget"),
+                   donate_argnums=(1,))
 def _route_step(old_state, new_state, out, dest, rank, dest_alive,
                 *, PB: int, E: int, budget: int):
     """Post-launch tail: discard escalated rows' effects, route the
@@ -258,6 +274,9 @@ class ColocatedVectorEngine(VectorStepEngine):
         # both sides keep ticking and campaigning, exactly a network
         # partition.  None = fully connected.
         self._part_fn = None
+        # rate limit for the O(resident rows) coalesce scan (see
+        # _coalesce); 0 = never scanned yet
+        self._last_coalesce_scan = 0.0
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         # loop-invariant delivered-bit unpack tables (word index and
@@ -472,8 +491,12 @@ class ColocatedVectorEngine(VectorStepEngine):
         host2 = _host_inbox_from_ticks(
             self._put_rows(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
         )
-        full = _assemble_inbox(host2, self._pending, alive)
-        new_st, out = K.step(st, full, out_capacity=O)
+        # warm the PRODUCTION fused executable; it donates host2 and
+        # _pending, so rebuild _pending afterwards
+        new_st, out = _assemble_and_step(
+            st, host2, self._pending, alive, out_capacity=O
+        )
+        self._pending = self._put_rows(make_inbox(G, P * B, E))
         _route_step(st, new_st, out, dest, rank, alive,
                     PB=P * B, E=E, budget=B)
         from .engine import _gather_rows, _scatter_rows, _select_rows
@@ -482,6 +505,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         pos0 = self._put_rows(jnp.full((G,), -1, jnp.int32))
         mask0 = self._put_rows(jnp.zeros((G,), bool))
         _zero_inbox_rows(self._pending, mask0)
+        # host2 was DONATED into _assemble_and_step above; warm the
+        # scatter against a fresh host inbox of the same signature
+        host3 = _host_inbox_from_ticks(
+            self._put_rows(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
+        )
         b = 1
         while b <= G:
             idx = self._put(jnp.zeros((b,), jnp.int32))
@@ -490,9 +518,9 @@ class ColocatedVectorEngine(VectorStepEngine):
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             _gather_vals(st, out, idx)
             _scatter_inbox_rows(
-                host2, pos0,
+                host3, pos0,
                 self._put(Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
-                                  for f in host2))),
+                                  for f in host3))),
             )
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
@@ -615,6 +643,19 @@ class ColocatedVectorEngine(VectorStepEngine):
         generation).  Safe under the core lock: ALL colocated node
         stepping happens inside it, so no other worker can be draining
         these queues concurrently."""
+        # throttle: the scan is O(resident rows) of pure Python and ran
+        # once per generation — ~1000 small preload generations during a
+        # 50k-row mass start made it the single largest cost of the r5
+        # scale run (294 s).  Skipping it is always SAFE: a node with
+        # work was notified, so its own exec worker delivers it in
+        # `nodes` on an upcoming generation; coalescing is a batching
+        # optimization, not a delivery guarantee.
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._last_coalesce_scan < 0.2:
+            return list(nodes)
+        self._last_coalesce_scan = now
         seen = {id(n) for n in nodes}
         out = list(nodes)
         for meta in self._meta.values():
@@ -779,8 +820,11 @@ class ColocatedVectorEngine(VectorStepEngine):
                         if self._meta[g].dirty
                     ]
                 )
-                self.stats["t_upload_ms"] += int(
-                    (_time.perf_counter() - _t0) * 1000
+                # float ms: lazy upload streams many sub-ms batches and
+                # int truncation under-reports the aggregate (same fix
+                # as t_up_pack_ms/t_up_scatter_ms)
+                self.stats["t_upload_ms"] += (
+                    (_time.perf_counter() - _t0) * 1000.0
                 )
                 updates.extend(self._device_step_colocated(batch))
             else:
@@ -877,16 +921,33 @@ class ColocatedVectorEngine(VectorStepEngine):
         from ..profiling import annotate
 
         _t0 = _time.perf_counter()
-        with annotate("raft-colocated-step"):
-            full = _assemble_inbox(host_inbox, self._pending, alive)
-            new_state, out = K.step(old_state, full, out_capacity=self.O)
-            merged, regions, stats_dev, delivered_dev, flags_dev = (
-                _route_step(
-                    old_state, new_state, out, self._dest_dev,
-                    self._rank_dev, alive, PB=P * B, E=E, budget=B,
+        try:
+            with annotate("raft-colocated-step"):
+                # fused assemble+step with host/pending donated, and
+                # new_state donated into route (dead after the merge):
+                # minimizes per-generation device allocations — the
+                # remote TPU service frees lazily and allocation-heavy
+                # cadences exhausted it (see _assemble_and_step)
+                new_state, out = _assemble_and_step(
+                    old_state, host_inbox, self._pending, alive,
+                    out_capacity=self.O,
                 )
-            )
-            flags = np.asarray(flags_dev)
+                merged, regions, stats_dev, delivered_dev, flags_dev = (
+                    _route_step(
+                        old_state, new_state, out, self._dest_dev,
+                        self._rank_dev, alive, PB=P * B, E=E, budget=B,
+                    )
+                )
+                flags = np.asarray(flags_dev)
+        except BaseException:
+            # self._pending was DONATED above; leaving the deleted
+            # buffer in place would poison every later generation with
+            # "Array has been deleted" after one transient launch
+            # failure (review finding).  Rebuild empty — dropping the
+            # in-flight routed traffic is raft-safe message loss.
+            self._pending = self._put_rows(make_inbox(G, P * B, E))
+            self._pending_live = False
+            raise
         self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
         rstats = np.asarray(stats_dev)
