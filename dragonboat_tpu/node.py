@@ -104,7 +104,7 @@ class Node:
         "transport", "on_leader_updated", "events", "registry",
         "_qlock", "_received", "_proposals", "_read_indexes",
         "_config_changes", "_cc_to_apply", "_snapshot_reqs",
-        "_leader_transfers", "_pending_ticks", "_gc_only_ticks",
+        "_leader_transfers", "_pending_ticks",
         "_ticks_in", "_ticks_taken",
         "pending_proposal", "pending_read_index", "pending_config_change",
         "pending_snapshot", "pending_leader_transfer", "device_reads",
@@ -152,7 +152,6 @@ class Node:
         self._snapshot_reqs: list = []  # (key, overhead)
         self._leader_transfers: list = []  # target
         self._pending_ticks = 0
-        self._gc_only_ticks = 0  # dropped by the backlog cap; clock-only
         # single-writer tick lane: the HOST TICKER is the only writer of
         # _ticks_in and the owning step worker the only writer of
         # _ticks_taken, so the per-tick fan-out needs NO lock — at 50k
@@ -472,7 +471,7 @@ class Node:
             cap = self.config.election_rtt
             si = StepInputs(
                 ticks=min(total, cap),
-                gc_ticks=self._gc_only_ticks + max(0, total - cap),
+                gc_ticks=max(0, total - cap),
             )
             if self._received:
                 si.received = self._received
@@ -496,7 +495,6 @@ class Node:
                 si.snapshot_reqs = self._snapshot_reqs
                 self._snapshot_reqs = []
             self._pending_ticks = 0
-            self._gc_only_ticks = 0
         return si
 
     def step(self) -> Optional[Update]:

@@ -277,6 +277,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         # rate limit for the O(resident rows) coalesce scan (see
         # _coalesce); 0 = never scanned yet
         self._last_coalesce_scan = 0.0
+        self._scan_cost = 0.0
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         # loop-invariant delivered-bit unpack tables (word index and
@@ -653,7 +654,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         import time as _time
 
         now = _time.monotonic()
-        if now - self._last_coalesce_scan < 0.2:
+        # interval scales with the measured scan cost (>=10x) so the
+        # scan can never consume more than ~10% of wall time: at 250k
+        # resident rows one scan is 1-2 s of Python and a fixed 200 ms
+        # interval let it dominate the 50k-shard election
+        if now - self._last_coalesce_scan < max(0.2, 10 * self._scan_cost):
             return list(nodes)
         self._last_coalesce_scan = now
         seen = {id(n) for n in nodes}
@@ -668,6 +673,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             ):
                 seen.add(id(n))
                 out.append(n)
+        self._scan_cost = _time.monotonic() - now
         coalesced = len(out) - len(nodes)
         if coalesced:
             self.stats["coalesced_rows"] += coalesced
@@ -920,6 +926,10 @@ class ColocatedVectorEngine(VectorStepEngine):
 
         from ..profiling import annotate
 
+        if self._pending is None:
+            # a prior launch failure consumed the donated pending inbox
+            # and could not rebuild it (see the handler below)
+            self._pending = self._put_rows(make_inbox(G, P * B, E))
         _t0 = _time.perf_counter()
         try:
             with annotate("raft-colocated-step"):
@@ -943,10 +953,18 @@ class ColocatedVectorEngine(VectorStepEngine):
             # self._pending was DONATED above; leaving the deleted
             # buffer in place would poison every later generation with
             # "Array has been deleted" after one transient launch
-            # failure (review finding).  Rebuild empty — dropping the
-            # in-flight routed traffic is raft-safe message loss.
-            self._pending = self._put_rows(make_inbox(G, P * B, E))
+            # failure (review finding).  Clear FIRST, then try to
+            # rebuild — the rebuild itself allocates and can fail under
+            # the same RESOURCE_EXHAUSTED this guards against, so a
+            # None sentinel (rebuilt lazily at the next launch) must
+            # never be skipped over.  Dropping the in-flight routed
+            # traffic is raft-safe message loss.
+            self._pending = None
             self._pending_live = False
+            try:
+                self._pending = self._put_rows(make_inbox(G, P * B, E))
+            except Exception:  # noqa: BLE001 — next launch rebuilds
+                pass
             raise
         self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)

@@ -151,7 +151,12 @@ def run_scale(shards: int, artifact_path: str = "",
         # vote responses lost that elections looped; the 1k geometry
         # settled fine at 4).  The wider regions live on device only.
         group = ColocatedEngineGroup(
-            capacity=capacity, P=P_eng, W=16, M=8, E=2, O=32,
+            capacity=capacity, P=P_eng, W=16, M=8, E=2,
+            # O/budget shrink for very large capacities: at 262k rows
+            # (50k mixed shards) the default O=32/B=8 geometry's route
+            # temporaries exceed device memory; B=4 storm drops are
+            # 0.14% and recover via raft retry (BENCH_NOTES_r05 sweep)
+            O=int(os.environ.get("SCALE_O", "32")),
             budget=int(os.environ.get("SCALE_BUDGET", "8")),
         )
 
@@ -267,15 +272,25 @@ def run_scale(shards: int, artifact_path: str = "",
         ok = [0]
         errs = collections.Counter()
 
+        # commit latency at scale is ~2 launch GENERATIONS, and a
+        # generation is minutes of host Python at 250k rows on a
+        # single core — fixed 90 s/240 s budgets expired mid-flight on
+        # every attempt of the 50k run while the commits were landing
+        # (the shards were all led and advancing).  Scale the budgets
+        # with the shard count instead of racing the wall clock.
+        p_timeout = min(300.0, max(90.0, shards * 0.005))
+        p_deadline = max(240.0, shards * 0.03)
+
         def propose_one(shard):
             members = shard_members(shard)
             nh = nhs[1 + (shard % len(members))]
             s = nh.get_noop_session(shard)
-            end = time.time() + 240.0
+            end = time.time() + p_deadline
             while True:
                 try:
                     nh.sync_propose(
-                        s, pickle.dumps((f"k{shard}", shard)), timeout=90.0
+                        s, pickle.dumps((f"k{shard}", shard)),
+                        timeout=p_timeout,
                     )
                     with ok_lock:
                         ok[0] += 1
@@ -294,10 +309,10 @@ def run_scale(shards: int, artifact_path: str = "",
         for t in threads:
             t.start()
         for t in threads:
-            # must exceed a thread's worst-case lifetime (240s deadline
-            # + one last 90s sync_propose) so no proposer outlives the
+            # must exceed a thread's worst-case lifetime (deadline + one
+            # last in-flight sync_propose) so no proposer outlives the
             # report read / NodeHost teardown
-            t.join(timeout=360.0)
+            t.join(timeout=p_deadline + p_timeout + 30.0)
         report["proposals_attempted"] = len(sample)
         report["proposals_committed"] = ok[0]
         report["propose_errors"] = dict(errs.most_common(5))
