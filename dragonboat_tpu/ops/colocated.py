@@ -75,9 +75,15 @@ from .engine import (
     _bucket,
     _place_rows,
     _pos_map,
+    _build_idx4,
+    _detail_width,
+    _fetch_detail_vals,
     _gather_detail,
+    _gather_detail_vals,
     _gather_vals,
     _split_detail,
+    N_FIELDS_BUF,
+    N_VALS,
     _summarize_flags,
     _tick_bookkeeping,
     _pad_idx,
@@ -87,6 +93,12 @@ from .route import build_route_tables, route
 from .types import APPEND_LO_NONE, I32, MT_TICK, Inbox, make_inbox
 
 _log = get_logger("engine")
+
+
+# per-launch [G, 4] host-upload lane assignments: every per-launch [G]
+# host input rides ONE device_put (each H2D put costs ~10-20 ms of
+# link latency; four separate puts were a fifth of the launch budget)
+_C_ALIVE, _C_BATCH, _C_PROP, _C_TICKS = range(4)
 
 
 @jax.jit
@@ -114,7 +126,7 @@ def _assemble_inbox(host: Inbox, pending: Inbox, alive: jnp.ndarray) -> Inbox:
 
 @functools.partial(jax.jit, static_argnames=("out_capacity",),
                    donate_argnums=(1, 2))
-def _assemble_and_step(state, host: Inbox, pending: Inbox, alive,
+def _assemble_and_step(state, host: Inbox, pending: Inbox, combo,
                        *, out_capacity: int):
     """Fused inbox assembly + kernel step in ONE program, with the host
     and pending inboxes DONATED: the remote TPU service frees device
@@ -122,14 +134,15 @@ def _assemble_and_step(state, host: Inbox, pending: Inbox, alive,
     out-allocated it (r5 finding — RESOURCE_EXHAUSTED mid-election);
     fusing avoids materializing the assembled inbox as a host-held
     buffer and donation lets the runtime reuse the inbox allocations
-    instead of growing the heap every generation."""
-    full = _assemble_inbox(host, pending, alive)
+    instead of growing the heap every generation.  ``combo`` is the
+    [G, 4] fused host-upload (see _C_*); the alive lane masks rows."""
+    full = _assemble_inbox(host, pending, combo[:, _C_ALIVE] != 0)
     return K.step(state, full, out_capacity=out_capacity)
 
 
 @functools.partial(jax.jit, static_argnames=("PB", "E", "budget"),
                    donate_argnums=(1,))
-def _route_step(old_state, new_state, out, dest, rank, dest_alive,
+def _route_step(old_state, new_state, out, dest, rank, combo,
                 *, PB: int, E: int, budget: int):
     """Post-launch tail: discard escalated rows' effects, route the
     outboxes into the next launch's pending regions (width P*budget,
@@ -147,7 +160,7 @@ def _route_step(old_state, new_state, out, dest, rank, dest_alive,
     regions, stats, delivered = route(
         merged, out, dest, rank,
         M=PB, E=E, budget=budget, base=0,
-        suppress=esc, dest_alive=dest_alive,
+        suppress=esc, dest_alive=combo[:, _C_ALIVE] != 0,
     )
     flags = _summarize_flags(old_state, merged, out)
     # colocated override of _F_COUNT: only rows with UNdelivered outbox
@@ -174,6 +187,75 @@ def _route_step(old_state, new_state, out, dest, rank, dest_alive,
     return merged, regions, jnp.stack(list(stats)), packed, flags
 
 
+@functools.partial(jax.jit, static_argnames=("CAP_D", "CAP_S"))
+def _select_and_blob(merged, out, stats, packed, flags, combo,
+                     *, CAP_D: int, CAP_S: int):
+    """Device-side row selection + detail/vals gather + single-blob
+    packing — the launch's ONE device->host sync.
+
+    Every sync round trip on a remote-device link costs ~100 ms of
+    latency regardless of size (measured r5); the r5 launch paid ~5
+    (flags, stats, delivered, detail, vals).  This program mirrors the
+    host's row-set computation (live/buf/append/need/slot/sum) from the
+    flag word, compacts each set with a stable argsort (selected rows
+    first, ascending), gathers the detail for the first CAP_D and the
+    values for the first CAP_S rows, and concatenates EVERYTHING the
+    host reads per launch into one int32 vector.  Counts above the
+    static capacities are reported so the host can fall back to an
+    exact two-sync gather (rare; it then raises its capacity floor).
+
+    Blob layout (all int32):
+      [0:G]               flags
+      [G:G+G*nw]          delivered bits (bitcast u32)
+      [+6]                route stats
+      [+5]                counts: n_buf, n_slot, n_need, n_append, n_sum
+      [+4*CAP_D]          row ids: buf | slot | need | append
+      [+CAP_S]            row ids: sum
+      [+CAP_D*K]          detail (engine._gather_detail packing)
+      [+CAP_S*N_VALS]     values (engine._gather_vals packing)
+    """
+    G = flags.shape[0]
+    alive = combo[:, _C_ALIVE] != 0
+    batch_mask = combo[:, _C_BATCH] != 0
+    prop_mask = combo[:, _C_PROP] != 0
+    esc = (flags & _F_ESC) != 0
+    anylive = (flags & _F_ANY_LIVE) != 0
+    # the host's live set: batch rows + resident alive rows with
+    # any-live flags, minus escalations
+    live = (batch_mask | (alive & anylive)) & ~esc
+    buf_sel = live & ((flags & _F_COUNT) != 0)
+    append_sel = live & ((flags & _F_APPEND) != 0)
+    need_sel = live & ((flags & _F_NEED_SS) != 0)
+    slot_sel = prop_mask & ~esc
+    sum_sel = live & (anylive | slot_sel)
+
+    def pick(sel, cap):
+        order = jnp.argsort(jnp.where(sel, 0, 1), stable=True)
+        return (
+            jax.lax.slice_in_dim(order, 0, cap).astype(I32),
+            jnp.sum(sel, dtype=I32),
+        )
+
+    rows_buf, n_buf = pick(buf_sel, CAP_D)
+    rows_slot, n_slot = pick(slot_sel, CAP_D)
+    rows_need, n_need = pick(need_sel, CAP_D)
+    rows_append, n_append = pick(append_sel, CAP_D)
+    rows_sum, n_sum = pick(sum_sel, CAP_S)
+    idx4 = jnp.stack([rows_buf, rows_slot, rows_need, rows_append])
+    detail = _gather_detail(merged, out, idx4)      # [CAP_D, K]
+    vals = _gather_vals(merged, out, rows_sum)      # [CAP_S, N_VALS]
+    return jnp.concatenate([
+        flags,
+        jax.lax.bitcast_convert_type(packed, jnp.int32).reshape(-1),
+        stats.astype(I32),
+        jnp.stack([n_buf, n_slot, n_need, n_append, n_sum]),
+        idx4.reshape(-1),
+        rows_sum,
+        detail.reshape(-1),
+        vals.reshape(-1),
+    ])
+
+
 @jax.jit
 def _zero_inbox_rows(inbox: Inbox, mask) -> Inbox:
     """Zero the inbox rows where ``mask`` ([G] bool) — mask-select, not
@@ -187,7 +269,7 @@ def _zero_inbox_rows(inbox: Inbox, mask) -> Inbox:
 
 
 @functools.partial(jax.jit, static_argnames=("M", "E"))
-def _host_inbox_from_ticks(tick_counts, *, M: int, E: int) -> Inbox:
+def _host_inbox_from_ticks(combo, *, M: int, E: int) -> Inbox:
     """Build the host inbox region ON DEVICE from a [G] fused-tick-count
     vector.  At scale, nearly every row's host region is exactly one
     count-carrying LOCAL_TICK slot — uploading the dense [G, M(, E)]
@@ -195,6 +277,7 @@ def _host_inbox_from_ticks(tick_counts, *, M: int, E: int) -> Inbox:
     the whole launch budget); the tick vector is 256 KB.  Rows with real
     host slots (wire messages, proposals, reads, tick-with-read-hint)
     are scattered over this base by _scatter_inbox_rows."""
+    tick_counts = combo[:, _C_TICKS]
     G = tick_counts.shape[0]
     z = jnp.zeros((G, M), I32)
     ze = jnp.zeros((G, M, E), I32)
@@ -278,6 +361,14 @@ class ColocatedVectorEngine(VectorStepEngine):
         # _coalesce); 0 = never scanned yet
         self._last_coalesce_scan = 0.0
         self._scan_cost = 0.0
+        # adaptive device-select capacities for the single-sync launch
+        # blob (see _select_and_blob): detail rows are ~2 KB each so
+        # CAP_D tracks actual peaks tightly; vals rows are 40 B so
+        # CAP_S can ride elections up to G cheaply
+        self._cap_d = min(capacity, 64)
+        self._cap_s = min(capacity, 1024)
+        self._need_d_hist: List[int] = [1]
+        self._need_s_hist: List[int] = [1]
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         # loop-invariant delivered-bit unpack tables (word index and
@@ -482,24 +573,33 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._pending = self._put_rows(make_inbox(G, P * B, E))
         st = self._state
         host = self._put_rows(make_inbox(G, self.M, E))
-        alive = self._put_rows(jnp.zeros((G,), bool))
+        combo = self._put_rows(jnp.zeros((G, 4), jnp.int32))
         dest = self._put_rows(jnp.full((G, P), -1, I32))
         rank = self._put_rows(jnp.zeros((G, P), I32))
         # warm the REAL launch signature: host inbox built on device
-        # from the (row-sharded) tick vector — warming with a host-side
-        # make_inbox would key different executables (committed-ness /
-        # sharding) and the first production launch would recompile
-        host2 = _host_inbox_from_ticks(
-            self._put_rows(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
-        )
+        # from the (row-sharded) fused combo upload — warming with a
+        # host-side make_inbox would key different executables
+        # (committed-ness / sharding) and the first production launch
+        # would recompile
+        host2 = _host_inbox_from_ticks(combo, M=self.M, E=E)
         # warm the PRODUCTION fused executable; it donates host2 and
         # _pending, so rebuild _pending afterwards
         new_st, out = _assemble_and_step(
-            st, host2, self._pending, alive, out_capacity=O
+            st, host2, self._pending, combo, out_capacity=O
         )
         self._pending = self._put_rows(make_inbox(G, P * B, E))
-        _route_step(st, new_st, out, dest, rank, alive,
-                    PB=P * B, E=E, budget=B)
+        merged_w, _regions_w, stats_w, packed_w, flags_w = _route_step(
+            st, new_st, out, dest, rank, combo, PB=P * B, E=E, budget=B
+        )
+        # warm both the startup caps and the adaptive floor pair — the
+        # first light-load launches shrink the caps to the floor and
+        # would otherwise recompile over the tunnel (review finding)
+        for cd, cs in {(self._cap_d, self._cap_s),
+                       (min(G, 8), min(G, 64))}:
+            _select_and_blob(
+                merged_w, out, stats_w, packed_w, flags_w, combo,
+                CAP_D=cd, CAP_S=cs,
+            )
         from .engine import _gather_rows, _scatter_rows, _select_rows
 
         _select_rows(self._put(jnp.ones((G,), bool)), st, st)
@@ -509,7 +609,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         # host2 was DONATED into _assemble_and_step above; warm the
         # scatter against a fresh host inbox of the same signature
         host3 = _host_inbox_from_ticks(
-            self._put_rows(jnp.zeros((G,), jnp.int32)), M=self.M, E=E
+            self._put_rows(jnp.zeros((G, 4), jnp.int32)), M=self.M, E=E
         )
         b = 1
         while b <= G:
@@ -518,6 +618,11 @@ class ColocatedVectorEngine(VectorStepEngine):
             _scatter_rows(st, pos0, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             _gather_vals(st, out, idx)
+            # the production path fuses both gathers into one program;
+            # warm the common same-bucket pairing
+            _gather_detail_vals(
+                st, out, self._put(jnp.zeros((4, b), jnp.int32)), idx
+            )
             _scatter_inbox_rows(
                 host3, pos0,
                 self._put(Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
@@ -881,9 +986,28 @@ class ColocatedVectorEngine(VectorStepEngine):
                 tick_counts[g] = m0.log_index
             else:
                 sparse.append((g, msgs))
-        host_inbox = _host_inbox_from_ticks(
-            self._put_rows(jnp.asarray(tick_counts)), M=M, E=E
-        )
+        if self._tables_dirty:
+            self._rebuild_tables()
+        # ONE fused [G, 4] host upload for every per-launch [G] input
+        # (alive, batch membership, proposal rows, fused tick counts):
+        # each separate device_put pays ~10-20 ms of link latency
+        combo_np = np.zeros((G, 4), np.int32)
+        combo_np[:, _C_TICKS] = tick_counts
+        alive_np = np.zeros((G,), bool)
+        for g, meta in self._meta.items():
+            # a stopping member's rows must neither consume routed
+            # traffic nor be routable targets: a stopped-but-undetached
+            # leader would keep winning device elections while its host
+            # no longer publishes payloads to the entry cache — healthy
+            # peers then fail-stop on unreconstructible appends
+            alive_np[g] = not meta.dirty and not (
+                meta.node.stopped or meta.node.stopping
+            )
+        combo_np[:, _C_ALIVE] = alive_np
+        combo_np[[g for _, g, _, _ in batch], _C_BATCH] = 1
+        combo_np[prop_rows, _C_PROP] = 1
+        combo = self._put_rows(jnp.asarray(combo_np))
+        host_inbox = _host_inbox_from_ticks(combo, M=M, E=E)
         if sparse:
             nsb = _bucket(len(sparse))
             # pad with COPIES of the last real row: _pad_idx repeats its
@@ -907,20 +1031,6 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self._put(sub),
             )
 
-        if self._tables_dirty:
-            self._rebuild_tables()
-        alive_np = np.zeros((G,), bool)
-        for g, meta in self._meta.items():
-            # a stopping member's rows must neither consume routed
-            # traffic nor be routable targets: a stopped-but-undetached
-            # leader would keep winning device elections while its host
-            # no longer publishes payloads to the entry cache — healthy
-            # peers then fail-stop on unreconstructible appends
-            alive_np[g] = not meta.dirty and not (
-                meta.node.stopped or meta.node.stopping
-            )
-        alive = self._put_rows(jnp.asarray(alive_np))
-
         old_state = self._state
         import time as _time
 
@@ -939,16 +1049,28 @@ class ColocatedVectorEngine(VectorStepEngine):
                 # remote TPU service frees lazily and allocation-heavy
                 # cadences exhausted it (see _assemble_and_step)
                 new_state, out = _assemble_and_step(
-                    old_state, host_inbox, self._pending, alive,
+                    old_state, host_inbox, self._pending, combo,
                     out_capacity=self.O,
                 )
-                merged, regions, stats_dev, delivered_dev, flags_dev = (
+                merged, regions, stats_dev, packed_dev, flags_dev = (
                     _route_step(
                         old_state, new_state, out, self._dest_dev,
-                        self._rank_dev, alive, PB=P * B, E=E, budget=B,
+                        self._rank_dev, combo, PB=P * B, E=E, budget=B,
                     )
                 )
-                flags = np.asarray(flags_dev)
+                # the launch's ONE sync round trip: flags + delivered +
+                # stats + device-selected detail/vals rows in one blob
+                # (every separate np.asarray costs ~100 ms of tunnel
+                # latency regardless of size; r5 paid 5 per launch)
+                CAP_D, CAP_S = self._cap_d, self._cap_s
+                blob = np.asarray(
+                    _select_and_blob(
+                        merged, out, stats_dev, packed_dev, flags_dev,
+                        combo, CAP_D=CAP_D, CAP_S=CAP_S,
+                    )
+                )
+                nw = (self.O + 31) // 32
+                flags = blob[:G]
         except BaseException:
             # self._pending was DONATED above; leaving the deleted
             # buffer in place would poison every later generation with
@@ -968,8 +1090,22 @@ class ColocatedVectorEngine(VectorStepEngine):
             raise
         self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
-        rstats = np.asarray(stats_dev)
-        delivered_bits = np.asarray(delivered_dev)  # [G, ceil(O/32)] u32
+        pos = G + G * nw
+        rstats = blob[pos:pos + 6]
+        pos += 6
+        sel_counts = blob[pos:pos + 5]
+        pos += 5
+        sel_rows4 = blob[pos:pos + 4 * CAP_D].reshape(4, CAP_D)
+        pos += 4 * CAP_D
+        sel_rows_sum = blob[pos:pos + CAP_S]
+        pos += CAP_S
+        Kd = _detail_width(self.O, M + P * B, E, P, self.W)
+        sel_detail = blob[pos:pos + CAP_D * Kd].reshape(CAP_D, Kd)
+        pos += CAP_D * Kd
+        sel_vals = blob[pos:].reshape(CAP_S, N_VALS)
+        delivered_bits = (
+            blob[G:G + G * nw].view(np.uint32).reshape(G, nw)
+        )  # [G, ceil(O/32)] u32
         self._pending = regions
         self._state = merged
         self._pending_live = int(rstats[0]) > 0
@@ -1049,46 +1185,79 @@ class ColocatedVectorEngine(VectorStepEngine):
             if (flags[g] & _F_ANY_LIVE) or g in slot_set
         ]
         _t0 = _time.perf_counter()
-        if buf_rows or append_rows or slot_rows or need_rows:
-            b = _bucket(
-                max(len(buf_rows), len(append_rows), len(slot_rows),
-                    len(need_rows))
+        # device-selected detail (the single-sync fast path): the blob
+        # already carries detail/vals for the rows the DEVICE selected
+        # with the same flag logic; verify the host's sets are covered
+        # and fall back to an exact two-sync gather when not (capacity
+        # overflow, or a row the device's live approximation missed)
+        n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d = (
+            int(x) for x in sel_counts
+        )
+        dev_ok = (
+            max(n_buf_d, n_slot_d, n_need_d, n_append_d) <= CAP_D
+            and n_sum_d <= CAP_S
+        )
+        if dev_ok:
+            buf_at = {int(g): k for k, g in enumerate(sel_rows4[0][:n_buf_d])}
+            slot_at = {int(g): k for k, g in enumerate(sel_rows4[1][:n_slot_d])}
+            need_at = {int(g): k for k, g in enumerate(sel_rows4[2][:n_need_d])}
+            ring_at = {
+                int(g): k for k, g in enumerate(sel_rows4[3][:n_append_d])
+            }
+            sum_at = {int(g): k for k, g in enumerate(sel_rows_sum[:n_sum_d])}
+            dev_ok = (
+                all(g in buf_at for g in buf_rows)
+                and all(g in slot_at for g in slot_rows)
+                and all(g in need_at for g in need_rows)
+                and all(g in ring_at for g in append_rows)
+                and all(g in sum_at for g in sum_rows)
             )
-            idx4 = np.zeros((4, b), np.int32)
-            for row_i, rows in enumerate(
-                (buf_rows, slot_rows, need_rows, append_rows)
-            ):
-                if rows:
-                    idx4[row_i, : len(rows)] = rows
-                    idx4[row_i, len(rows):] = rows[-1]
-            flat = np.asarray(
-                _gather_detail(merged, out, self._put(jnp.asarray(idx4)))
-            )
-            # the kernel ran on the ASSEMBLED inbox (host slots + routed
-            # regions), so the out slot arrays are M + P*B wide
+        if dev_ok:
             (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
              ring_c) = _split_detail(
-                flat, self.O, M + P * B, E, P, self.W)
+                sel_detail, self.O, M + P * B, E, P, self.W)
+            vals_np = sel_vals
         else:
-            buf_np = slot_base = slot_term = ent_drop = need_np = None
-            ring_t = ring_c = None
-        if sum_rows:
-            vals_np = np.asarray(
-                _gather_vals(
-                    merged, out,
-                    self._put(jnp.asarray(_pad_idx(sum_rows))),
-                )
+            # exact host-side selection (the r5 two-sync path)
+            self.stats["sel_fallbacks"] = (
+                self.stats.get("sel_fallbacks", 0) + 1
             )
-        else:
-            vals_np = None
+            idx4 = _build_idx4(buf_rows, slot_rows, need_rows, append_rows)
+            # the kernel ran on the ASSEMBLED inbox (host slots + routed
+            # regions), so the out slot arrays are M + P*B wide
+            detail, vals_np = _fetch_detail_vals(
+                merged, out, idx4, sum_rows, self._put,
+                self.O, M + P * B, E, P, self.W,
+            )
+            if detail is not None:
+                (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
+                 ring_c) = detail
+            else:
+                buf_np = slot_base = slot_term = ent_drop = need_np = None
+                ring_t = ring_c = None
+            buf_at = {g: k for k, g in enumerate(buf_rows)}
+            ring_at = {g: k for k, g in enumerate(append_rows)}
+            slot_at = {g: k for k, g in enumerate(slot_rows)}
+            need_at = {g: k for k, g in enumerate(need_rows)}
+            sum_at = {g: k for k, g in enumerate(sum_rows)}
+        # adaptive select capacities: recent peaks (device counts AND
+        # host set sizes) size the next launches' blob, with power-of-
+        # two hysteresis; a change only recompiles the small select
+        # program, never the big step/route programs
+        self._need_d_hist.append(
+            max(n_buf_d, n_slot_d, n_need_d, n_append_d,
+                len(buf_rows), len(slot_rows), len(need_rows),
+                len(append_rows))
+        )
+        self._need_s_hist.append(max(n_sum_d, len(sum_rows)))
+        if len(self._need_d_hist) > 64:
+            del self._need_d_hist[0]
+            del self._need_s_hist[0]
+        self._cap_d = min(G, _bucket(max(8, 2 * max(self._need_d_hist))))
+        self._cap_s = min(G, _bucket(max(64, 2 * max(self._need_s_hist))))
         self.stats["t_detail_ms"] += int(
             (_time.perf_counter() - _t0) * 1000
         )
-        buf_at = {g: k for k, g in enumerate(buf_rows)}
-        ring_at = {g: k for k, g in enumerate(append_rows)}
-        slot_at = {g: k for k, g in enumerate(slot_rows)}
-        need_at = {g: k for k, g in enumerate(need_rows)}
-        sum_at = {g: k for k, g in enumerate(sum_rows)}
 
         from .engine import SLOT_DROPPED
 

@@ -104,8 +104,9 @@ def _bucket(n: int) -> int:
     return b
 
 
-def _pad_idx(idx: Sequence[int]) -> np.ndarray:
-    pad = _bucket(len(idx))
+def _pad_idx(idx: Sequence[int], pad: Optional[int] = None) -> np.ndarray:
+    if pad is None:
+        pad = _bucket(len(idx))
     out = np.empty((pad,), np.int32)
     out[: len(idx)] = idx
     out[len(idx):] = idx[-1]  # duplicate scatter/gather of one row is benign
@@ -226,6 +227,14 @@ def _gather_detail(state, out, idx4):
     return jnp.concatenate([p.reshape(b, -1) for p in parts], axis=1)
 
 
+def _detail_width(O: int, M: int, E: int, P: int, W: int) -> int:
+    """Per-row int32 width of _gather_detail's packing — the ONE
+    definition shared by _split_detail, _fetch_detail_vals and the
+    colocated single-sync blob parse (review finding: the formula was
+    hand-duplicated and a packing change would silently misalign)."""
+    return O * N_FIELDS_BUF + M + M + M * E + P + W + W
+
+
 def _split_detail(flat: np.ndarray, O: int, M: int, E: int, P: int, W: int):
     """Host-side inverse of _gather_detail's packing."""
     b = flat.shape[0]
@@ -237,6 +246,87 @@ def _split_detail(flat: np.ndarray, O: int, M: int, E: int, P: int, W: int):
         outs.append(flat[:, pos : pos + size].reshape(shape))
         pos += size
     return tuple(outs)
+
+
+@jax.jit
+def _gather_detail_vals(state, out, idx4, idx_sum):
+    """_gather_detail + _gather_vals in ONE dispatch and ONE flat 1-D
+    readback.  A device->host sync on a remote-device link costs ~100 ms
+    of round-trip latency regardless of size (measured r5); issuing the
+    detail and values gathers as two programs with two np.asarray calls
+    was two of the launch's ~5 round trips."""
+    detail = _gather_detail(state, out, idx4)
+    vals = _gather_vals(state, out, idx_sum)
+    return jnp.concatenate([detail.reshape(-1), vals.reshape(-1)])
+
+
+def _build_idx4(buf_rows, slot_rows, need_rows, append_rows):
+    """[4, b] padded index sets for _gather_detail, or None when all
+    four are empty.  All sets pad to ONE bucket so the fused gather
+    compiles per bucket size, not per size combination; the pad repeats
+    the last real row (duplicate gathers of one row are benign)."""
+    if not (buf_rows or append_rows or slot_rows or need_rows):
+        return None
+    b = _bucket(
+        max(len(buf_rows), len(append_rows), len(slot_rows), len(need_rows))
+    )
+    idx4 = np.zeros((4, b), np.int32)
+    for row_i, rows in enumerate(
+        (buf_rows, slot_rows, need_rows, append_rows)
+    ):
+        if rows:
+            idx4[row_i, : len(rows)] = rows
+            idx4[row_i, len(rows):] = rows[-1]
+    return idx4
+
+
+def _fetch_detail_vals(state, out, idx4, sum_rows, put, O, M, E, P, W):
+    """Gather post-step detail and/or per-row values with the MINIMUM
+    number of sync round trips: one fused dispatch+readback when both
+    are needed, one when only one is.  Returns (detail_tuple_or_None,
+    vals_np_or_None) where detail_tuple is _split_detail's output.
+
+    The fused program is compiled per (detail-bucket, sum-bucket) shape
+    pair but the warm loops only warm EQUAL pairs (review finding), so
+    the buckets are equalized whenever padding is cheap: sum rows up is
+    always cheap (N_VALS ints/row); detail rows up only until ~1 MB of
+    padded transfer.  A mismatched pair beyond that uses the two
+    separate per-bucket-warmed gathers instead of an unwarmed compile.
+    """
+    detail = vals_np = None
+    if idx4 is not None and sum_rows:
+        b = idx4.shape[1]
+        bs = _bucket(len(sum_rows))
+        K = _detail_width(O, M, E, P, W)
+        if bs < b:
+            bs = b  # pad sum rows up: N_VALS ints per padded row
+        elif bs > b and (bs - b) * K * 4 <= 1_000_000:
+            idx4 = np.concatenate(
+                [idx4, np.repeat(idx4[:, -1:], bs - b, axis=1)], axis=1
+            )
+            b = bs
+        if b == bs:
+            flat = np.asarray(
+                _gather_detail_vals(
+                    state, out, put(jnp.asarray(idx4)),
+                    put(jnp.asarray(_pad_idx(sum_rows, bs))),
+                )
+            )
+            detail = _split_detail(
+                flat[: b * K].reshape(b, K), O, M, E, P, W
+            )
+            vals_np = flat[b * K:].reshape(-1, N_VALS)
+            return detail, vals_np
+    if idx4 is not None:
+        detail = _split_detail(
+            np.asarray(_gather_detail(state, out, put(jnp.asarray(idx4)))),
+            O, M, E, P, W,
+        )
+    if sum_rows:
+        vals_np = np.asarray(
+            _gather_vals(state, out, put(jnp.asarray(_pad_idx(sum_rows))))
+        )
+    return detail, vals_np
 
 
 @jax.jit
@@ -490,6 +580,10 @@ class VectorStepEngine(IStepEngine):
             _scatter_rows(st, pos0, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             _gather_vals(st, out, self._put(jnp.zeros((b,), jnp.int32)))
+            _gather_detail_vals(
+                st, out, self._put(jnp.zeros((4, b), jnp.int32)),
+                self._put(jnp.zeros((b,), jnp.int32)),
+            )
             b <<= 1
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
@@ -1225,38 +1319,17 @@ class VectorStepEngine(IStepEngine):
             g for _, g, _ in live
             if (flags[g] & _F_ANY_LIVE) or g in slot_set
         ]
-        if buf_rows or append_rows or slot_rows or need_rows:
-            # pad all four index sets to ONE bucket so the fused gather
-            # compiles per bucket size, not per size combination
-            b = _bucket(
-                max(len(buf_rows), len(append_rows), len(slot_rows),
-                    len(need_rows))
-            )
-            idx4 = np.zeros((4, b), np.int32)
-            for row_i, rows in enumerate(
-                (buf_rows, slot_rows, need_rows, append_rows)
-            ):
-                if rows:
-                    idx4[row_i, : len(rows)] = rows
-                    idx4[row_i, len(rows):] = rows[-1]
-            flat = np.asarray(
-                _gather_detail(new_state, out, self._put(jnp.asarray(idx4)))
-            )  # ONE device dispatch, ONE D2H copy
-            (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t, ring_c) = (
-                _split_detail(flat, self.O, self.M, self.E, self.P, self.W)
-            )
+        idx4 = _build_idx4(buf_rows, slot_rows, need_rows, append_rows)
+        detail, vals_np = _fetch_detail_vals(
+            new_state, out, idx4, sum_rows, self._put,
+            self.O, self.M, self.E, self.P, self.W,
+        )
+        if detail is not None:
+            (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
+             ring_c) = detail
         else:
             buf_np = slot_base = slot_term = ent_drop = need_np = None
             ring_t = ring_c = None
-        if sum_rows:
-            vals_np = np.asarray(
-                _gather_vals(
-                    new_state, out,
-                    self._put(jnp.asarray(_pad_idx(sum_rows))),
-                )
-            )
-        else:
-            vals_np = None
         buf_at = {g: k for k, g in enumerate(buf_rows)}
         ring_at = {g: k for k, g in enumerate(append_rows)}
         slot_at = {g: k for k, g in enumerate(slot_rows)}
