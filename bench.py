@@ -314,7 +314,7 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
     }
 
 
-def phase_c(jax, SHARDS: int, duration: float, *, inflight: int = 4,
+def phase_c(jax, SHARDS: int, duration: float, *, inflight: int = 8,
             workers: int = 8) -> dict:
     """PRODUCT-PATH consensus throughput: pipelined proposals through the
     PUBLIC NodeHost API — sessions, futures, colocated device engine,

@@ -497,6 +497,31 @@ class Node:
             self._pending_ticks = 0
         return si
 
+    def drain_ticks_only(self, step_cap: int):
+        """Consume ONLY the tick inputs — the lock-free ticker lane plus
+        the deferred backlog — applying the same two caps as the full
+        path (``drain_step_inputs``'s election-window gulp cap, then the
+        per-launch ``step_cap`` with defer): one definition so the
+        colocated fast tick lane and the full drain can never diverge.
+
+        LOCKING CONTRACT: caller must be the only step consumer (the
+        colocated engine's core lock); in that regime every
+        ``_pending_ticks`` writer also runs under the same lock, so no
+        ``_qlock`` is needed.  Returns ``(ticks, gc_ticks)``."""
+        lane = self._ticks_in - self._ticks_taken
+        self._ticks_taken += lane
+        total = self._pending_ticks + lane
+        ticks = min(total, self.config.election_rtt)
+        gc = total - ticks
+        if step_cap < 1:
+            step_cap = 1
+        if ticks > step_cap:
+            self._pending_ticks = ticks - step_cap
+            ticks = step_cap
+        else:
+            self._pending_ticks = 0
+        return ticks, gc
+
     def step(self) -> Optional[Update]:
         """Drain inputs into the raft peer and produce this shard's Update
         (reference: node.stepNode [U])."""

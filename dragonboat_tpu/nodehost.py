@@ -263,11 +263,17 @@ class NodeHost:
         self._env.close()
 
     def _ticker_main(self) -> None:
-        period = self.config.rtt_millisecond / 1000.0
+        import os as _os
+
+        # experiment knob: sweep the per-node loop only every Nth
+        # period, crediting N ticks at once (same logical tick rate,
+        # 1/N the per-node host cost)
+        batch = max(1, int(_os.environ.get("TICK_SWEEP_BATCH", "1")))
+        period = self.config.rtt_millisecond / 1000.0 * batch
         while not self._ticker_stop.wait(period):
             if self._ticks_paused:
                 continue
-            self._global_ticks += 1
+            self._global_ticks += batch
             with self._nodes_lock:
                 nodes = [
                     n for sid, n in self._nodes.items()
@@ -289,7 +295,8 @@ class NodeHost:
                             n.parked_at_tick = self._global_ticks
                             self._parked[n.shard_id] = n
                             continue
-                n.add_tick()
+                for _ in range(batch):
+                    n.add_tick()
                 ready.append(n.shard_id)
             if ready:
                 self.engine.notify_many(ready)

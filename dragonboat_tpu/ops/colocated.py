@@ -54,6 +54,7 @@ import numpy as np
 
 from ..engine.execengine import IStepEngine
 from ..logger import get_logger
+from ..node import StepInputs
 from ..pb import Entry
 from ..raft.raft import RaftRole
 from . import kernel as K
@@ -71,6 +72,7 @@ from .engine import (
     _R_BARRIER_IDX,
     _R_BARRIER_TERM,
     _R_COUNT,
+    _R_LEADER,
     _R_ROLE,
     _bucket,
     _place_rows,
@@ -90,9 +92,25 @@ from .engine import (
     _set_remote_snapshot,
 )
 from .route import build_route_tables, route
-from .types import APPEND_LO_NONE, I32, MT_TICK, Inbox, make_inbox
+from .types import (
+    APPEND_LO_NONE,
+    I32,
+    MT_TICK,
+    SLOT_UNUSED as SLOT_UNUSED_I,
+    Inbox,
+    make_inbox,
+)
 
 _log = get_logger("engine")
+
+import os as _os
+
+_DEBUG_LAUNCH = _os.environ.get("COLOC_DEBUG_LAUNCH", "") == "1"
+
+# fast-lane invalidation margin: re-validate a row's int32 headroom via
+# the full plan well before the hard 2^31 ceiling (margin >> M*E and
+# any per-launch term burst)
+_LIM_SOFT = 2**31 - 2**24
 
 
 # per-launch [G, 4] host-upload lane assignments: every per-launch [G]
@@ -187,9 +205,34 @@ def _route_step(old_state, new_state, out, dest, rank, combo,
     return merged, regions, jnp.stack(list(stats)), packed, flags
 
 
-@functools.partial(jax.jit, static_argnames=("CAP_D", "CAP_S"))
+# deterministic select-capacity ladder (clamped to G at use): free-form
+# adaptive capacities keyed a fresh XLA program per distinct tuple and
+# the mid-run compiles froze the launch pipeline for tens of seconds on
+# the remote link (r5 finding: phase C commits arrived ~25 s late).
+# Three fixed tiers are warmed at startup, live in the persistent
+# cache, and any count beyond the big tier falls back to the exact
+# host-side gather for that launch.
+_SEL_TIERS = (
+    {"b": 16, "sl": 64, "n": 8, "a": 64, "s": 1024},
+    {"b": 64, "sl": 1024, "n": 32, "a": 1024, "s": 16384},
+    {"b": 256, "sl": 4096, "n": 64, "a": 4096, "s": 65536},
+    # storm tier for scale geometries (mass-start elections append the
+    # become-leader barrier on tens of thousands of rows per launch);
+    # ring rows are 2W ints and vals rows 10, so even 32k/256k rows
+    # transfer in ~100s of ms — the exact bytes the r5 two-sync path
+    # moved for the same storms, minus its extra round trips
+    {"b": 1024, "sl": 8192, "n": 256, "a": 32768, "s": 1 << 18},
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("CAP_B", "CAP_SL", "CAP_N", "CAP_A", "CAP_S",
+                     "HOST_OFF"),
+)
 def _select_and_blob(merged, out, stats, packed, flags, combo,
-                     *, CAP_D: int, CAP_S: int):
+                     *, CAP_B: int, CAP_SL: int, CAP_N: int, CAP_A: int,
+                     CAP_S: int, HOST_OFF: int):
     """Device-side row selection + detail/vals gather + single-blob
     packing — the launch's ONE device->host sync.
 
@@ -198,21 +241,34 @@ def _select_and_blob(merged, out, stats, packed, flags, combo,
     (flags, stats, delivered, detail, vals).  This program mirrors the
     host's row-set computation (live/buf/append/need/slot/sum) from the
     flag word, compacts each set with a stable argsort (selected rows
-    first, ascending), gathers the detail for the first CAP_D and the
-    values for the first CAP_S rows, and concatenates EVERYTHING the
-    host reads per launch into one int32 vector.  Counts above the
-    static capacities are reported so the host can fall back to an
-    exact two-sync gather (rare; it then raises its capacity floor).
+    first, ascending), gathers each section for its own capacity, and
+    concatenates EVERYTHING the host reads per launch into one int32
+    vector.  Counts above the static capacities are reported so the
+    host can fall back to an exact multi-sync gather (rare; it then
+    raises its capacity floors).
+
+    Capacities are PER SECTION because their per-row widths differ
+    wildly: one buf row is O*N_FIELDS ints (352 at O=32) while a slot
+    row is M*(2+E) and a vals row is 10 — a shared capacity padded the
+    heavy buf section to the proposal-row cardinality (~4 MB/launch at
+    1k shards, the whole launch budget after the sync collapse).
+
+    The slot sections ship only the HOST-region columns (HOST_OFF =
+    P*budget onward): proposals ride host slots exclusively — forwarded
+    PROPOSE is never device-routed — so the routed-region columns are
+    always SLOT_UNUSED/0 and the host re-pads them for free.
 
     Blob layout (all int32):
       [0:G]               flags
       [G:G+G*nw]          delivered bits (bitcast u32)
       [+6]                route stats
       [+5]                counts: n_buf, n_slot, n_need, n_append, n_sum
-      [+4*CAP_D]          row ids: buf | slot | need | append
-      [+CAP_S]            row ids: sum
-      [+CAP_D*K]          detail (engine._gather_detail packing)
-      [+CAP_S*N_VALS]     values (engine._gather_vals packing)
+      [+CAP_B]            row ids: buf   | [+CAP_B*O*NF]    out.buf rows
+      [+CAP_SL]           row ids: slot  | [+CAP_SL*M*(2+E)] slot_base|
+                                           slot_term | ent_drop rows
+      [+CAP_N]            row ids: need  | [+CAP_N*P]       need rows
+      [+CAP_A]            row ids: append| [+CAP_A*2W]      ring rows
+      [+CAP_S]            row ids: sum   | [+CAP_S*N_VALS]  values
     """
     G = flags.shape[0]
     alive = combo[:, _C_ALIVE] != 0
@@ -236,22 +292,29 @@ def _select_and_blob(merged, out, stats, packed, flags, combo,
             jnp.sum(sel, dtype=I32),
         )
 
-    rows_buf, n_buf = pick(buf_sel, CAP_D)
-    rows_slot, n_slot = pick(slot_sel, CAP_D)
-    rows_need, n_need = pick(need_sel, CAP_D)
-    rows_append, n_append = pick(append_sel, CAP_D)
+    rows_buf, n_buf = pick(buf_sel, CAP_B)
+    rows_slot, n_slot = pick(slot_sel, CAP_SL)
+    rows_need, n_need = pick(need_sel, CAP_N)
+    rows_append, n_append = pick(append_sel, CAP_A)
     rows_sum, n_sum = pick(sum_sel, CAP_S)
-    idx4 = jnp.stack([rows_buf, rows_slot, rows_need, rows_append])
-    detail = _gather_detail(merged, out, idx4)      # [CAP_D, K]
     vals = _gather_vals(merged, out, rows_sum)      # [CAP_S, N_VALS]
     return jnp.concatenate([
         flags,
         jax.lax.bitcast_convert_type(packed, jnp.int32).reshape(-1),
         stats.astype(I32),
         jnp.stack([n_buf, n_slot, n_need, n_append, n_sum]),
-        idx4.reshape(-1),
+        rows_buf,
+        out.buf[rows_buf].reshape(-1),
+        rows_slot,
+        out.slot_base[rows_slot][:, HOST_OFF:].reshape(-1),
+        out.slot_term[rows_slot][:, HOST_OFF:].reshape(-1),
+        out.ent_drop[rows_slot][:, HOST_OFF:].reshape(-1),
+        rows_need,
+        out.need_snapshot[rows_need].reshape(-1),
+        rows_append,
+        merged.ring_term[rows_append].reshape(-1),
+        merged.ring_cc[rows_append].reshape(-1),
         rows_sum,
-        detail.reshape(-1),
         vals.reshape(-1),
     ])
 
@@ -365,10 +428,10 @@ class ColocatedVectorEngine(VectorStepEngine):
         # blob (see _select_and_blob): detail rows are ~2 KB each so
         # CAP_D tracks actual peaks tightly; vals rows are 40 B so
         # CAP_S can ride elections up to G cheaply
-        self._cap_d = min(capacity, 64)
-        self._cap_s = min(capacity, 1024)
-        self._need_d_hist: List[int] = [1]
-        self._need_s_hist: List[int] = [1]
+        # deterministic select-capacity tier (see _SEL_TIERS): index into
+        # the warmed ladder + the consecutive-fits-lower-tier streak
+        self._sel_tier = 0
+        self._sel_fit_streak = 0
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         # loop-invariant delivered-bit unpack tables (word index and
@@ -388,6 +451,9 @@ class ColocatedVectorEngine(VectorStepEngine):
     def _compute_base(self, r) -> int:
         # the SHARD's shared base, not a per-row quantity — see __init__
         return self._shard_base.get(r.shard_id, 0)
+
+    def _tier_caps(self, t: int) -> Dict[str, int]:
+        return {k: min(self.capacity, v) for k, v in _SEL_TIERS[t].items()}
 
     # -- row identity ---------------------------------------------------
     def _row_key(self, node):
@@ -591,14 +657,15 @@ class ColocatedVectorEngine(VectorStepEngine):
         merged_w, _regions_w, stats_w, packed_w, flags_w = _route_step(
             st, new_st, out, dest, rank, combo, PB=P * B, E=E, budget=B
         )
-        # warm both the startup caps and the adaptive floor pair — the
-        # first light-load launches shrink the caps to the floor and
-        # would otherwise recompile over the tunnel (review finding)
-        for cd, cs in {(self._cap_d, self._cap_s),
-                       (min(G, 8), min(G, 64))}:
+        # warm EVERY ladder tier: tier changes mid-run must hit the
+        # (persistent) cache, never a fresh tunnel compile — a mid-run
+        # compile froze the launch pipeline for tens of seconds (r5)
+        for t in range(len(_SEL_TIERS)):
+            caps = self._tier_caps(t)
             _select_and_blob(
                 merged_w, out, stats_w, packed_w, flags_w, combo,
-                CAP_D=cd, CAP_S=cs,
+                CAP_B=caps["b"], CAP_SL=caps["sl"], CAP_N=caps["n"],
+                CAP_A=caps["a"], CAP_S=caps["s"], HOST_OFF=P * B,
             )
         from .engine import _gather_rows, _scatter_rows, _select_rows
 
@@ -618,11 +685,6 @@ class ColocatedVectorEngine(VectorStepEngine):
             _scatter_rows(st, pos0, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             _gather_vals(st, out, idx)
-            # the production path fuses both gathers into one program;
-            # warm the common same-bucket pairing
-            _gather_detail_vals(
-                st, out, self._put(jnp.zeros((4, b), jnp.int32)), idx
-            )
             _scatter_inbox_rows(
                 host3, pos0,
                 self._put(Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
@@ -878,9 +940,72 @@ class ColocatedVectorEngine(VectorStepEngine):
             (_time.perf_counter() - _t0) * 1000
         )
         _t0 = _time.perf_counter()
+        n_fast = 0
         for node in nodes:
             if node.stopped or node.stopping:
                 continue
+            # ---- fast tick lane -------------------------------------
+            # A clean resident row whose ONLY input is the lock-free
+            # tick lane skips the drain lock and the full classifier:
+            # the static checks were proven by the last full plan
+            # (meta.plan_ok) and everything that can change them either
+            # arrives through the queues (checked empty right here,
+            # GIL-atomic truthiness) or invalidates plan_ok at its
+            # source.  At 50k rows the full per-row plan was ~57 us and
+            # t_plan was 152 s of a 269 s election (10k-shard TPU run);
+            # the fast lane is ~5 us.
+            g = self._row_of.get(self._row_key(node))
+            meta = self._meta.get(g) if g is not None else None
+            if (
+                meta is not None
+                and meta.node is node  # not a stale pre-restart binding
+                and meta.plan_ok
+                and not meta.dirty
+                and meta.esc_hold == 0
+                and node not in self._save_quarantine
+                and not (
+                    node._received
+                    or node._proposals
+                    or node._read_indexes
+                    or node._config_changes
+                    or node._cc_to_apply
+                    or node._snapshot_reqs
+                    or node._leader_transfers
+                )
+            ):
+                r = node.peer.raft
+                if not (
+                    r.snapshotting
+                    or r.read_index.pending
+                    or r.read_index.queue
+                ):
+                    # ONE shared definition of the tick drain/cap/defer
+                    # arithmetic (node.drain_ticks_only) — see its
+                    # locking contract: this worker holds the core lock
+                    ticks, gc_t = node.drain_ticks_only(
+                        r.election_timeout // 2
+                    )
+                    q = node.quiesce
+                    if q.enabled and ticks:
+                        busy = bool(self._behind[g])
+                        no_leader = int(self._mirror[_R_LEADER, g]) == 0
+                        was = q.quiesced
+                        ticks_dev = q.tick_n(ticks, busy=busy,
+                                             block=no_leader)
+                        if q.quiesced and not was:
+                            node.broadcast_quiesce_enter()
+                    else:
+                        ticks_dev = ticks
+                    n_fast += 1
+                    if ticks_dev:
+                        si = StepInputs(ticks=ticks, gc_ticks=gc_t)
+                        batch.append(
+                            (node, g, si, [("tick", ticks_dev)])
+                        )
+                    else:
+                        _tick_bookkeeping(node, ticks + gc_t)
+                    continue
+            # ---- full path ------------------------------------------
             si = node.drain_step_inputs()
             if self._static_host_only(node):
                 host_rows.append((node, si))
@@ -897,6 +1022,8 @@ class ColocatedVectorEngine(VectorStepEngine):
             if plan is None:
                 host_rows.append((node, si))
                 continue
+            # every static eligibility check passed: arm the fast lane
+            self._meta[g].plan_ok = True
             if not plan and not self._meta[g].dirty:
                 _tick_bookkeeping(node, si.ticks + si.gc_ticks)
                 continue
@@ -920,6 +1047,10 @@ class ColocatedVectorEngine(VectorStepEngine):
             if u is not None:
                 updates.append((node, u))
 
+        if n_fast:
+            self.stats["fast_lane_rows"] = self.stats.get(
+                "fast_lane_rows", 0
+            ) + n_fast
         self.stats["t_plan_ms"] += int((_time.perf_counter() - _t0) * 1000)
         if batch or self._pending_live:
             if self._pending_live or any(plan for _, _, _, plan in batch):
@@ -1040,6 +1171,24 @@ class ColocatedVectorEngine(VectorStepEngine):
             # a prior launch failure consumed the donated pending inbox
             # and could not rebuild it (see the handler below)
             self._pending = self._put_rows(make_inbox(G, P * B, E))
+        if _DEBUG_LAUNCH:
+            # debug-only sync: how much PRIOR device work (uploads,
+            # materialize, inbox scatters) is still in flight?
+            import sys as _sys
+            _td = _time.perf_counter()
+            np.asarray(jax.device_get(old_state.term[:1]))
+            _occ_h = np.asarray(jax.device_get(
+                (host_inbox.mtype != 0).sum(axis=1)))
+            _occ_p = np.asarray(jax.device_get(
+                (self._pending.mtype != 0).sum(axis=1)))
+            print(
+                f"[pre ] prior-work wait "
+                f"{(_time.perf_counter() - _td) * 1000:.0f} ms "
+                f"n_occ_max={int((_occ_h + _occ_p).max())} "
+                f"occ_mean={float((_occ_h + _occ_p).mean()):.2f} "
+                f"ticks_max={int(tick_counts.max())}",
+                file=_sys.stderr, flush=True,
+            )
         _t0 = _time.perf_counter()
         try:
             with annotate("raft-colocated-step"):
@@ -1052,23 +1201,67 @@ class ColocatedVectorEngine(VectorStepEngine):
                     old_state, host_inbox, self._pending, combo,
                     out_capacity=self.O,
                 )
+                self.stats["t_dev_step_ms"] = self.stats.get(
+                    "t_dev_step_ms", 0
+                ) + int((_time.perf_counter() - _t0) * 1000)
+                if _DEBUG_LAUNCH:
+                    import sys as _sys
+                    _td = _time.perf_counter()
+                    np.asarray(jax.device_get(new_state.term[:1]))
+                    print(
+                        f"[asm ] assemble+step exec "
+                        f"{(_time.perf_counter() - _td) * 1000:.0f} ms",
+                        file=_sys.stderr, flush=True,
+                    )
+                _t1 = _time.perf_counter()
                 merged, regions, stats_dev, packed_dev, flags_dev = (
                     _route_step(
                         old_state, new_state, out, self._dest_dev,
                         self._rank_dev, combo, PB=P * B, E=E, budget=B,
                     )
                 )
+                self.stats["t_dev_route_ms"] = self.stats.get(
+                    "t_dev_route_ms", 0
+                ) + int((_time.perf_counter() - _t1) * 1000)
+                if _DEBUG_LAUNCH:
+                    import sys as _sys
+                    _td = _time.perf_counter()
+                    np.asarray(jax.device_get(flags_dev[:1]))
+                    print(
+                        f"[chain] step+route exec "
+                        f"{(_time.perf_counter() - _td) * 1000:.0f} ms",
+                        file=_sys.stderr, flush=True,
+                    )
+                _t1 = _time.perf_counter()
                 # the launch's ONE sync round trip: flags + delivered +
                 # stats + device-selected detail/vals rows in one blob
                 # (every separate np.asarray costs ~100 ms of tunnel
                 # latency regardless of size; r5 paid 5 per launch)
-                CAP_D, CAP_S = self._cap_d, self._cap_s
-                blob = np.asarray(
-                    _select_and_blob(
-                        merged, out, stats_dev, packed_dev, flags_dev,
-                        combo, CAP_D=CAP_D, CAP_S=CAP_S,
-                    )
+                caps = self._tier_caps(self._sel_tier)
+                blob_dev = _select_and_blob(
+                    merged, out, stats_dev, packed_dev, flags_dev,
+                    combo, CAP_B=caps["b"], CAP_SL=caps["sl"],
+                    CAP_N=caps["n"], CAP_A=caps["a"],
+                    CAP_S=caps["s"], HOST_OFF=P * B,
                 )
+                self.stats["t_dev_sel_ms"] = self.stats.get(
+                    "t_dev_sel_ms", 0
+                ) + int((_time.perf_counter() - _t1) * 1000)
+                _t1 = _time.perf_counter()
+                blob = np.asarray(blob_dev)
+                _blob_ms = int((_time.perf_counter() - _t1) * 1000)
+                self.stats["t_dev_blob_ms"] = self.stats.get(
+                    "t_dev_blob_ms", 0
+                ) + _blob_ms
+                if _DEBUG_LAUNCH:
+                    import sys as _sys
+
+                    print(
+                        f"[launch {self.stats['launches']}] tier="
+                        f"{self._sel_tier} batch={len(batch)} "
+                        f"blob_ms={_blob_ms} bytes={blob.nbytes}",
+                        file=_sys.stderr, flush=True,
+                    )
                 nw = (self.O + 31) // 32
                 flags = blob[:G]
         except BaseException:
@@ -1090,19 +1283,33 @@ class ColocatedVectorEngine(VectorStepEngine):
             raise
         self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
-        pos = G + G * nw
-        rstats = blob[pos:pos + 6]
-        pos += 6
-        sel_counts = blob[pos:pos + 5]
-        pos += 5
-        sel_rows4 = blob[pos:pos + 4 * CAP_D].reshape(4, CAP_D)
-        pos += 4 * CAP_D
-        sel_rows_sum = blob[pos:pos + CAP_S]
-        pos += CAP_S
-        Kd = _detail_width(self.O, M + P * B, E, P, self.W)
-        sel_detail = blob[pos:pos + CAP_D * Kd].reshape(CAP_D, Kd)
-        pos += CAP_D * Kd
-        sel_vals = blob[pos:].reshape(CAP_S, N_VALS)
+        _parse = [G + G * nw]
+
+        def take(n, shape=None):
+            part = blob[_parse[0]:_parse[0] + n]
+            _parse[0] += n
+            return part.reshape(shape) if shape is not None else part
+
+        rstats = take(6)
+        sel_counts = take(5)
+        sel_rows_buf = take(caps["b"])
+        sel_buf = take(
+            caps["b"] * self.O * N_FIELDS_BUF,
+            (caps["b"], self.O, N_FIELDS_BUF),
+        )
+        sel_rows_slot = take(caps["sl"])
+        # slot sections carry HOST-region columns only (see
+        # _select_and_blob); the routed-region prefix re-pads below
+        sel_slot_base = take(caps["sl"] * M, (caps["sl"], M))
+        sel_slot_term = take(caps["sl"] * M, (caps["sl"], M))
+        sel_ent_drop = take(caps["sl"] * M * E, (caps["sl"], M, E))
+        sel_rows_need = take(caps["n"])
+        sel_need = take(caps["n"] * P, (caps["n"], P))
+        sel_rows_append = take(caps["a"])
+        sel_ring_t = take(caps["a"] * self.W, (caps["a"], self.W))
+        sel_ring_c = take(caps["a"] * self.W, (caps["a"], self.W))
+        sel_rows_sum = take(caps["s"])
+        sel_vals = take(caps["s"] * N_VALS, (caps["s"], N_VALS))
         delivered_bits = (
             blob[G:G + G * nw].view(np.uint32).reshape(G, nw)
         )  # [G, ceil(O/32)] u32
@@ -1128,6 +1335,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         ) + int(rstats[3])
 
         # ---- escalations ---------------------------------------------
+        # ONE C-level conversion: per-row numpy scalar indexing of the
+        # flag word costs ~150 ns a touch and the loops below touch it
+        # several times per row — at 250k rows that alone was tens of
+        # ms per generation
+        flags = flags.tolist()
         batch_gs = {g for _, g, _, _ in batch}
         esc_batch = [
             (node, g, si)
@@ -1194,15 +1406,22 @@ class ColocatedVectorEngine(VectorStepEngine):
             int(x) for x in sel_counts
         )
         dev_ok = (
-            max(n_buf_d, n_slot_d, n_need_d, n_append_d) <= CAP_D
-            and n_sum_d <= CAP_S
+            n_buf_d <= caps["b"] and n_slot_d <= caps["sl"]
+            and n_need_d <= caps["n"] and n_append_d <= caps["a"]
+            and n_sum_d <= caps["s"]
         )
         if dev_ok:
-            buf_at = {int(g): k for k, g in enumerate(sel_rows4[0][:n_buf_d])}
-            slot_at = {int(g): k for k, g in enumerate(sel_rows4[1][:n_slot_d])}
-            need_at = {int(g): k for k, g in enumerate(sel_rows4[2][:n_need_d])}
+            buf_at = {
+                int(g): k for k, g in enumerate(sel_rows_buf[:n_buf_d])
+            }
+            slot_at = {
+                int(g): k for k, g in enumerate(sel_rows_slot[:n_slot_d])
+            }
+            need_at = {
+                int(g): k for k, g in enumerate(sel_rows_need[:n_need_d])
+            }
             ring_at = {
-                int(g): k for k, g in enumerate(sel_rows4[3][:n_append_d])
+                int(g): k for k, g in enumerate(sel_rows_append[:n_append_d])
             }
             sum_at = {int(g): k for k, g in enumerate(sel_rows_sum[:n_sum_d])}
             dev_ok = (
@@ -1213,9 +1432,27 @@ class ColocatedVectorEngine(VectorStepEngine):
                 and all(g in sum_at for g in sum_rows)
             )
         if dev_ok:
-            (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
-             ring_c) = _split_detail(
-                sel_detail, self.O, M + P * B, E, P, self.W)
+            # live rows only: the padded capacity tail is garbage the
+            # merge loop never indexes, and converting it cost tens of
+            # ms/launch at storm-tier capacities (review finding)
+            sel_vals = sel_vals[:n_sum_d]
+            buf_np = sel_buf
+            # re-pad the routed-region prefix the device omitted: those
+            # columns are ALWAYS unused for slot bookkeeping (forwarded
+            # PROPOSE never rides the routed regions)
+            PB = P * B
+            slot_base = np.concatenate([
+                np.full((caps["sl"], PB), SLOT_UNUSED_I, np.int32),
+                sel_slot_base,
+            ], axis=1)
+            slot_term = np.concatenate([
+                np.zeros((caps["sl"], PB), np.int32), sel_slot_term
+            ], axis=1)
+            ent_drop = np.concatenate([
+                np.zeros((caps["sl"], PB, E), np.int32), sel_ent_drop
+            ], axis=1)
+            need_np = sel_need
+            ring_t, ring_c = sel_ring_t, sel_ring_c
             vals_np = sel_vals
         else:
             # exact host-side selection (the r5 two-sync path)
@@ -1227,7 +1464,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             # regions), so the out slot arrays are M + P*B wide
             detail, vals_np = _fetch_detail_vals(
                 merged, out, idx4, sum_rows, self._put,
-                self.O, M + P * B, E, P, self.W,
+                self.O, M + P * B, E, P, self.W, allow_fused=False,
             )
             if detail is not None:
                 (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
@@ -1240,24 +1477,39 @@ class ColocatedVectorEngine(VectorStepEngine):
             slot_at = {g: k for k, g in enumerate(slot_rows)}
             need_at = {g: k for k, g in enumerate(need_rows)}
             sum_at = {g: k for k, g in enumerate(sum_rows)}
-        # adaptive select capacities: recent peaks (device counts AND
-        # host set sizes) size the next launches' blob, with power-of-
-        # two hysteresis; a change only recompiles the small select
-        # program, never the big step/route programs
-        self._need_d_hist.append(
-            max(n_buf_d, n_slot_d, n_need_d, n_append_d,
-                len(buf_rows), len(slot_rows), len(need_rows),
-                len(append_rows))
-        )
-        self._need_s_hist.append(max(n_sum_d, len(sum_rows)))
-        if len(self._need_d_hist) > 64:
-            del self._need_d_hist[0]
-            del self._need_s_hist[0]
-        self._cap_d = min(G, _bucket(max(8, 2 * max(self._need_d_hist))))
-        self._cap_s = min(G, _bucket(max(64, 2 * max(self._need_s_hist))))
+        # tier selection: promote immediately to the smallest warmed
+        # tier that fits this launch's needs (overflow used the exact
+        # fallback above, once); demote only after 64 consecutive
+        # launches that would have fit the lower tier
+        needs = {
+            "b": max(n_buf_d, len(buf_rows)),
+            "sl": max(n_slot_d, len(slot_rows)),
+            "n": max(n_need_d, len(need_rows)),
+            "a": max(n_append_d, len(append_rows)),
+            "s": max(n_sum_d, len(sum_rows)),
+        }
+        need_tier = len(_SEL_TIERS) - 1
+        for t in range(len(_SEL_TIERS)):
+            c = self._tier_caps(t)
+            if all(needs[k] <= c[k] for k in c):
+                need_tier = t
+                break
+        if need_tier > self._sel_tier:
+            self._sel_tier = need_tier
+            self._sel_fit_streak = 0
+        elif need_tier < self._sel_tier:
+            self._sel_fit_streak += 1
+            if self._sel_fit_streak >= 64:
+                self._sel_tier = need_tier
+                self._sel_fit_streak = 0
+        else:
+            self._sel_fit_streak = 0
         self.stats["t_detail_ms"] += int(
             (_time.perf_counter() - _t0) * 1000
         )
+        # one C-level conversion for the merge loop's 10-ints-per-row
+        # reads (numpy scalar -> int costs ~100 ns each)
+        vals_l = vals_np.tolist() if vals_np is not None else None
 
         from .engine import SLOT_DROPPED
 
@@ -1284,10 +1536,21 @@ class ColocatedVectorEngine(VectorStepEngine):
             if g not in sum_at:
                 # no flags, no slots: the row only ticked
                 continue
-            sv = vals_np[sum_at[g]]
-            term, vote, committed, leader, role, last = (
-                int(sv[i]) for i in range(6)
-            )
+            sv = vals_l[sum_at[g]]
+            term, vote, committed, leader, role, last = sv[:6]
+            # fast-lane invalidation: re-run the full plan when this
+            # row approaches an int32 lane limit or streams a snapshot
+            # (the only plan facts a DEVICE step can change; everything
+            # else arrives via the host queues, which the fast lane
+            # checks each launch)
+            if (
+                term > _LIM_SOFT
+                or last > _LIM_SOFT
+                or g in need_at
+            ):
+                _m = self._meta.get(g)
+                if _m is not None:
+                    _m.plan_ok = False
             committed += base
             last += base
             appended = bool(flags[g] & _F_APPEND)

@@ -280,7 +280,8 @@ def _build_idx4(buf_rows, slot_rows, need_rows, append_rows):
     return idx4
 
 
-def _fetch_detail_vals(state, out, idx4, sum_rows, put, O, M, E, P, W):
+def _fetch_detail_vals(state, out, idx4, sum_rows, put, O, M, E, P, W,
+                       allow_fused: bool = True):
     """Gather post-step detail and/or per-row values with the MINIMUM
     number of sync round trips: one fused dispatch+readback when both
     are needed, one when only one is.  Returns (detail_tuple_or_None,
@@ -292,9 +293,12 @@ def _fetch_detail_vals(state, out, idx4, sum_rows, put, O, M, E, P, W):
     always cheap (N_VALS ints/row); detail rows up only until ~1 MB of
     padded transfer.  A mismatched pair beyond that uses the two
     separate per-bucket-warmed gathers instead of an unwarmed compile.
+    ``allow_fused=False`` forces the separate gathers — the colocated
+    fallback path uses it because only the separate per-bucket programs
+    are in its warm set (a fused compile mid-run stalls the tunnel).
     """
     detail = vals_np = None
-    if idx4 is not None and sum_rows:
+    if allow_fused and idx4 is not None and sum_rows:
         b = idx4.shape[1]
         bs = _bucket(len(sum_rows))
         K = _detail_width(O, M, E, P, W)
@@ -387,13 +391,22 @@ def _tick_bookkeeping(node, ticks: int) -> None:
 
 
 class _RowMeta:
-    __slots__ = ("node", "dirty", "esc_hold")
+    __slots__ = ("node", "dirty", "esc_hold", "plan_ok")
 
     def __init__(self, node):
         self.node = node
         # dirty = the scalar Raft is authoritative and the device row is
         # stale (fresh rows, cold-stepped rows, escalated rows)
         self.dirty = True
+        # plan_ok = the last FULL _plan_device pass for this row passed
+        # every static eligibility check; while it holds (and the cheap
+        # per-launch conditions — empty queues, clean row, no snapshot/
+        # read state — are re-verified inline), the colocated fast tick
+        # lane may skip the full classifier.  Invalidated by the events
+        # that can change a static check: merge-loop snapshot sends,
+        # int32-limit proximity, membership traffic (which arrives via
+        # the queues and forces the full path anyway).
+        self.plan_ok = False
         # steps to HOLD the row on the scalar path after an escalation.
         # (set via set_escalation_hold so both engines share the
         # formula.)
@@ -874,13 +887,12 @@ class VectorStepEngine(IStepEngine):
             else:
                 busy = bool(self._behind[g])
                 no_leader = int(self._mirror[_R_LEADER, g]) == 0
-            for _ in range(si.ticks):
-                was_quiesced = node.quiesce.quiesced
-                if node.quiesce.tick(busy=busy, block=no_leader):
-                    if not was_quiesced:
-                        node.broadcast_quiesce_enter()
-                else:
-                    ticks += 1
+            was_quiesced = node.quiesce.quiesced
+            ticks += node.quiesce.tick_n(
+                si.ticks, busy=busy, block=no_leader
+            )
+            if node.quiesce.quiesced and not was_quiesced:
+                node.broadcast_quiesce_enter()
         if ticks:
             slots.append(("tick", ticks))
         return slots
@@ -932,6 +944,10 @@ class VectorStepEngine(IStepEngine):
             self._mirror[_R_ROLE, g] = int(r.role)
             self._mirror[_R_LAST, g] = r.log.last_index() - self._base[g]
             self._meta[g].dirty = False
+            # the scalar excursion may have changed the static plan
+            # facts (term, log span, remotes); require a fresh full
+            # plan before the fast tick lane re-engages
+            self._meta[g].plan_ok = False
 
     def _materialize_rows(
         self, gs: List[int], state: Optional[DeviceState] = None

@@ -401,3 +401,43 @@ class TestEntryCachePublishing:
         core = ColocatedEngineGroup(**geom)
         core.factory(None)
         assert core.core._cache_depth >= 8 * 8 * 4
+
+
+class TestColocatedQuiesce:
+    """Quiesce through the COLOCATED fast tick lane: device-resident
+    rows whose only input is the tick lane take the fast-lane quiesce
+    path (plan_ok short-circuit), must still idle out, park, and wake
+    on activity (reference: quiesceManager + workReady [U])."""
+
+    def test_quiesce_enters_and_wakes_through_fast_lane(self):
+        group, nhs = make_colocated_cluster(rtt_ms=2)
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(
+                    ADDRS, False, KVStore,
+                    colo_shard_config(rid, quiesce=True, election_rtt=10),
+                )
+            wait_for_leader(nhs)
+            s = nhs[1].get_noop_session(1)
+            propose_r(nhs[1], s, set_cmd("a", b"1"))
+
+            # idle out: threshold = election_rtt*10 = 100 ticks = 200ms
+            # at rtt 2ms; poll until every member parks the shard
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                if all(1 in nh._parked for nh in nhs.values()):
+                    break
+                time.sleep(0.05)
+            assert all(1 in nh._parked for nh in nhs.values()), [
+                dict(nh._parked) for nh in nhs.values()
+            ]
+            # fast lane must actually have engaged while idling out
+            assert group.core.stats.get("fast_lane_rows", 0) > 0
+
+            time.sleep(0.5)
+            propose_r(nhs[1], s, set_cmd("b", b"2"))
+            for rid in ADDRS:
+                assert read_r(nhs[rid], 1, "b") == b"2"
+        finally:
+            for nh in nhs.values():
+                nh.close()
