@@ -10,8 +10,15 @@ sharded across TPU chips.
 """
 __version__ = "0.1.0"
 
-from .client import Session
+from .client import Session, propose_with_retry
 from .config import Config, EngineConfig, ExpertConfig, GossipConfig, NodeHostConfig
+from .faults import (
+    Fault,
+    FaultController,
+    FaultPlan,
+    RecoverySLAViolation,
+    assert_recovery_sla,
+)
 from .nodehost import (
     NodeHost,
     NodeHostClosed,

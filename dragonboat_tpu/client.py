@@ -8,6 +8,7 @@ instead of re-applying.  ``NoOPSession`` opts out (at-most-once).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 NOOP_SERIES_ID = 0
 SERIES_ID_REGISTER = 0xFFFFFFFFFFFFFFFD
@@ -85,3 +86,64 @@ class Session:
         if self.is_noop():
             return False
         return self.series_id in (SERIES_ID_REGISTER, SERIES_ID_UNREGISTER)
+
+
+def propose_with_retry(
+    nodehost,
+    session: Session,
+    cmd: bytes,
+    *,
+    timeout: float = 10.0,
+    deadline: Optional[float] = None,
+    per_try_timeout: float = 1.0,
+    base_backoff: float = 0.02,
+    max_backoff: float = 0.5,
+    rng=None,
+):
+    """Deadline-aware proposal retry (the self-healing client path).
+
+    Retries ``nodehost.sync_propose`` on the TRANSIENT failures a
+    healthy-but-shaken cluster emits — ShardNotReady (no leader yet),
+    SystemBusy (queues full), ShardNotFound (replica restarting),
+    RequestDropped and timeouts — with jittered exponential backoff,
+    never exceeding the caller's deadline (``deadline`` as a
+    ``time.monotonic()`` instant, or ``timeout`` seconds from now).
+
+    Retrying is exactly-once-safe with a registered ``Session`` (the
+    series id is unchanged across retries, so a retried proposal that
+    already applied returns the cached result); with a ``NoOPSession``
+    a retried timeout MAY apply twice — same contract as the reference
+    client [U].  Terminal errors (InvalidTarget, rejected/terminated
+    requests) propagate immediately.  Returns the proposal Result.
+    """
+    import random as _random
+    import time as _time
+
+    # lazy: nodehost imports this module
+    from .nodehost import RequestDropped, TimeoutError_
+    from .request import ShardNotFound, ShardNotReady, SystemBusy
+
+    retryable = (ShardNotReady, ShardNotFound, SystemBusy, RequestDropped,
+                 TimeoutError_)
+    rng = rng or _random.Random()
+    if deadline is None:
+        deadline = _time.monotonic() + timeout
+    backoff = base_backoff
+    attempt = 0
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError_(
+                f"proposal deadline exhausted after {attempt} attempt(s)"
+            )
+        try:
+            return nodehost.sync_propose(
+                session, cmd, timeout=min(per_try_timeout, remaining)
+            )
+        except retryable:
+            attempt += 1
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise
+            _time.sleep(min(backoff * (0.5 + rng.random()), remaining))
+            backoff = min(backoff * 2.0, max_backoff)

@@ -1,0 +1,630 @@
+"""Unified deterministic fault-injection subsystem (the nemesis).
+
+reference: the drummer/monkeytest chaos methodology [U] — long-running
+clusters shaken by partitions, message loss, disk faults and crash
+cycles, with invariant checks after every heal.  This module replaces
+the three ad-hoc injection points that grew organically (the in-proc
+transport's ``drop_hook``, ``StrictMemFS.fault_hook`` and the tan
+LogDB's ``fault_hook``) with ONE seeded, declarative fault plane that
+every layer consumes:
+
+* **wire** — both raw transports (``transport/inproc.py``,
+  ``transport/tcp.py``) pass every outbound ``MessageBatch``/``Chunk``
+  through :meth:`FaultController.on_wire`, which applies symmetric or
+  asymmetric partitions, probabilistic drop / delay / duplicate /
+  reorder, and snapshot-chunk corruption.
+* **storage** — ``StrictMemFS`` and the tan WAL consult
+  :meth:`on_fs_op` before data-touching operations; active fault
+  windows raise injected fsync / torn-write errors.
+* **engine** — the device step engines consult
+  :meth:`on_engine_step` per row per launch; an active ``escalate``
+  fault forces the kernel-escalation recovery path (discard device
+  effects, replay on the scalar).
+* **process** — ``crash`` faults call harness-registered kill/restart
+  callbacks, so replica crash-restart cycles ride the same schedule.
+
+Determinism contract: a plan is executed strictly in schedule order by
+one nemesis thread, and :attr:`FaultController.event_log` records each
+activation/heal with its plan step index and parameters — NO wall-clock
+values — so the same seed and plan produce a byte-identical event log
+on every run.  Per-payload decisions (e.g. which messages a 30%% drop
+window actually eats) come from per-lane RNGs seeded from
+``(seed, kind, source, target, payload_type)``; their sequence is
+deterministic per lane even though cross-lane interleaving is
+scheduling-dependent.
+
+Seed-replay workflow: every chaos failure prints ``controller.seed``;
+re-running with that seed replays the identical fault schedule (see
+docs/FAULTS.md).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from random import Random
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .logger import get_logger
+
+_log = get_logger("faults")
+
+# operations on_fs_op treats as durability points
+_SYNC_OPS = ("sync", "sync_dir", "wal_append")
+# operations that mutate file data (torn-write / write-error windows)
+_WRITE_OPS = ("write", "create", "truncate", "rename", "unlink", "wal_append")
+
+WIRE_KINDS = (
+    "partition",
+    "drop",
+    "delay",
+    "duplicate",
+    "reorder",
+    "chunk_corrupt",
+)
+FS_KINDS = ("fsync_err", "torn_write", "write_err")
+ENGINE_KINDS = ("escalate",)
+PROCESS_KINDS = ("crash",)
+ALL_KINDS = WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS
+
+
+class TornWriteError(OSError):
+    """Raised by ``on_fs_op`` inside a torn-write window.  ``keep``
+    tells a cooperating FS what fraction of the write to apply before
+    failing (StrictMemFS persists that prefix, reproducing a torn
+    final write without a full crash)."""
+
+    def __init__(self, keep: float):
+        super().__init__("nemesis: injected torn write")
+        self.keep = keep
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One declarative fault.
+
+    ``at``/``duration`` are seconds from plan start (one-shot faults
+    use duration 0; ``crash`` interprets duration as downtime before
+    the restart callback fires).  ``targets`` scopes the fault:
+    transport addresses for wire kinds (a ``partition``'s targets are
+    side A), component keys for fs kinds, shard ids for ``escalate``,
+    harness keys for ``crash``; empty = every installed component.
+    ``p`` is the per-event probability inside the window.
+    """
+
+    kind: str
+    at: float = 0.0
+    duration: float = 0.0
+    targets: Tuple = ()
+    p: float = 1.0
+    delay: float = 0.05  # kind="delay": seconds each affected send stalls
+    both_ways: bool = True  # kind="partition": symmetric vs A->rest only
+
+    def __post_init__(self):
+        if self.kind not in ALL_KINDS:
+            raise ValueError(f"unknown fault kind: {self.kind!r}")
+
+    def describe(self) -> str:
+        return (
+            f"{self.kind}(at={self.at:g},dur={self.duration:g},"
+            f"targets={tuple(self.targets)!r},p={self.p:g},"
+            f"delay={self.delay:g},both_ways={self.both_ways})"
+        )
+
+
+@dataclass
+class FaultPlan:
+    """An ordered fault schedule.  ``describe()`` is the canonical
+    byte-form used by the determinism tests — two plans are the same
+    schedule iff their describe() strings are equal."""
+
+    faults: List[Fault] = field(default_factory=list)
+
+    def describe(self) -> str:
+        return "\n".join(f.describe() for f in self.faults)
+
+    @staticmethod
+    def randomized(
+        seed: int,
+        *,
+        addrs: Sequence[str],
+        fs_keys: Sequence = (),
+        crash_keys: Sequence = (),
+        shards: Sequence[int] = (),
+        rounds: int = 8,
+        mean_gap: float = 0.8,
+        mean_duration: float = 0.8,
+    ) -> "FaultPlan":
+        """Generate a randomized-but-deterministic plan: same arguments
+        and seed produce the identical plan (the soak entry point's
+        replay contract)."""
+        rng = Random(seed)
+        addrs = list(addrs)
+        kinds = ["partition", "drop", "delay", "duplicate", "reorder"]
+        if fs_keys:
+            kinds += ["fsync_err", "torn_write"]
+        if crash_keys:
+            kinds.append("crash")
+        if shards:
+            kinds.append("escalate")
+        t = 0.0
+        faults: List[Fault] = []
+        for _ in range(rounds):
+            t += rng.uniform(0.2, 2 * mean_gap)
+            kind = rng.choice(kinds)
+            dur = rng.uniform(0.3, 2 * mean_duration)
+            if kind == "partition":
+                side = tuple(
+                    sorted(rng.sample(addrs, rng.choice((1, len(addrs) // 2 or 1))))
+                )
+                faults.append(Fault(kind, at=t, duration=dur, targets=side))
+            elif kind in ("drop", "delay", "duplicate", "reorder"):
+                src = tuple(sorted(rng.sample(addrs, rng.randrange(1, len(addrs) + 1))))
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=dur,
+                        targets=src,
+                        p=round(rng.uniform(0.1, 0.6), 3),
+                        delay=round(rng.uniform(0.01, 0.1), 3),
+                    )
+                )
+            elif kind in ("fsync_err", "torn_write"):
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=dur,
+                        targets=(rng.choice(list(fs_keys)),),
+                        p=round(rng.uniform(0.3, 0.9), 3),
+                    )
+                )
+            elif kind == "crash":
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=max(0.4, dur),
+                        targets=(rng.choice(list(crash_keys)),),
+                    )
+                )
+            else:  # escalate
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=dur,
+                        targets=tuple(sorted(rng.sample(list(shards), 1))),
+                        p=round(rng.uniform(0.2, 0.8), 3),
+                    )
+                )
+            t += dur
+        return FaultPlan(faults)
+
+
+class _BoundFS:
+    """Per-component fs-hook adapter: remembers which component key the
+    hook belongs to (the controller scopes fs faults by key)."""
+
+    __slots__ = ("_ctl", "_key")
+
+    def __init__(self, ctl: "FaultController", key):
+        self._ctl = ctl
+        self._key = key
+
+    def on_fs_op(self, op: str, path: str) -> None:
+        self._ctl.on_fs_op(self._key, op, path)
+
+
+class RecoverySLAViolation(AssertionError):
+    """The cluster failed to re-converge within the tick bound after
+    the fault plan healed."""
+
+
+def assert_recovery_sla(
+    nhs: Dict,
+    shard_id: int = 1,
+    sla_ticks: int = 5000,
+    cmd: Optional[bytes] = None,
+    rtt_ms: Optional[int] = None,
+) -> int:
+    """Recovery-SLA invariant: after faults heal, the cluster must
+    re-establish FULL leader coverage (every NodeHost knows the same
+    leader) and — when ``cmd`` is given — resume commit progress, all
+    within ``sla_ticks`` logical ticks (converted to wall time via the
+    hosts' rtt).  Returns the leader id.  Raises
+    :class:`RecoverySLAViolation` otherwise."""
+    hosts = list(nhs.values())
+    if not hosts:
+        raise ValueError("no nodehosts")
+    if rtt_ms is None:
+        rtt_ms = max(nh.config.rtt_millisecond for nh in hosts)
+    budget = sla_ticks * rtt_ms / 1000.0
+    deadline = time.monotonic() + budget
+    leader = None
+    while time.monotonic() < deadline:
+        seen = set()
+        for nh in hosts:
+            try:
+                lid, ok = nh.get_leader_id(shard_id)
+            except Exception:  # noqa: BLE001 — shard mid-restart etc.
+                # the whole point of the SLA is that a just-healed
+                # cluster may still be re-adding shards: not-found /
+                # closed hosts count as "not converged yet", not a crash
+                ok = False
+            if not ok:
+                break
+            seen.add(lid)
+        else:
+            if len(seen) == 1:
+                leader = seen.pop()
+                break
+        time.sleep(0.02)
+    if leader is None:
+        raise RecoverySLAViolation(
+            f"no full leader coverage for shard {shard_id} within "
+            f"{sla_ticks} ticks ({budget:.1f}s)"
+        )
+    if cmd is not None:
+        from .client import propose_with_retry
+
+        nh = hosts[0]
+        try:
+            propose_with_retry(
+                nh,
+                nh.get_noop_session(shard_id),
+                cmd,
+                deadline=deadline,
+                per_try_timeout=1.0,
+            )
+        except Exception as e:  # noqa: BLE001 — any terminal error is a miss
+            raise RecoverySLAViolation(
+                f"no commit progress on shard {shard_id} within "
+                f"{sla_ticks} ticks ({budget:.1f}s): {e!r}"
+            ) from e
+    return leader
+
+
+class FaultController:
+    """Seeded nemesis: owns the fault plan, the hook plane and the
+    deterministic event log.
+
+    Imperative use (most ported chaos tests)::
+
+        ctl = FaultController(seed=7)
+        ctl.install_transport(nh.transport)
+        f = ctl.activate(Fault("partition", targets=("nh-1",)))
+        ... shake ...
+        ctl.deactivate(f)            # or ctl.heal_wire() / ctl.heal_all()
+
+    Declarative use (the soak / acceptance scenarios)::
+
+        ctl = FaultController(seed=7, plan=FaultPlan([...]))
+        ctl.start(); ctl.wait()
+        assert_recovery_sla(nhs, cmd=...)
+    """
+
+    def __init__(self, seed: int = 0, plan: Optional[FaultPlan] = None):
+        self.seed = seed
+        self.plan = plan or FaultPlan()
+        self._lock = threading.RLock()
+        self._active: List[Fault] = []
+        self._lane_rngs: Dict[Tuple, Random] = {}
+        # (source, target) -> payload held back by an active reorder
+        self._held: Dict[Tuple[str, str], object] = {}
+        self.event_log: List[Tuple] = []
+        self._seq = 0
+        self.stats: Dict[str, int] = {}
+        self._crash_fn: Optional[Callable] = None
+        self._restart_fn: Optional[Callable] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # installation
+    # ------------------------------------------------------------------
+    def install_transport(self, transport) -> None:
+        """Install on a ``Transport`` wrapper (propagates to its raw
+        ITransport) or directly on a raw transport."""
+        setter = getattr(transport, "set_fault_injector", None)
+        if setter is not None:
+            setter(self)
+        else:
+            transport.fault_injector = self
+
+    def install_vfs(self, key, fs) -> None:
+        fs.fault_injector = _BoundFS(self, key)
+
+    def install_logdb(self, key, logdb) -> None:
+        logdb.fault_injector = _BoundFS(self, key)
+
+    def install_engine(self, engine) -> None:
+        engine.fault_injector = self
+
+    def install_nodehost(self, key, nh) -> None:
+        """Wire one NodeHost's transport + logdb in one call."""
+        self.install_transport(nh.transport)
+        self.install_logdb(key, nh.logdb)
+
+    def set_crash_handlers(
+        self, crash_fn: Callable, restart_fn: Callable
+    ) -> None:
+        """``crash_fn(key)`` / ``restart_fn(key)`` from the harness;
+        consumed by ``crash`` faults."""
+        self._crash_fn = crash_fn
+        self._restart_fn = restart_fn
+
+    # ------------------------------------------------------------------
+    # imperative fault control
+    # ------------------------------------------------------------------
+    def activate(self, fault: Fault) -> Fault:
+        with self._lock:
+            self._active.append(fault)
+            self._record("activate", fault)
+        if fault.kind == "crash" and self._crash_fn is not None:
+            for t in fault.targets:
+                self._crash_fn(t)
+        return fault
+
+    def deactivate(self, fault: Fault) -> None:
+        with self._lock:
+            try:
+                self._active.remove(fault)
+            except ValueError:
+                return
+            self._record("heal", fault)
+            if fault.kind == "reorder" and not any(
+                f.kind == "reorder" for f in self._active
+            ):
+                # DISCARD held payloads once no reorder window remains
+                # (there is no delivery path from here).  Message-batch
+                # loss is raft-safe; a held snapshot chunk already
+                # failed its send loudly (see the transports' chunk
+                # lanes), so nothing waits on these.
+                self._held.clear()
+        if fault.kind == "crash" and self._restart_fn is not None:
+            for t in fault.targets:
+                self._restart_fn(t)
+
+    def set_partition(self, side: Sequence[str], both_ways: bool = True) -> Fault:
+        """Replace any current partition with a new one (test helper)."""
+        with self._lock:
+            for f in [f for f in self._active if f.kind == "partition"]:
+                self._active.remove(f)
+                self._record("heal", f)
+        return self.activate(
+            # sorted: callers pass sets, and describe() is the canonical
+            # byte-form of the schedule — hash-randomized set order would
+            # break cross-process event-log comparison
+            Fault("partition", targets=tuple(sorted(side)), both_ways=both_ways)
+        )
+
+    def heal_wire(self) -> None:
+        self._heal_kinds(WIRE_KINDS)
+
+    def heal_all(self) -> None:
+        self._heal_kinds(ALL_KINDS)
+
+    def _heal_kinds(self, kinds, restart: bool = True) -> None:
+        crashed = []
+        with self._lock:
+            for f in [f for f in self._active if f.kind in kinds]:
+                self._active.remove(f)
+                self._record("heal", f)
+                if f.kind == "crash":
+                    crashed.append(f)
+            self._held.clear()
+        if restart and self._restart_fn is not None:
+            for f in crashed:
+                for t in f.targets:
+                    self._restart_fn(t)
+
+    def active_faults(self) -> List[Fault]:
+        with self._lock:
+            return list(self._active)
+
+    def has_active(self, kind: str) -> bool:
+        """Cheap gate for hot paths (the engines check it once per
+        launch before paying for per-row hook calls)."""
+        with self._lock:
+            return any(f.kind == kind for f in self._active)
+
+    def _record(self, action: str, fault: Fault) -> None:
+        # plan-step-indexed, wall-clock-free: the determinism contract
+        self.event_log.append((self._seq, action, fault.describe()))
+        self._seq += 1
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+    def _draw(self, kind: str, source, target, ptype: str = "") -> float:
+        """One deterministic per-lane uniform draw.  Lanes are keyed by
+        payload type too (a MessageBatch sender thread and a snapshot
+        stream-job thread share (source, target) but must not interleave
+        draws from one RNG), and the draw happens under the controller
+        lock so concurrent lanes can't corrupt each other's sequences."""
+        key = (kind, source, target, ptype)
+        with self._lock:
+            rng = self._lane_rngs.get(key)
+            if rng is None:
+                seed = zlib.crc32(
+                    f"{self.seed}:{kind}:{source}:{target}:{ptype}".encode()
+                )
+                rng = self._lane_rngs.setdefault(key, Random(seed))
+            return rng.random()
+
+    # ------------------------------------------------------------------
+    # plan execution (nemesis thread)
+    # ------------------------------------------------------------------
+    def start(self) -> "FaultController":
+        if self._thread is not None:
+            raise RuntimeError("nemesis already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run_plan, daemon=True, name="tpu-raft-nemesis"
+        )
+        self._thread.start()
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def stop(self) -> None:
+        """Tear the nemesis down.  Active faults are healed WITHOUT
+        firing restart handlers — stop() runs from teardown/finally
+        paths where restarting a crashed node (onto a cluster being
+        closed) would only add churn, and a restart failure there would
+        mask the original test error (review finding).  Use heal_all()
+        for a mid-run heal that should restart crashed nodes."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._heal_kinds(ALL_KINDS, restart=False)
+
+    def _run_plan(self) -> None:
+        # timeline = activations + heals merged in schedule order; ties
+        # break by plan position so execution order is deterministic
+        timeline: List[Tuple[float, int, str, Fault]] = []
+        for i, f in enumerate(self.plan.faults):
+            timeline.append((f.at, i, "activate", f))
+            timeline.append((f.at + max(f.duration, 0.0), i, "heal", f))
+        timeline.sort(key=lambda e: (e[0], e[1], e[2] == "heal"))
+        t0 = time.monotonic()
+        for when, _i, action, f in timeline:
+            while not self._stop.is_set():
+                lag = when - (time.monotonic() - t0)
+                if lag <= 0:
+                    break
+                time.sleep(min(lag, 0.05))
+            if self._stop.is_set():
+                return
+            if action == "activate":
+                self.activate(f)
+            else:
+                self.deactivate(f)
+
+    # ------------------------------------------------------------------
+    # the hook plane
+    # ------------------------------------------------------------------
+    def on_wire(self, source: str, target: str, payload) -> List:
+        """Filter one outbound payload (MessageBatch or Chunk).
+        Returns the list of payloads to deliver now — possibly empty
+        (drop/partition/held), possibly longer than one (duplicate, or
+        a reorder releasing its held message)."""
+        # reorder lanes are keyed by payload TYPE too: batches and
+        # snapshot chunks share (source, target) but travel different
+        # connections — swapping across them would hand a Chunk to the
+        # message path (review finding)
+        lane = (source, target, payload.__class__.__name__)
+        with self._lock:
+            active = list(self._active)
+            held = self._held.pop(lane, None)
+        # a released held payload joins BEFORE the fault loop, so an
+        # active partition/drop window applies to it too — appending it
+        # afterwards would let a held message cross a live partition
+        # (review finding)
+        out: List = [payload] if held is None else [payload, held]
+        for f in active:
+            if not out:
+                break
+            if f.kind == "partition":
+                a = set(f.targets)
+                cut = (
+                    (source in a) != (target in a)
+                    if f.both_ways
+                    else (source in a and target not in a)
+                )
+                if cut:
+                    self._count("wire_partitioned")
+                    out = []
+            elif f.targets and source not in f.targets:
+                continue
+            elif f.kind == "drop":
+                if self._draw("drop", source, target, lane[2]) < f.p:
+                    self._count("wire_dropped")
+                    out = []
+            elif f.kind == "delay":
+                if self._draw("delay", source, target, lane[2]) < f.p:
+                    self._count("wire_delayed")
+                    time.sleep(f.delay)
+            elif f.kind == "duplicate":
+                if self._draw("duplicate", source, target, lane[2]) < f.p:
+                    self._count("wire_duplicated")
+                    out = out + [out[0]]
+            elif f.kind == "reorder":
+                if self._draw("reorder", source, target, lane[2]) < f.p:
+                    self._count("wire_reordered")
+                    with self._lock:
+                        # at most one held payload per lane; a second
+                        # trigger releases the first (swapped)
+                        if lane not in self._held:
+                            self._held[lane] = out.pop(0)
+            elif f.kind == "chunk_corrupt":
+                out = [self._maybe_corrupt(f, source, target, p) for p in out]
+        return out
+
+    def _maybe_corrupt(self, f: Fault, source, target, payload):
+        data = getattr(payload, "data", None)
+        chunk_id = getattr(payload, "chunk_id", None)
+        if chunk_id is None or not data:
+            return payload  # not a snapshot chunk (or empty/dummy)
+        if self._draw("chunk_corrupt", source, target) >= f.p:
+            return payload
+        import dataclasses
+
+        pos = min(
+            int(self._draw("chunk_corrupt_pos", source, target) * len(data)),
+            len(data) - 1,
+        )
+        corrupted = data[:pos] + bytes([data[pos] ^ 0xFF]) + data[pos + 1:]
+        self._count("chunks_corrupted")
+        return dataclasses.replace(payload, data=corrupted)
+
+    def on_fs_op(self, key, op: str, path: str) -> None:
+        """Storage hook: raise to inject an I/O error at this exact
+        durability point."""
+        with self._lock:
+            active = list(self._active)
+        for f in active:
+            if f.targets and key not in f.targets:
+                continue
+            if f.kind == "fsync_err" and op in _SYNC_OPS:
+                if self._draw("fsync_err", key, op) < f.p:
+                    self._count("fs_fsync_errors")
+                    raise OSError(f"nemesis: injected fsync error ({op} {path})")
+            elif f.kind == "torn_write" and op in ("write", "wal_append"):
+                # on a cooperating FS (StrictMemFS) the prefix persists;
+                # the WAL append path can't split a frame and treats the
+                # TornWriteError as a plain injected I/O failure
+                if self._draw("torn_write", key, op) < f.p:
+                    self._count("fs_torn_writes")
+                    raise TornWriteError(
+                        keep=self._draw("torn_write_keep", key, op)
+                    )
+            elif f.kind == "write_err" and op in _WRITE_OPS:
+                if self._draw("write_err", key, op) < f.p:
+                    self._count("fs_write_errors")
+                    raise OSError(f"nemesis: injected write error ({op} {path})")
+
+    def on_engine_step(self, shard_id: int, replica_id: int) -> bool:
+        """Engine hook: True forces the kernel-escalation recovery path
+        for this row this launch."""
+        with self._lock:
+            active = list(self._active)
+        for f in active:
+            if f.kind != "escalate":
+                continue
+            if f.targets and shard_id not in f.targets:
+                continue
+            if self._draw("escalate", shard_id, replica_id) < f.p:
+                self._count("engine_escalations")
+                return True
+        return False

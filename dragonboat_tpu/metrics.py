@@ -14,6 +14,18 @@ import time
 from typing import Callable, Dict, Optional
 
 
+def _labeled(name: str, labels) -> str:
+    """Prometheus-style labelled series name: name{k="v",...}."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def _base_name(name: str) -> str:
+    return name.split("{", 1)[0]
+
+
 class Counter:
     __slots__ = ("name", "value")
 
@@ -84,18 +96,25 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, Histogram] = {}
 
-    def counter(self, name: str):
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None):
         if not self.enabled:
             return _NOOP
+        name = _labeled(name, labels)
         with self._lock:
             c = self._counters.get(name)
             if c is None:
                 c = self._counters[name] = Counter(name)
             return c
 
-    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None):
+    def gauge(
+        self,
+        name: str,
+        fn: Optional[Callable[[], float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
         if not self.enabled:
             return _NOOP
+        name = _labeled(name, labels)
         with self._lock:
             g = self._gauges.get(name)
             if g is None:
@@ -104,9 +123,10 @@ class MetricsRegistry:
                 g.fn = fn
             return g
 
-    def histogram(self, name: str):
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None):
         if not self.enabled:
             return _NOOP
+        name = _labeled(name, labels)
         with self._lock:
             h = self._hists.get(name)
             if h is None:
@@ -134,22 +154,36 @@ class MetricsRegistry:
     def export_text(self) -> str:
         """Prometheus text exposition format."""
         out = []
+        typed = set()  # one TYPE line per base name (labelled series share it)
+
+        def type_line(name: str, kind: str) -> None:
+            base = _base_name(name)
+            if base not in typed:
+                typed.add(base)
+                out.append(f"# TYPE {base} {kind}")
+
         with self._lock:
             for c in sorted(self._counters.values(), key=lambda x: x.name):
-                out.append(f"# TYPE {c.name} counter")
+                type_line(c.name, "counter")
                 out.append(f"{c.name} {c.value}")
             for g in sorted(self._gauges.values(), key=lambda x: x.name):
-                out.append(f"# TYPE {g.name} gauge")
+                type_line(g.name, "gauge")
                 out.append(f"{g.name} {g.get()}")
             for h in sorted(self._hists.values(), key=lambda x: x.name):
-                out.append(f"# TYPE {h.name} histogram")
+                type_line(h.name, "histogram")
+                base = _base_name(h.name)
+                # merge any labels into the bucket brace set: the le
+                # label must join the series labels, not follow them
+                inner = h.name[len(base):].strip("{}")
+                pre = f"{inner}," if inner else ""
                 acc = 0
                 for i, b in enumerate(Histogram.BOUNDS):
                     acc += h.buckets[i]
-                    out.append(f'{h.name}_bucket{{le="{b}"}} {acc}')
-                out.append(f'{h.name}_bucket{{le="+Inf"}} {h.count}')
-                out.append(f"{h.name}_sum {h.total}")
-                out.append(f"{h.name}_count {h.count}")
+                    out.append(f'{base}_bucket{{{pre}le="{b}"}} {acc}')
+                out.append(f'{base}_bucket{{{pre}le="+Inf"}} {h.count}')
+                suffix = f"{{{inner}}}" if inner else ""
+                out.append(f"{base}_sum{suffix} {h.total}")
+                out.append(f"{base}_count{suffix} {h.count}")
         return "\n".join(out) + "\n"
 
 

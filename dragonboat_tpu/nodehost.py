@@ -153,6 +153,7 @@ class NodeHost:
                 ),
                 deliver_fn=self._deliver_received_snapshot,
                 confirm_fn=self._confirm_received_snapshot,
+                reject_fn=self._reject_received_snapshot,
             )
             raw_transport = (
                 expert.transport_factory(
@@ -165,6 +166,9 @@ class NodeHost:
                     self._chunk_sink.add,
                 )
             )
+            # registry exists before the transport so per-target breaker
+            # metrics can register as send queues appear
+            self.metrics = MetricsRegistry(enabled=config.enable_metrics)
             self.transport = Transport(
                 raw_transport,
                 self.registry.resolve,
@@ -176,10 +180,10 @@ class NodeHost:
                 max_snapshot_send_bytes_per_second=(
                     config.max_snapshot_send_bytes_per_second
                 ),
+                metrics_registry=self.metrics,
             )
             self.transport.start()
 
-            self.metrics = MetricsRegistry(enabled=config.enable_metrics)
             self.metrics.gauge(
                 "raft_nodehost_shards", lambda: len(self._nodes)
             )
@@ -193,6 +197,10 @@ class NodeHost:
             self.metrics.gauge(
                 "raft_transport_failed_total",
                 lambda: self.transport.metrics["failed"],
+            )
+            self.metrics.gauge(
+                "raft_transport_snapshots_sent_total",
+                lambda: self.transport.metrics["snapshots_sent"],
             )
 
             step_engine = (
@@ -445,6 +453,24 @@ class NodeHost:
                 shard_id=shard_id,
                 from_=to_replica,
                 to=from_replica,
+            )
+        )
+
+    def _reject_received_snapshot(
+        self, shard_id: int, from_replica: int, to_replica: int
+    ) -> None:
+        """A completed stream failed container validation: tell the
+        SENDER over the wire so its raft peer clears the pending
+        snapshot and retries (without this the remote would stay in
+        SNAPSHOT wait forever on transports where the sender cannot
+        observe the final-chunk rejection)."""
+        self.transport.send(
+            Message(
+                type=MessageType.SNAPSHOT_STATUS,
+                shard_id=shard_id,
+                from_=to_replica,
+                to=from_replica,
+                reject=True,
             )
         )
 

@@ -434,6 +434,10 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._sel_fit_streak = 0
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
+        # nemesis escalations are consumed at plan time here: routed
+        # regions suppress escalated rows ON device, so the base
+        # engine's post-launch flag flip would desync the merged state
+        self._consume_engine_fault_at_plan = True
         # loop-invariant delivered-bit unpack tables (word index and
         # in-word shift per outbox slot) — hoisted out of the merge loop
         self._dw_word = np.arange(self.O) // 32

@@ -513,6 +513,15 @@ class VectorStepEngine(IStepEngine):
         # device-synced "leader has a lagging peer" bit per row (the
         # scalar remotes of resident rows are stale) — quiesce gate
         self._behind = np.zeros((capacity,), bool)
+        # the unified fault plane (faults.FaultController): an active
+        # `escalate` fault forces rows through the kernel-escalation
+        # recovery machinery.  The base engine consumes it post-launch
+        # (discard device effects + scalar replay — the true escalation
+        # contract); the colocated engine consumes it at plan time (its
+        # routed regions suppress escalated rows ON device, so a
+        # post-hoc flag flip there would desync merged state).
+        self.fault_injector = None
+        self._consume_engine_fault_at_plan = False
         self.stats = {
             "device_steps": 0,
             "device_rows_stepped": 0,
@@ -722,6 +731,14 @@ class VectorStepEngine(IStepEngine):
             or si.transfers
         ):
             return None
+        inj = self.fault_injector
+        if (
+            inj is not None
+            and self._consume_engine_fault_at_plan
+            and getattr(inj, "has_active", lambda k: True)("escalate")
+            and inj.on_engine_step(node.shard_id, node.replica_id)
+        ):
+            return None  # nemesis: forced scalar excursion for this row
         if si.read_indexes and not mirror_leader:
             return None
         if node in self._save_quarantine:
@@ -1284,6 +1301,23 @@ class VectorStepEngine(IStepEngine):
         with annotate("raft-device-step"):
             new_state, out = K.step(old_state, inbox, out_capacity=self.O)
             flags = np.asarray(_summarize_flags(old_state, new_state, out))
+        inj = self.fault_injector
+        if (
+            inj is not None
+            and not self._consume_engine_fault_at_plan
+            and getattr(inj, "has_active", lambda k: True)("escalate")
+        ):
+            # nemesis: force the kernel-escalation recovery path for the
+            # selected rows — their device effects are discarded below
+            # exactly as for a real ESC_* escalation.  The jax-backed
+            # asarray view is read-only; take a writable copy to flip
+            # bits in (only on the injected path — never in production)
+            flags = np.array(flags)
+            for node, g, si, plan in batch:
+                if not flags[g] & _F_ESC and inj.on_engine_step(
+                    node.shard_id, node.replica_id
+                ):
+                    flags[g] |= _F_ESC
         self._behind = (flags & _F_PEERS_BEHIND) != 0
         self.stats["device_steps"] += 1
         self.stats["device_rows_stepped"] += len(batch)

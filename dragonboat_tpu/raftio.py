@@ -119,7 +119,22 @@ ChunkHandler = Callable[[Chunk], bool]
 
 
 class ITransport(abc.ABC):
-    """reference: raftio.ITransport (v3 IRaftRPC) [U]."""
+    """reference: raftio.ITransport (v3 IRaftRPC) [U].
+
+    Implementations SHOULD pass every outbound payload through
+    ``self.fault_injector.on_wire(source, target, payload)`` when the
+    attribute is non-None — that is the contract that lets the unified
+    nemesis (faults.FaultController) inject partitions, loss, delay,
+    duplication, reordering and chunk corruption on any transport
+    (see docs/FAULTS.md).
+    """
+
+    # the unified fault plane; None in production.  fault_source is the
+    # identity to report as `source` to on_wire — the Transport wrapper
+    # sets it to the RAFT address (what fault plans target), which may
+    # differ from a bind/listen address
+    fault_injector = None
+    fault_source = None
 
     @abc.abstractmethod
     def name(self) -> str: ...

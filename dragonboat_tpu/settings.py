@@ -33,6 +33,9 @@ class _Soft:
     # snapshots
     snapshot_chunk_size: int = 2 * 1024 * 1024
     max_concurrent_streaming_snapshots: int = 128
+    # bounded re-stream before a stream job reports failure (each report
+    # resets the remote to WAIT and costs a leader round trip)
+    snapshot_stream_max_tries: int = 3
     # transport
     send_queue_length: int = 1024 * 2
     connection_retry_ticks: int = 5

@@ -361,6 +361,33 @@ class _FileReceiveSink:
         self._f.close()
         self._f = open(os.path.join(self._tmp, base), "wb")
 
+    def validate(self) -> None:
+        """Checksum-walk the received main container BEFORE finalize:
+        a corrupted chunk that survived the wire must fail the receive
+        (the sender retries) — finalizing it would fail-stop the
+        replica at recover time instead.
+
+        External files are stored VERBATIM with no per-file checksum
+        (format parity with the reference), so only their SIZES can be
+        cross-checked against the container's file table — truncated or
+        padded external streams are rejected here, but a same-length
+        bit flip in an external file is not detectable in this format.
+        """
+        from .snapshotio import SnapshotReader
+
+        self._f.flush()
+        with open(os.path.join(self._tmp, "snapshot.bin"), "rb") as f:
+            reader = SnapshotReader(f)
+            reader.validate()
+        for sf in reader.external_files:
+            p = os.path.join(self._tmp, os.path.basename(sf.filepath))
+            got = os.path.getsize(p) if os.path.exists(p) else -1
+            if got != sf.file_size:
+                raise IOError(
+                    f"external file {sf.filepath!r}: received {got} "
+                    f"bytes, table says {sf.file_size}"
+                )
+
     def finalize(self) -> str:
         self._f.flush()
         os.fsync(self._f.fileno())
@@ -400,6 +427,27 @@ class _MemReceiveSink:
             with open(path, "wb") as f:
                 f.write(self._cur.getvalue())
             self._ext_name = None
+
+    def validate(self) -> None:
+        """Checksum-walk the received buffer when it IS a v2 container
+        (trailer magic present) — same corrupt-chunk rejection as the
+        file sink.  Transport-level tests stream raw non-container
+        payloads through this sink; those skip validation."""
+        import struct as _struct
+
+        from .snapshotio import MAGIC, SnapshotReader
+
+        buf = self._main.getvalue()
+        # the format carries MAGIC in both header and trailer; either
+        # one marks a container (a corrupt flip can kill at most one)
+        is_container = len(buf) >= 8 and (
+            _struct.unpack("<I", buf[:4])[0] == MAGIC
+            or _struct.unpack("<I", buf[-4:])[0] == MAGIC
+        )
+        if not is_container:
+            return  # raw payload (transport tests): nothing to checksum
+        f = io.BytesIO(buf)
+        SnapshotReader(f).validate()
 
     def finalize(self) -> str:
         self._flush_ext()

@@ -181,6 +181,9 @@ class TanLogDB(ILogDB):
         # BOTH writer paths (python and native group-commit); raising
         # simulates an I/O failure at that point
         self.fault_hook = None
+        # the unified fault plane (faults.FaultController via a bound
+        # adapter); consulted at the same write+fsync boundary
+        self.fault_injector = None
         self.fs.makedirs(directory)
         self._replay()
         self._open_active()
@@ -319,6 +322,8 @@ class TanLogDB(ILogDB):
         raw = self._frame(recs)
         if self.fault_hook is not None:
             self.fault_hook(raw)
+        if self.fault_injector is not None:
+            self.fault_injector.on_fs_op("wal_append", self.dir)
         if self._writer is not None:
             # native path: write+fsync on the group-commit thread, GIL
             # released; concurrent workers' batches share one fsync
@@ -429,6 +434,8 @@ class TanLogDB(ILogDB):
         raw = self._frame(recs)
         if self.fault_hook is not None:
             self.fault_hook(raw)
+        if self.fault_injector is not None:
+            self.fault_injector.on_fs_op("wal_append", self.dir)
         with self._lock:
             # a pending rotation blocks NEW appends so inflight can drain
             # — otherwise sustained load starves rotation (and GC) forever

@@ -180,7 +180,18 @@ class _MemFile(IVFSFile):
         self._closed = False
 
     def write(self, data: bytes) -> None:
-        self._fs._hook("write", self._path)
+        try:
+            self._fs._hook("write", self._path)
+        except Exception as e:
+            # nemesis torn write: persist the prefix the fault allows,
+            # then fail — replay code must cope with the partial tail
+            keep = getattr(e, "keep", None)
+            if keep is not None and data:
+                with self._fs._lock:
+                    self._fs._node(self._path).pending += data[
+                        : int(len(data) * float(keep))
+                    ]
+            raise
         with self._fs._lock:
             self._fs._node(self._path).pending += data
 
@@ -218,12 +229,17 @@ class StrictMemFS(IVFS):
         self._synced_dirs: Dict[str, Dict[str, _MemNode]] = {}
         self._dirs: set = set()
         self.fault_hook: Optional[Callable[[str, str], None]] = None
+        # the unified fault plane (faults.FaultController via a bound
+        # adapter); fault_hook stays for bespoke test callbacks
+        self.fault_injector = None
         self.crashes = 0
 
     # -- internals -------------------------------------------------------
     def _hook(self, op: str, path: str) -> None:
         if self.fault_hook is not None:
             self.fault_hook(op, path)
+        if self.fault_injector is not None:
+            self.fault_injector.on_fs_op(op, path)
 
     def _node(self, path: str) -> _MemNode:
         n = self._files.get(path)

@@ -169,10 +169,15 @@ class ChunkSink:
         begin_fn: Callable[[int, int, int], object],
         deliver_fn: Callable[[Message], None],
         confirm_fn: Optional[Callable[[int, int, int], None]] = None,
+        reject_fn: Optional[Callable[[int, int, int], None]] = None,
     ):
         self.begin_fn = begin_fn
         self.deliver_fn = deliver_fn
         self.confirm_fn = confirm_fn
+        # a completed stream whose container fails validation (corrupt
+        # payload survived the wire): tell the sender so its raft peer
+        # clears the pending snapshot and retries
+        self.reject_fn = reject_fn
         self._lock = threading.Lock()
         self._inflight: Dict[Tuple[int, int], _InFlight] = {}
 
@@ -237,19 +242,36 @@ class ChunkSink:
             with self._lock:
                 if self._inflight.get(key) is fl:
                     del self._inflight[key]
-            self._complete(c, fl)
+            # a corrupt/unfinalizable stream returns False for the LAST
+            # chunk: the sending stream job sees a failed send and runs
+            # its retry/report path instead of assuming delivery
+            return self._complete(c, fl)
         return True
 
-    def _complete(self, last: Chunk, fl: _InFlight) -> None:
+    def _complete(self, last: Chunk, fl: _InFlight) -> bool:
         if fl.sink is None:
             filepath = ""
         else:
+            validate = getattr(fl.sink, "validate", None)
+            if validate is not None:
+                try:
+                    validate()
+                except Exception as e:  # noqa: BLE001 - corrupt container
+                    _log.warning(
+                        "received snapshot for shard %d from %d failed "
+                        "validation, discarding: %s",
+                        last.shard_id, last.from_, e,
+                    )
+                    fl.sink.abort()
+                    if self.reject_fn is not None:
+                        self.reject_fn(last.shard_id, last.from_, last.replica_id)
+                    return False
             try:
                 filepath = fl.sink.finalize()
             except Exception as e:  # noqa: BLE001 - disk trouble
                 _log.warning("receive sink finalize failed: %s", e)
                 fl.sink.abort()
-                return
+                return False
         ss = Snapshot(
             filepath=filepath,
             file_size=last.file_size,
@@ -274,3 +296,4 @@ class ChunkSink:
         )
         if self.confirm_fn is not None:
             self.confirm_fn(last.shard_id, last.from_, last.replica_id)
+        return True

@@ -3,13 +3,15 @@
 reference: the chan-based test transports in internal/transport [U].
 Multiple NodeHosts in one process register by address in a module-level
 network table; delivery is a direct call into the receiver's handler
-(which only enqueues — cheap and deadlock-free).  Supports fault
-injection (drop/partition hooks) for chaos tests.
+(which only enqueues — cheap and deadlock-free).  Fault injection goes
+through the unified ``fault_injector`` hook protocol
+(faults.FaultController.on_wire): partitions, drop/delay/duplicate/
+reorder and chunk corruption, shared with the TCP transport.
 """
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Dict, Optional
 
 from ..pb import Chunk, MessageBatch
 from ..raftio import (
@@ -42,9 +44,13 @@ class _InProcConnection(IConnection):
             peer = _network.get(self.target)
         if peer is None or peer._closed:
             raise ConnectionError(f"no transport at {self.target}")
-        if self.owner.drop_hook and self.owner.drop_hook(self.target, batch):
-            return  # chaos: silently dropped
-        peer.deliver(batch)
+        inj = self.owner.fault_injector
+        if inj is None:
+            peer.deliver(batch)
+            return
+        src = self.owner.fault_source or self.owner.address
+        for b in inj.on_wire(src, self.target, batch):
+            peer.deliver(b)
 
 
 class _InProcSnapshotConnection(ISnapshotConnection):
@@ -60,10 +66,22 @@ class _InProcSnapshotConnection(ISnapshotConnection):
             peer = _network.get(self.target)
         if peer is None or peer._closed:
             raise ConnectionError(f"no transport at {self.target}")
-        if self.owner.drop_hook and self.owner.drop_hook(self.target, chunk):
-            return
-        if not peer.deliver_chunk(chunk):
-            raise ConnectionError(f"chunk rejected by {self.target}")
+        inj = self.owner.fault_injector
+        if inj is None:
+            chunks = (chunk,)
+        else:
+            src = self.owner.fault_source or self.owner.address
+            chunks = inj.on_wire(src, self.target, chunk)
+        for c in chunks:
+            if not peer.deliver_chunk(c):
+                raise ConnectionError(f"chunk rejected by {self.target}")
+        if not chunks:
+            # chunks ride a RELIABLE stream: a swallowed chunk must fail
+            # the send (a real network stalls/breaks the stream) — a
+            # silent success here would wedge the sender's raft peer in
+            # SNAPSHOT state forever, since the receiver's reassembly
+            # never completes and no status is ever reported
+            raise ConnectionError("nemesis: snapshot chunk lost")
 
 
 class InProcTransport(ITransport):
@@ -77,8 +95,8 @@ class InProcTransport(ITransport):
         self.message_handler = message_handler
         self.chunk_handler = chunk_handler
         self._closed = False
-        # chaos-injection hook: (target, batch_or_chunk) -> drop?
-        self.drop_hook: Optional[Callable] = None
+        # the unified fault plane (faults.FaultController.on_wire)
+        self.fault_injector = None
 
     def name(self) -> str:
         return "inproc"

@@ -111,11 +111,18 @@ class _TCPConnection(IConnection):
             pass
 
     def send_message_batch(self, batch: MessageBatch) -> None:
-        hook = self._owner.drop_hook
-        if hook is not None and hook(self._target, batch):
-            return  # chaos: silently dropped
+        inj = self._owner.fault_injector
+        if inj is None:
+            batches = (batch,)
+        else:
+            # fault identity is the RAFT address (what plans target),
+            # not the bind address — they differ under ListenAddress
+            # overrides and port-0 binds
+            src = self._owner.fault_source or self._owner.listen_address
+            batches = inj.on_wire(src, self._target, batch)
         with self._lock:
-            _write_frame(self._sock, KIND_BATCH, encode_batch(batch))
+            for b in batches:
+                _write_frame(self._sock, KIND_BATCH, encode_batch(b))
 
 
 class _TCPSnapshotConnection(ISnapshotConnection):
@@ -132,11 +139,19 @@ class _TCPSnapshotConnection(ISnapshotConnection):
             pass
 
     def send_chunk(self, chunk: Chunk) -> None:
-        hook = self._owner.drop_hook
-        if hook is not None and hook(self._target, chunk):
-            return
+        inj = self._owner.fault_injector
+        if inj is None:
+            chunks = (chunk,)
+        else:
+            src = self._owner.fault_source or self._owner.listen_address
+            chunks = inj.on_wire(src, self._target, chunk)
         with self._lock:
-            _write_frame(self._sock, KIND_CHUNK, encode_chunk(chunk))
+            for c in chunks:
+                _write_frame(self._sock, KIND_CHUNK, encode_chunk(c))
+        if not chunks:
+            # see the inproc chunk lane: a swallowed chunk must fail the
+            # send, or the sender's raft peer wedges in SNAPSHOT state
+            raise ConnectionError("nemesis: snapshot chunk lost")
 
 
 class TCPTransport(ITransport):
@@ -163,9 +178,9 @@ class TCPTransport(ITransport):
         self._threads = []
         self._conn_lock = threading.Lock()
         self._inbound = set()
-        # chaos-injection hook, same contract as the in-proc transport:
-        # (target, batch_or_chunk) -> True to drop silently
-        self.drop_hook = None
+        # the unified fault plane, same contract as the in-proc
+        # transport (faults.FaultController.on_wire)
+        self.fault_injector = None
 
     def name(self) -> str:
         return "tcp"
@@ -264,8 +279,14 @@ class TCPTransport(ITransport):
                 if kind == KIND_BATCH:
                     self.message_handler(decode_batch(payload))
                 elif kind == KIND_CHUNK:
-                    if self.chunk_handler is not None:
-                        self.chunk_handler(decode_chunk(payload))
+                    if self.chunk_handler is not None and not self.chunk_handler(
+                        decode_chunk(payload)
+                    ):
+                        # rejected chunk (out-of-order / failed receive):
+                        # tear the connection down so the sending stream
+                        # job fails fast and retries/reports, instead of
+                        # pumping the rest of a doomed stream
+                        raise WireError("chunk rejected by receiver")
                 else:
                     raise WireError(f"unknown frame kind {kind}")
         except (WireError, ValueError) as e:

@@ -10,6 +10,7 @@ breaker util [U]).
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import deque
@@ -22,29 +23,95 @@ from ..raftio import ITransport
 
 _log = get_logger("transport")
 
+# breaker states (exported as a gauge value: 0=closed 1=half-open 2=open)
+_CLOSED, _HALF_OPEN, _OPEN = 0, 1, 2
+_STATE_NAMES = {_CLOSED: "closed", _HALF_OPEN: "half-open", _OPEN: "open"}
+
 
 class _Breaker:
-    """Minimal circuit breaker: open after N consecutive failures, half-open
-    after a cooldown."""
+    """Circuit breaker with exponential backoff and half-open probing.
 
-    def __init__(self, threshold: int = 3, cooldown: float = 1.0):
+    Opens after ``threshold`` consecutive failures.  After a jittered
+    cooldown, ``ready()`` admits exactly ONE probe batch (half-open); a
+    probe success closes the breaker and resets the cooldown, a probe
+    failure reopens it with the cooldown doubled (capped) — so a peer
+    that stays dead costs geometrically fewer connection attempts,
+    while a healed peer is rediscovered within one cooldown.  The
+    jitter desynchronizes many senders probing one recovered peer.
+
+    Single-threaded per target (only its sender thread touches it);
+    the metrics accessors read plain ints/floats, safe under the GIL.
+    """
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        cooldown: float = 0.5,
+        max_cooldown: float = 10.0,
+        jitter: float = 0.25,
+        rng: Optional[random.Random] = None,
+    ):
         self.threshold = threshold
-        self.cooldown = cooldown
+        self.base_cooldown = cooldown
+        self.max_cooldown = max_cooldown
+        self.jitter = jitter
+        self._rng = rng or random.Random()
         self.failures = 0
+        self.state = _CLOSED
         self.opened_at = 0.0
+        self.cooldown = cooldown
+        self._wait = cooldown  # jittered effective cooldown
+        # per-target observability (surfaced through metrics.py)
+        self.open_count = 0
+        self._open_seconds = 0.0  # completed open/half-open intervals
 
     def ready(self) -> bool:
-        if self.failures < self.threshold:
+        if self.state == _CLOSED:
             return True
-        return (time.monotonic() - self.opened_at) >= self.cooldown
+        if self.state == _OPEN:
+            if time.monotonic() - self.opened_at >= self._wait:
+                self.state = _HALF_OPEN
+                return True  # the one probe
+            return False
+        return False  # half-open: probe already in flight
 
     def success(self) -> None:
+        if self.state != _CLOSED:
+            self._open_seconds += time.monotonic() - self.opened_at
+        self.state = _CLOSED
         self.failures = 0
+        self.cooldown = self.base_cooldown
 
     def failure(self) -> None:
         self.failures += 1
-        if self.failures >= self.threshold:
-            self.opened_at = time.monotonic()
+        if self.state == _HALF_OPEN:
+            # probe failed: back off exponentially
+            self.cooldown = min(self.cooldown * 2.0, self.max_cooldown)
+            self._reopen(accumulate=True)
+        elif self.state == _CLOSED and self.failures >= self.threshold:
+            self.cooldown = self.base_cooldown
+            self._reopen(accumulate=False)
+
+    def _reopen(self, accumulate: bool) -> None:
+        now = time.monotonic()
+        if accumulate:
+            self._open_seconds += now - self.opened_at
+        self.state = _OPEN
+        self.open_count += 1
+        self.opened_at = now
+        self._wait = self.cooldown * (
+            1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        )
+
+    # -- metrics ----------------------------------------------------------
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state]
+
+    def open_seconds(self) -> float:
+        """Total time spent open/half-open, including a current stint."""
+        if self.state == _CLOSED:
+            return self._open_seconds
+        return self._open_seconds + (time.monotonic() - self.opened_at)
 
 
 class _SendQueue:
@@ -68,6 +135,7 @@ class Transport:
         snapshot_source_opener: Optional[Callable[[object], object]] = None,
         snapshot_status_cb: Optional[Callable[[int, int, bool], None]] = None,
         max_snapshot_send_bytes_per_second: int = 0,
+        metrics_registry=None,
     ):
         self.raw = raw
         self.resolver = resolver
@@ -88,7 +156,18 @@ class Transport:
         self._threads: Dict[str, threading.Thread] = {}
         self._lock = threading.Lock()
         self._stopped = False
-        self.metrics = {"sent": 0, "dropped": 0, "failed": 0}
+        self.metrics = {"sent": 0, "dropped": 0, "failed": 0, "snapshots_sent": 0}
+        self._metrics_registry = metrics_registry
+        # the unified fault plane (faults.FaultController); propagated
+        # to the raw ITransport so every outbound batch/chunk crosses it
+        self.fault_injector = None
+
+    def set_fault_injector(self, injector) -> None:
+        self.fault_injector = injector
+        self.raw.fault_injector = injector
+        # fault plans target raft addresses; make the raw transport
+        # report that identity (not its bind address) to on_wire
+        self.raw.fault_source = self.source_address
 
     def start(self) -> None:
         self.raw.start()
@@ -120,10 +199,17 @@ class Transport:
         with sq.cond:
             if sq.closed or len(sq.q) >= sq.maxlen:
                 self.metrics["dropped"] += 1
-                return False
-            sq.q.append(m)
-            sq.cond.notify()
-        return True
+                full = not sq.closed
+            else:
+                sq.q.append(m)
+                sq.cond.notify()
+                return True
+        if full:
+            # a full queue means the peer isn't draining: report it
+            # unreachable so the leader backs off (silently dropping
+            # here left congested peers hammered at full rate)
+            self._notify_unreachable([m])
+        return False
 
     def _get_queue(self, target: str) -> _SendQueue:
         with self._lock:
@@ -131,7 +217,8 @@ class Transport:
             if sq is None:
                 sq = _SendQueue(settings.Soft.send_queue_length)
                 self._queues[target] = sq
-                self._breakers[target] = _Breaker()
+                self._breakers[target] = b = _Breaker()
+                self._register_breaker_metrics(target, b)
                 t = threading.Thread(
                     target=self._sender_main,
                     args=(target, sq),
@@ -141,6 +228,43 @@ class Transport:
                 self._threads[target] = t
                 t.start()
             return sq
+
+    def _register_breaker_metrics(self, target: str, b: _Breaker) -> None:
+        """Per-target breaker observability: state, open transitions and
+        cumulative time-in-open, labelled by target (chaos runs watch
+        these to see breaker flaps)."""
+        reg = self._metrics_registry
+        if reg is None:
+            return
+        labels = {"target": target}
+        reg.gauge(
+            "raft_transport_breaker_state", lambda b=b: b.state, labels=labels
+        )
+        reg.gauge(
+            "raft_transport_breaker_opens_total",
+            lambda b=b: b.open_count,
+            labels=labels,
+        )
+        reg.gauge(
+            "raft_transport_breaker_open_seconds_total",
+            lambda b=b: b.open_seconds(),
+            labels=labels,
+        )
+
+    def breaker_stats(self) -> Dict[str, Dict]:
+        """Snapshot of every per-target breaker (tests + debugging)."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {
+            t: {
+                "state": b.state_name(),
+                "failures": b.failures,
+                "open_count": b.open_count,
+                "open_seconds": b.open_seconds(),
+                "cooldown": b.cooldown,
+            }
+            for t, b in breakers.items()
+        }
 
     def _sender_main(self, target: str, sq: _SendQueue) -> None:
         breaker = self._breakers[target]
@@ -210,44 +334,38 @@ class Transport:
         return True
 
     def _stream_job(self, m: Message, target: str) -> None:
-        from .chunk import iter_snapshot_chunks
-
+        """One stream job with BOUNDED retry: a transient failure (peer
+        restarting, a fault window, one torn connection) re-streams from
+        chunk 0 after a short backoff instead of immediately reporting
+        the snapshot failed — reporting failure resets the remote to
+        WAIT and costs a full leader round trip before the next attempt.
+        Only after ``snapshot_stream_max_tries`` consecutive failures is
+        the failure surfaced (snapshot_status_cb + unreachable)."""
         source = None
+        tries = max(1, settings.Soft.snapshot_stream_max_tries)
         try:
             if not m.snapshot.dummy and self.snapshot_source_opener is not None:
                 source = self.snapshot_source_opener(m.snapshot)
-            conn = self.raw.get_snapshot_connection(target)
-            try:
-                # deficit pacing against MaxSnapshotSendBytesPerSecond
-                # (reference: snapshot bandwidth limits [U]).  Each sent
-                # chunk adds its size to a byte deficit that drains at
-                # `rate`; the next chunk waits until the deficit clears.
-                # Debt is never forgiven (chunks larger than one second
-                # of budget still average out correctly) and idle time
-                # banks no burst credit.  Sleeps are sliced so close()
-                # interrupts promptly.
-                rate = self.max_snapshot_send_rate
-                deficit = 0.0
-                last = time.monotonic()
-                for c in iter_snapshot_chunks(m, source):
+            for attempt in range(tries):
+                try:
+                    self._stream_once(m, target, source)
+                    self.metrics["snapshots_sent"] += 1
+                    return
+                except Exception as e:  # noqa: BLE001 — any transport error
+                    if self._stopped or attempt == tries - 1:
+                        raise
+                    _log.warning(
+                        "snapshot stream to %s failed (attempt %d/%d): %s",
+                        target, attempt + 1, tries, e,
+                    )
+                    # sliced backoff so close() interrupts promptly
+                    wait = 0.05 * (2 ** attempt)
+                    deadline = time.monotonic() + wait
+                    while not self._stopped and time.monotonic() < deadline:
+                        time.sleep(0.02)
                     if self._stopped:
-                        raise ConnectionError("transport stopped")
-                    conn.send_chunk(c)
-                    if rate <= 0:
-                        continue
-                    now = time.monotonic()
-                    deficit = max(0.0, deficit - (now - last) * rate)
-                    last = now
-                    deficit += len(c.data)
-                    while deficit > 0 and not self._stopped:
-                        time.sleep(min(deficit / rate, 0.1))
-                        now = time.monotonic()
-                        deficit = max(0.0, deficit - (now - last) * rate)
-                        last = now
-            finally:
-                conn.close()
-            self.metrics["snapshots_sent"] = self.metrics.get("snapshots_sent", 0) + 1
-        except Exception as e:  # noqa: BLE001 — any transport error
+                        raise
+        except Exception as e:  # noqa: BLE001 — retries exhausted
             _log.warning("snapshot stream to %s failed: %s", target, e)
             self._snapshot_failed(m)
             if self.unreachable_cb is not None:
@@ -257,6 +375,40 @@ class Transport:
                 source.close()  # releases the storage GC lease
             with self._stream_lock:
                 self._stream_jobs -= 1
+
+    def _stream_once(self, m: Message, target: str, source) -> None:
+        from .chunk import iter_snapshot_chunks
+
+        conn = self.raw.get_snapshot_connection(target)
+        try:
+            # deficit pacing against MaxSnapshotSendBytesPerSecond
+            # (reference: snapshot bandwidth limits [U]).  Each sent
+            # chunk adds its size to a byte deficit that drains at
+            # `rate`; the next chunk waits until the deficit clears.
+            # Debt is never forgiven (chunks larger than one second
+            # of budget still average out correctly) and idle time
+            # banks no burst credit.  Sleeps are sliced so close()
+            # interrupts promptly.
+            rate = self.max_snapshot_send_rate
+            deficit = 0.0
+            last = time.monotonic()
+            for c in iter_snapshot_chunks(m, source):
+                if self._stopped:
+                    raise ConnectionError("transport stopped")
+                conn.send_chunk(c)
+                if rate <= 0:
+                    continue
+                now = time.monotonic()
+                deficit = max(0.0, deficit - (now - last) * rate)
+                last = now
+                deficit += len(c.data)
+                while deficit > 0 and not self._stopped:
+                    time.sleep(min(deficit / rate, 0.1))
+                    now = time.monotonic()
+                    deficit = max(0.0, deficit - (now - last) * rate)
+                    last = now
+        finally:
+            conn.close()
 
     def _snapshot_failed(self, m: Message) -> None:
         if self.snapshot_status_cb is not None:

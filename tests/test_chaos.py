@@ -7,8 +7,10 @@ multi-NodeHost clusters with fault injection and invariant checks:
   I2 (agreement):    all replicas' SM state is identical after settling
   I3 (availability): the cluster accepts writes again after healing
 
-Faults are injected through the in-proc transport's drop hook
-(partitions) and real NodeHost close/reopen over tan WAL dirs (kills).
+All faults flow through the unified seeded nemesis
+(dragonboat_tpu.faults.FaultController): partitions/drops on the wire
+plane, fsync faults on the storage plane, plus real NodeHost
+close/reopen over tan WAL dirs (kills) via the crash handlers.
 """
 import pickle
 import random
@@ -21,6 +23,8 @@ import pytest
 from dragonboat_tpu import (
     EngineConfig,
     ExpertConfig,
+    Fault,
+    FaultController,
     NodeHost,
     NodeHostConfig,
     RequestDropped,
@@ -51,8 +55,10 @@ def make_chaos_nodehost(replica_id):
 class Cluster:
     ADDRS = ADDRS
 
-    def __init__(self):
+    def __init__(self, seed=0):
         reset_inproc_network()
+        self.nemesis = FaultController(seed=seed)
+        self.nemesis.set_crash_handlers(self.kill, self.restart)
         for rid in self.ADDRS:
             shutil.rmtree(self._dir(rid), ignore_errors=True)
         self.nhs = {}
@@ -68,7 +74,11 @@ class Cluster:
         return f"/tmp/nh-chaos-{rid}"
 
     def start(self, rid):
-        self.nhs[rid] = make_chaos_nodehost(rid)
+        self.nhs[rid] = self.make_nodehost(rid)
+        self.nemesis.install_nodehost(rid, self.nhs[rid])
+
+    def make_nodehost(self, rid):
+        return make_chaos_nodehost(rid)
 
     def kill(self, rid):
         """Hard-ish kill: close the nodehost (tan WAL survives)."""
@@ -80,25 +90,13 @@ class Cluster:
 
     def partition(self, side_a):
         """Messages between side_a and the rest are dropped, both ways."""
-        side_a = set(side_a)
-        addr_side = {self.ADDRS[r] for r in side_a}
-
-        def mk_hook(my_rid):
-            mine_in_a = my_rid in side_a
-
-            def hook(target, _payload):
-                return (target in addr_side) != mine_in_a
-
-            return hook
-
-        for rid, nh in self.nhs.items():
-            nh.transport.raw.drop_hook = mk_hook(rid)
+        self.nemesis.set_partition({self.ADDRS[r] for r in side_a})
 
     def heal(self):
-        for nh in self.nhs.values():
-            nh.transport.raw.drop_hook = None
+        self.nemesis.heal_wire()
 
     def close(self):
+        self.nemesis.stop()
         for nh in self.nhs.values():
             nh.close()
         self.nhs = {}
@@ -226,6 +224,44 @@ class TestChaos:
         finally:
             cluster.close()
 
+    def test_lossy_delaying_duplicating_reordering_network(self):
+        """Wire faults beyond what the old drop-only hook could express:
+        probabilistic loss + delay + duplication + reordering on every
+        lane at once.  Raft's idempotent message handling must keep the
+        cluster committing with no acked-write loss (I1/I2/I3)."""
+        cluster = Cluster(seed=29)
+        acked = {}
+        stop = threading.Event()
+        clients = [
+            threading.Thread(
+                target=chaos_client, args=(cluster, acked, stop, f"n{k}"),
+                daemon=True,
+            )
+            for k in range(2)
+        ]
+        try:
+            wait_for_leader(cluster.nhs)
+            addrs = tuple(ADDRS.values())
+            n = cluster.nemesis
+            n.activate(Fault("drop", targets=addrs, p=0.05))
+            n.activate(Fault("delay", targets=addrs, p=0.2, delay=0.005))
+            n.activate(Fault("duplicate", targets=addrs, p=0.25))
+            n.activate(Fault("reorder", targets=addrs, p=0.25))
+            for t in clients:
+                t.start()
+            time.sleep(3.0)
+            stop.set()
+            for t in clients:
+                t.join(timeout=5.0)
+            n.heal_all()
+            assert len(acked) > 20, f"no progress under lossy net: {len(acked)}"
+            assert n.stats.get("wire_duplicated", 0) > 0, n.stats
+            assert n.stats.get("wire_reordered", 0) > 0, n.stats
+            cluster.settle_and_check_agreement(acked)
+        finally:
+            stop.set()
+            cluster.close()
+
     def test_minority_partition_cannot_commit(self):
         cluster = Cluster()
         try:
@@ -259,8 +295,8 @@ class TcpCluster(Cluster):
     def _dir(self, rid):
         return f"/tmp/nh-tchaos-{rid}"
 
-    def start(self, rid):
-        self.nhs[rid] = NodeHost(
+    def make_nodehost(self, rid):
+        return NodeHost(
             NodeHostConfig(
                 nodehost_dir=self._dir(rid),
                 rtt_millisecond=2,

@@ -35,6 +35,7 @@ from dragonboat_tpu import (
     Config,
     EngineConfig,
     ExpertConfig,
+    Fault,
     NodeHost,
     NodeHostConfig,
 )
@@ -68,9 +69,9 @@ class ColocatedCluster(Cluster):
 
     ADDRS = ADDRS
 
-    def __init__(self):
+    def __init__(self, seed=0):
         self.group = ColocatedEngineGroup(**GEOM)
-        super().__init__()
+        super().__init__(seed=seed)
 
     def _dir(self, rid):
         return f"/tmp/nh-cchaos-{rid}"
@@ -78,8 +79,8 @@ class ColocatedCluster(Cluster):
     def config(self, rid):
         return colo_chaos_config(rid)
 
-    def start(self, rid):
-        self.nhs[rid] = NodeHost(
+    def make_nodehost(self, rid):
+        return NodeHost(
             NodeHostConfig(
                 nodehost_dir=self._dir(rid),
                 rtt_millisecond=5,
@@ -150,6 +151,43 @@ class TestColocatedChaos:
             cluster.settle_and_check_agreement(acked, timeout=60.0)
             st = cluster.stats()
             assert st.get("routed_delivered", 0) > 0, st  # I4
+            assert st.get("divergence_halts", 0) == 0, st  # I5
+        finally:
+            stop.set()
+            cluster.close()
+
+    def test_forced_kernel_escalations_under_load(self):
+        """Nemesis-forced device-kernel escalations: rows are randomly
+        bounced through the escalation recovery machinery (discard
+        device effects / scalar replay / re-upload) while clients
+        propose.  The cluster must keep agreeing with zero divergence
+        fail-stops — escalation is a recovery path, not a fault."""
+        cluster = ColocatedCluster(seed=17)
+        acked = {}
+        stop = threading.Event()
+        t = threading.Thread(
+            target=chaos_client, args=(cluster, acked, stop, "esc"),
+            daemon=True,
+        )
+        try:
+            wait_for_leader(cluster.nhs)
+            cluster.nemesis.install_engine(cluster.group.core)
+            # p is modest: each forced escalation costs a materialize +
+            # scalar replay + a several-step scalar hold, so a high rate
+            # legitimately throttles the shard rather than proving
+            # anything about divergence
+            f = cluster.nemesis.activate(
+                Fault("escalate", targets=(1,), p=0.08)
+            )
+            t.start()
+            time.sleep(4.0)
+            cluster.nemesis.deactivate(f)
+            stop.set()
+            t.join(timeout=5)
+            assert len(acked) > 5, "no progress under forced escalations"
+            assert cluster.nemesis.stats.get("engine_escalations", 0) > 0
+            cluster.settle_and_check_agreement(acked, timeout=60.0)
+            st = cluster.stats()
             assert st.get("divergence_halts", 0) == 0, st  # I5
         finally:
             stop.set()
@@ -234,12 +272,9 @@ def test_extended_colocated_chaos_schedule():
                     cluster.restart(rid)
             elif fault == 2:
                 rid = rng.choice(list(cluster.nhs))
-                logdb = cluster.nhs[rid].logdb
-                logdb.fault_hook = lambda _raw: (_ for _ in ()).throw(
-                    OSError("injected")
-                )
+                f = cluster.nemesis.activate(Fault("fsync_err", targets=(rid,)))
                 time.sleep(rng.uniform(0.3, 1.0))
-                logdb.fault_hook = None
+                cluster.nemesis.deactivate(f)
             else:
                 time.sleep(rng.uniform(0.5, 1.5))
             if i and i % 25 == 0:
@@ -276,9 +311,8 @@ class TestWalFaultQuarantine:
             acked["pre"] = b"0"
 
             # inject a WAL fault at member 2 under proposal load
-            logdb = cluster.nhs[2].logdb
-            logdb.fault_hook = lambda _raw: (_ for _ in ()).throw(
-                OSError("injected")
+            wal_fault = cluster.nemesis.activate(
+                Fault("fsync_err", targets=(2,))
             )
             done = 0
             deadline = time.time() + 60.0
@@ -296,7 +330,7 @@ class TestWalFaultQuarantine:
             st = cluster.stats()
             assert st.get("save_failures", 0) > 0, st
 
-            logdb.fault_hook = None  # disk heals
+            cluster.nemesis.deactivate(wal_fault)  # disk heals
             cluster.settle_and_check_agreement(acked, timeout=120.0)
             st = cluster.stats()
             assert st.get("divergence_halts", 0) == 0, st

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import datetime
 import os
+import pickle
 import random
 import shutil
 import socket
@@ -22,8 +23,11 @@ import pytest
 from dragonboat_tpu import (
     EngineConfig,
     ExpertConfig,
+    Fault,
+    FaultPlan,
     NodeHost,
     NodeHostConfig,
+    assert_recovery_sla,
 )
 from dragonboat_tpu.storage.tan import tan_logdb_factory
 from dragonboat_tpu.transport.inproc import reset_inproc_network
@@ -37,6 +41,9 @@ from test_nodehost import KVStore, set_cmd, shard_config, wait_for_leader
 # self-signed PKI for mutual TLS (cryptography lib is baked in)
 # ---------------------------------------------------------------------------
 def _make_pki(tmp_path):
+    pytest.importorskip(
+        "cryptography", reason="mutual-TLS PKI needs the cryptography lib"
+    )
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -195,8 +202,10 @@ class TestMutualTLS:
 class TestDiskFaultChaos:
     def test_fsync_failures_under_load(self):
         """A replica whose WAL intermittently fails fsync must never ack
-        a lost write; when the disk heals, the cluster reconverges."""
-        cluster = Cluster()
+        a lost write; when the disk heals, the cluster reconverges.
+        Fault windows come from the shared nemesis (fsync_err on the
+        storage plane) instead of a bespoke counter hook."""
+        cluster = Cluster(seed=42)
         acked = {}
         stop = threading.Event()
         t = threading.Thread(
@@ -209,21 +218,16 @@ class TestDiskFaultChaos:
             rng = random.Random(42)
             for round_no in range(3):
                 victim = rng.choice(list(cluster.nhs))
-                logdb = cluster.nhs[victim].logdb
-                state = {"n": 0}
-
-                def hook(_raw):
-                    state["n"] += 1
-                    if state["n"] % 3 != 0:  # 2/3 of appends fail
-                        raise OSError("injected fsync failure")
-
-                logdb.fault_hook = hook
+                f = cluster.nemesis.activate(
+                    Fault("fsync_err", targets=(victim,), p=2 / 3)
+                )
                 time.sleep(1.0)  # load continues against the sick disk
-                logdb.fault_hook = None  # disk heals
+                cluster.nemesis.deactivate(f)  # disk heals
                 time.sleep(0.5)
             stop.set()
             t.join(timeout=5)
             assert len(acked) > 10, "client never made progress"
+            assert cluster.nemesis.stats.get("fs_fsync_errors", 0) > 0
             cluster.settle_and_check_agreement(acked)
         finally:
             stop.set()
@@ -324,8 +328,20 @@ class TestWitnessChaos:
     reason="set CHAOS_ROUNDS=N for the long schedule (~N*4s of churn)",
 )
 def test_extended_chaos_schedule():
+    """The drummer-style long schedule, now a declarative randomized
+    plan executed by the nemesis thread (same seed => same schedule;
+    the seed prints on failure for replay)."""
     rounds = int(os.environ["CHAOS_ROUNDS"])
-    cluster = Cluster()
+    seed = int(os.environ.get("DRAGONBOAT_TPU_SEED", "7"))
+    cluster = Cluster(seed=seed)
+    plan = FaultPlan.randomized(
+        seed,
+        addrs=list(Cluster.ADDRS.values()),
+        fs_keys=list(Cluster.ADDRS),
+        crash_keys=list(Cluster.ADDRS),
+        rounds=rounds,
+    )
+    cluster.nemesis.plan = plan
     acked = {}
     stop = threading.Event()
     threads = [
@@ -339,36 +355,20 @@ def test_extended_chaos_schedule():
         wait_for_leader(cluster.nhs)
         for t in threads:
             t.start()
-        rng = random.Random(7)
-        for i in range(rounds):
-            fault = rng.randrange(4)
-            if fault == 0:
-                side = rng.sample(list(cluster.ADDRS), rng.choice([1, 2]))
-                cluster.partition(side)
-                time.sleep(rng.uniform(0.5, 2.0))
-                cluster.heal()
-            elif fault == 1:
-                rid = rng.choice(list(cluster.nhs))
-                if len(cluster.nhs) > 2:
-                    cluster.kill(rid)
-                    time.sleep(rng.uniform(0.5, 1.5))
-                    cluster.restart(rid)
-            elif fault == 2:
-                rid = rng.choice(list(cluster.nhs))
-                logdb = cluster.nhs[rid].logdb
-                logdb.fault_hook = lambda _raw: (_ for _ in ()).throw(
-                    OSError("injected")
-                )
-                time.sleep(rng.uniform(0.3, 1.0))
-                logdb.fault_hook = None
-            else:
-                time.sleep(rng.uniform(0.5, 1.5))  # calm period
-            time.sleep(0.5)
+        cluster.nemesis.start()
+        assert cluster.nemesis.wait(timeout=rounds * 6.0)
         stop.set()
         for t in threads:
             t.join(timeout=5)
         assert len(acked) > rounds, "clients made no progress"
         cluster.settle_and_check_agreement(acked, timeout=60.0)
+        assert_recovery_sla(
+            cluster.nhs, sla_ticks=10_000,
+            cmd=pickle.dumps(("set", "sla", b"1")),
+        )
+    except BaseException:
+        print(f"CHAOS FAILURE: replay with DRAGONBOAT_TPU_SEED={seed}")
+        raise
     finally:
         stop.set()
         cluster.close()
