@@ -581,6 +581,113 @@ def _bench_sm_cls():
     return _BenchSM
 
 
+def phase_balance(
+    shards: int = 16,
+    hosts: int = 4,
+    *,
+    rtt_ms: int = 2,
+    replicas: int = 3,
+    seed: int = 1,
+) -> dict:
+    """Balance control-plane convergence: drain one of ``hosts``
+    in-proc NodeHosts carrying ``shards`` x ``replicas`` and measure
+    how many logical ticks (and wall seconds) the control loop needs to
+    reach the drain fixed point (zero replicas on the drained host,
+    leader counts within ±1).  Pure host path — no device, no jax.
+    """
+    import shutil
+
+    from dragonboat_tpu import (
+        Config,
+        EngineConfig,
+        ExpertConfig,
+        NodeHost,
+        NodeHostConfig,
+    )
+    from dragonboat_tpu.balance import Balancer
+    from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+    reset_inproc_network()
+    sm_cls = _bench_sm_cls()
+    keys = [f"bench-bal-{i}" for i in range(hosts)]
+    nhs = {}
+    for i, key in enumerate(keys):
+        d = f"/tmp/nh-bench-bal-{i}"
+        shutil.rmtree(d, ignore_errors=True)
+        nhs[key] = NodeHost(NodeHostConfig(
+            nodehost_dir=d,
+            rtt_millisecond=rtt_ms,
+            raft_address=key,
+            expert=ExpertConfig(
+                engine=EngineConfig(exec_shards=2, apply_shards=2),
+            ),
+        ))
+
+    def cfg(sid, rid):
+        return Config(shard_id=sid, replica_id=rid,
+                      election_rtt=10, heartbeat_rtt=1)
+
+    try:
+        placements = {}
+        for sid in range(1, shards + 1):
+            ks = [keys[(sid + j) % hosts] for j in range(replicas)]
+            members = {rid: ks[rid - 1] for rid in range(1, replicas + 1)}
+            placements[sid] = members
+            for rid, key in members.items():
+                nhs[key].start_replica(members, False, sm_cls, cfg(sid, rid))
+        t_boot = time.monotonic()
+        deadline = t_boot + 60.0
+        covered = 0
+        while time.monotonic() < deadline:
+            covered = 0
+            for sid, members in placements.items():
+                seen = set()
+                for key in members.values():
+                    lid, ok = nhs[key].get_leader_id(sid)
+                    if not ok:
+                        break
+                    seen.add(lid)
+                else:
+                    covered += len(seen) == 1
+            if covered == shards:
+                break
+            time.sleep(0.05)
+        b = Balancer(sm_cls, cfg, hosts=dict(nhs), seed=seed,
+                     replication_factor=replicas)
+        drained = keys[0]
+        survivors = [k for k in keys if k != drained]
+        tick0 = max(nhs[k]._global_ticks for k in survivors)
+        t0 = time.monotonic()
+        report = b.drain(drained, timeout=240.0)
+        secs = time.monotonic() - t0
+        ticks = max(nhs[k]._global_ticks for k in survivors) - tick0
+        view = b.view()
+        lc = view.leader_counts()
+        lc.pop(drained, None)
+        b.stop()
+        return {
+            "shards": shards,
+            "hosts": hosts,
+            "replicas": replicas,
+            "rtt_ms": rtt_ms,
+            "seed": seed,
+            "leader_coverage_at_start": covered,
+            "drained_host_replicas_left": view.replicas_on(drained),
+            "moves_passes": report.get("passes", 0),
+            "convergence_ticks": int(ticks),
+            "convergence_secs": round(secs, 2),
+            "leader_spread_after": (
+                max(lc.values()) - min(lc.values()) if lc else -1
+            ),
+        }
+    finally:
+        for nh in nhs.values():
+            try:
+                nh.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
 def main() -> None:
     import jax
 
@@ -620,7 +727,8 @@ def main() -> None:
     # each phase-B success — each line complete and parseable on its
     # own.  Whatever the driver's cutoff, the last line standing is a
     # valid result.
-    def emit(ticks_per_sec: float, a_groups, device_loop, consensus) -> None:
+    def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
+             balance=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -640,6 +748,9 @@ def main() -> None:
                     "phase_a_groups": a_groups,
                     "device_loop": device_loop,
                     "consensus": consensus,
+                    # r06 schema addition: balance control-plane
+                    # convergence (host-only; see phase_balance)
+                    "balance": balance,
                 }
             ),
             flush=True,
@@ -756,6 +867,21 @@ def main() -> None:
             consensus = {"error": f"{c_err or 'failed'} at {c_shards} shards"}
         emit(ticks_per_sec, a_groups, device_loop, consensus)
 
+    # Balance control-plane convergence (host path only — cheap, no
+    # device risk): rebalance ticks for the 16-shard/4-host drain
+    balance = None
+    if bool(int(os.environ.get("BENCH_BALANCE", "1"))) and remaining() > 90:
+        code = (
+            "import json, bench;"
+            "print('BENCHBAL ' + json.dumps(bench.phase_balance(16, 4)))"
+        )
+        balance, bal_err = run_sub(
+            code, "BENCHBAL", max(60, min(300, int(remaining() - 30)))
+        )
+        if balance is None:
+            balance = {"error": bal_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -772,7 +898,7 @@ def main() -> None:
         if val is not None:
             ticks_per_sec = float(val)
             a_groups = fallback
-            emit(ticks_per_sec, a_groups, device_loop, consensus)
+            emit(ticks_per_sec, a_groups, device_loop, consensus, balance)
 
     if profile_dir and remaining() > 60:
         # profiling runs a small phase A in-process with the tracer on;

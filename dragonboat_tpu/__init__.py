@@ -10,7 +10,13 @@ sharded across TPU chips.
 """
 __version__ = "0.1.0"
 
-from .client import Session, propose_with_retry
+from .balance import (
+    Balancer,
+    BalanceAborted,
+    DrainTimeout,
+    MoveFailed,
+)
+from .client import Session, call_with_retry, propose_with_retry
 from .config import Config, EngineConfig, ExpertConfig, GossipConfig, NodeHostConfig
 from .faults import (
     Fault,
@@ -56,7 +62,13 @@ from .statemachine import (
 )
 
 __all__ = [
+    "Balancer",
+    "BalanceAborted",
+    "DrainTimeout",
+    "MoveFailed",
     "Session",
+    "call_with_retry",
+    "propose_with_retry",
     "Config",
     "EngineConfig",
     "ExpertConfig",

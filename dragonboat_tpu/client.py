@@ -88,6 +88,56 @@ class Session:
         return self.series_id in (SERIES_ID_REGISTER, SERIES_ID_UNREGISTER)
 
 
+def call_with_retry(
+    fn,
+    *,
+    timeout: float = 10.0,
+    deadline: Optional[float] = None,
+    base_backoff: float = 0.02,
+    max_backoff: float = 0.5,
+    rng=None,
+):
+    """Deadline-aware retry of an arbitrary synchronous request call.
+
+    The one retry discipline of the client path — retries ``fn()`` on
+    the transient failures a healthy-but-shaken cluster emits —
+    ShardNotReady, SystemBusy, ShardNotFound, RequestDropped and
+    timeouts — with jittered exponential backoff, never exceeding the
+    caller's deadline (``deadline`` as a ``time.monotonic()`` instant,
+    or ``timeout`` seconds from now).  :func:`propose_with_retry` is
+    the proposal-shaped wrapper.  Terminal errors propagate
+    immediately.  Returns ``fn()``'s result.
+    """
+    import random as _random
+    import time as _time
+
+    # lazy: nodehost imports this module
+    from .nodehost import RequestDropped, TimeoutError_
+    from .request import ShardNotFound, ShardNotReady, SystemBusy
+
+    retryable = (ShardNotReady, ShardNotFound, SystemBusy, RequestDropped,
+                 TimeoutError_)
+    rng = rng or _random.Random()
+    if deadline is None:
+        deadline = _time.monotonic() + timeout
+    backoff = base_backoff
+    attempt = 0
+    while True:
+        if deadline - _time.monotonic() <= 0:
+            raise TimeoutError_(
+                f"request deadline exhausted after {attempt} attempt(s)"
+            )
+        try:
+            return fn()
+        except retryable:
+            attempt += 1
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise
+            _time.sleep(min(backoff * (0.5 + rng.random()), remaining))
+            backoff = min(backoff * 2.0, max_backoff)
+
+
 def propose_with_retry(
     nodehost,
     session: Session,
@@ -115,35 +165,25 @@ def propose_with_retry(
     a retried timeout MAY apply twice — same contract as the reference
     client [U].  Terminal errors (InvalidTarget, rejected/terminated
     requests) propagate immediately.  Returns the proposal Result.
+
+    The retry discipline itself lives in :func:`call_with_retry` — one
+    loop to tune, not two.
     """
-    import random as _random
     import time as _time
 
-    # lazy: nodehost imports this module
-    from .nodehost import RequestDropped, TimeoutError_
-    from .request import ShardNotFound, ShardNotReady, SystemBusy
-
-    retryable = (ShardNotReady, ShardNotFound, SystemBusy, RequestDropped,
-                 TimeoutError_)
-    rng = rng or _random.Random()
     if deadline is None:
         deadline = _time.monotonic() + timeout
-    backoff = base_backoff
-    attempt = 0
-    while True:
-        remaining = deadline - _time.monotonic()
-        if remaining <= 0:
-            raise TimeoutError_(
-                f"proposal deadline exhausted after {attempt} attempt(s)"
-            )
-        try:
-            return nodehost.sync_propose(
-                session, cmd, timeout=min(per_try_timeout, remaining)
-            )
-        except retryable:
-            attempt += 1
-            remaining = deadline - _time.monotonic()
-            if remaining <= 0:
-                raise
-            _time.sleep(min(backoff * (0.5 + rng.random()), remaining))
-            backoff = min(backoff * 2.0, max_backoff)
+
+    def attempt():
+        remaining = max(deadline - _time.monotonic(), 0.001)
+        return nodehost.sync_propose(
+            session, cmd, timeout=min(per_try_timeout, remaining)
+        )
+
+    return call_with_retry(
+        attempt,
+        deadline=deadline,
+        base_backoff=base_backoff,
+        max_backoff=max_backoff,
+        rng=rng,
+    )

@@ -120,7 +120,22 @@ class EngineConfig:
 
 @dataclass
 class NodeHostConfig:
-    """Per-process configuration (reference: config.NodeHostConfig [U])."""
+    """Per-process configuration (reference: config.NodeHostConfig [U]).
+
+    ``tick_sweep_batch`` coarsens the host ticker: the per-node sweep
+    runs only every Nth ``rtt_millisecond`` period, crediting N logical
+    ticks at once — the same logical tick RATE at 1/N the per-node host
+    cost (the mass-start tooling knob, formerly the undocumented
+    ``TICK_SWEEP_BATCH`` env var, which remains honoured when this field
+    is 0).  Timing-granularity implication: election/heartbeat/quiesce
+    deadlines are still crossed at the right tick COUNT, but the
+    crossing is only observed at sweep boundaries, so any raft timer can
+    fire up to ``(N-1) * rtt_millisecond`` wall-clock late and N ticks
+    land in one step with no wall time between them for responses.
+    Keep ``N * heartbeat_rtt`` well under ``election_rtt`` or healthy
+    leaders will flap; intended for experiments and mass-start tooling,
+    not steady-state deployments.  0 = use the env var, else 1.
+    """
 
     deployment_id: int = 0
     nodehost_dir: str = ""
@@ -139,6 +154,7 @@ class NodeHostConfig:
     max_snapshot_recv_bytes_per_second: int = 0
     notify_commit: bool = False
     enable_metrics: bool = False
+    tick_sweep_batch: int = 0  # 0 = TICK_SWEEP_BATCH env var, else 1
     gossip: GossipConfig = field(default_factory=GossipConfig)
     expert: ExpertConfig = field(default_factory=ExpertConfig)
     raft_event_listener: Optional[object] = None
@@ -149,6 +165,8 @@ class NodeHostConfig:
             raise ConfigError("nodehost_dir not set")
         if self.rtt_millisecond <= 0:
             raise ConfigError("rtt_millisecond must be > 0")
+        if self.tick_sweep_batch < 0:
+            raise ConfigError("tick_sweep_batch must be >= 0")
         if not self.raft_address:
             raise ConfigError("raft_address not set")
         if self.address_by_nodehost_id and self.gossip.is_empty():

@@ -16,7 +16,14 @@ from .raftio import IRaftEventListener, ISystemEventListener, LeaderInfo
 _log = get_logger("nodehost")
 
 
-class EventFanout(ISystemEventListener):
+class EventFanout:
+    # NOT a subclass of ISystemEventListener: its concrete no-op
+    # methods would shadow the __getattr__ forwarding below (normal
+    # attribute lookup finds the inherited no-op, __getattr__ never
+    # fires), silently dropping every system event — which is exactly
+    # what happened until the balance control plane's event drive
+    # caught it.  Duck typing is the contract; nothing isinstance-checks
+    # the fanout.
     def __init__(
         self,
         raft_listener: Optional[IRaftEventListener] = None,

@@ -22,6 +22,11 @@ every layer consumes:
   effects, replay on the scalar).
 * **process** — ``crash`` faults call harness-registered kill/restart
   callbacks, so replica crash-restart cycles ride the same schedule.
+* **balance** — the rebalancing move executor (``balance/executor.py``)
+  consults :meth:`on_balance_step` before every step of a move;
+  ``balance_abort`` kills the move mid-sequence (forcing the rollback
+  path) and ``balance_stall`` stretches a step so other planes can
+  strike while the move is in flight.
 
 Determinism contract: a plan is executed strictly in schedule order by
 one nemesis thread, and :attr:`FaultController.event_log` records each
@@ -66,7 +71,13 @@ WIRE_KINDS = (
 FS_KINDS = ("fsync_err", "torn_write", "write_err")
 ENGINE_KINDS = ("escalate",)
 PROCESS_KINDS = ("crash",)
-ALL_KINDS = WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS
+# balance plane: kill a rebalancing move mid-sequence.  ``balance_abort``
+# makes the executor's next fault-point check raise (targets = shard
+# ids, empty = every shard); ``balance_stall`` sleeps ``delay`` seconds
+# at the fault point, widening the window in which wire/process faults
+# can land mid-move.
+BALANCE_KINDS = ("balance_abort", "balance_stall")
+ALL_KINDS = WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS + BALANCE_KINDS
 
 
 class TornWriteError(OSError):
@@ -348,6 +359,11 @@ class FaultController:
         self.install_transport(nh.transport)
         self.install_logdb(key, nh.logdb)
 
+    def install_balancer(self, balancer) -> None:
+        """Install on a balance-plane Balancer (its executor consults
+        :meth:`on_balance_step` before every move step)."""
+        balancer.fault_injector = self
+
     def set_crash_handlers(
         self, crash_fn: Callable, restart_fn: Callable
     ) -> None:
@@ -613,6 +629,28 @@ class FaultController:
                 if self._draw("write_err", key, op) < f.p:
                     self._count("fs_write_errors")
                     raise OSError(f"nemesis: injected write error ({op} {path})")
+
+    def on_balance_step(self, shard_id: int, step: str) -> bool:
+        """Balance hook, consulted by the move executor before each step
+        of the add -> catchup -> transfer -> remove sequence.  True tells
+        the executor to abort the move (it must then roll back); an
+        active ``balance_stall`` window sleeps here instead, stretching
+        the step so other planes can strike mid-move."""
+        with self._lock:
+            active = list(self._active)
+        for f in active:
+            if f.kind not in BALANCE_KINDS:
+                continue
+            if f.targets and shard_id not in f.targets:
+                continue
+            if f.kind == "balance_stall":
+                if self._draw("balance_stall", shard_id, step) < f.p:
+                    self._count("balance_stalled")
+                    time.sleep(f.delay)
+            elif self._draw("balance_abort", shard_id, step) < f.p:
+                self._count("balance_aborted")
+                return True
+        return False
 
     def on_engine_step(self, shard_id: int, replica_id: int) -> bool:
         """Engine hook: True forces the kernel-escalation recovery path

@@ -53,22 +53,26 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket latency histogram (seconds)."""
+    """Fixed-bucket latency histogram (seconds).  The default bounds
+    suit sub-second request latencies; pass ``bounds`` for series whose
+    observations run longer (e.g. multi-second rebalance moves, which
+    would otherwise all land in +Inf and carry no distribution)."""
 
     BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 
-    __slots__ = ("name", "buckets", "count", "total")
+    __slots__ = ("name", "bounds", "buckets", "count", "total")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, bounds=None):
         self.name = name
-        self.buckets = [0] * (len(self.BOUNDS) + 1)
+        self.bounds = tuple(bounds) if bounds is not None else self.BOUNDS
+        self.buckets = [0] * (len(self.bounds) + 1)
         self.count = 0
         self.total = 0.0
 
     def observe(self, v: float) -> None:
         self.count += 1
         self.total += v
-        for i, b in enumerate(self.BOUNDS):
+        for i, b in enumerate(self.bounds):
             if v <= b:
                 self.buckets[i] += 1
                 return
@@ -123,14 +127,15 @@ class MetricsRegistry:
                 g.fn = fn
             return g
 
-    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None):
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  bounds=None):
         if not self.enabled:
             return _NOOP
         name = _labeled(name, labels)
         with self._lock:
             h = self._hists.get(name)
             if h is None:
-                h = self._hists[name] = Histogram(name)
+                h = self._hists[name] = Histogram(name, bounds=bounds)
             return h
 
     def timer(self, name: str):
@@ -177,7 +182,7 @@ class MetricsRegistry:
                 inner = h.name[len(base):].strip("{}")
                 pre = f"{inner}," if inner else ""
                 acc = 0
-                for i, b in enumerate(Histogram.BOUNDS):
+                for i, b in enumerate(h.bounds):
                     acc += h.buckets[i]
                     out.append(f'{base}_bucket{{{pre}le="{b}"}} {acc}')
                 out.append(f'{base}_bucket{{{pre}le="+Inf"}} {h.count}')

@@ -108,7 +108,8 @@ class Node:
         "_ticks_in", "_ticks_taken",
         "pending_proposal", "pending_read_index", "pending_config_change",
         "pending_snapshot", "pending_leader_transfer", "device_reads",
-        "tick_count", "leader_id", "stopped", "stopping", "_snapshotting",
+        "tick_count", "leader_id", "proposal_count", "stopped", "stopping",
+        "_snapshotting",
         "_applied_since_snapshot", "_retired_snapshots", "_apply_lock",
         "_sm_close_lock", "notify_work", "engine_apply_ready",
         "log_reader", "sm", "_stop_event", "peer", "quiesce",
@@ -195,6 +196,12 @@ class Node:
 
         self.tick_count = 0
         self.leader_id = 0
+        # monotone count of user proposals accepted into the queue
+        # (incremented under _qlock beside the enqueue — a bare += on
+        # concurrent producer threads is a non-atomic read-modify-write
+        # and loses increments); the balance collector diffs it across
+        # collect rounds to derive per-shard proposal rates
+        self.proposal_count = 0
         self.stopped = False
         # stopping = shutdown announced but SM not yet closed: the node
         # must stop PARTICIPATING (elections, device routing) immediately
@@ -225,7 +232,20 @@ class Node:
         bootstrap = logdb.get_bootstrap_info(config.shard_id, config.replica_id)
         new_node = bootstrap is None
         if new_node:
-            members = {} if join else dict(initial_members)
+            # a JOIN may seed the current membership: the bootstrap
+            # members were never log entries, so a fresh joiner whose
+            # catch-up is snapshot-less (short, uncompacted leader log)
+            # replays a log with no trace of them and would believe the
+            # shard's voter set is just itself — a leadership transfer
+            # to it then self-elects into a split brain (balance-plane
+            # finding).  Seeding is safe against the replayed config
+            # changes: membership validation no-op-accepts a
+            # same-address re-add and rejects removes of absent
+            # members, so replay on top of the seeded state converges
+            # to the same final membership.  An empty-members join
+            # (the reference's contract) still works and learns
+            # membership from the leader's snapshot.
+            members = dict(initial_members)
             logdb.save_bootstrap_info(
                 config.shard_id,
                 config.replica_id,
@@ -357,6 +377,7 @@ class Node:
             session, cmd, self.tick_count + timeout_ticks
         )
         with self._qlock:
+            self.proposal_count += 1
             self._proposals.append(entry)
         self._wake()
         return rs
@@ -504,22 +525,28 @@ class Node:
         per-launch ``step_cap`` with defer): one definition so the
         colocated fast tick lane and the full drain can never diverge.
 
-        LOCKING CONTRACT: caller must be the only step consumer (the
-        colocated engine's core lock); in that regime every
-        ``_pending_ticks`` writer also runs under the same lock, so no
-        ``_qlock`` is needed.  Returns ``(ticks, gc_ticks)``."""
+        LOCKING: caller must be the only step consumer (the colocated
+        engine's core lock), which serializes it against the OTHER step-
+        side ``_pending_ticks`` writers — but NOT against
+        ``grant_ticks``, which runs on producer threads under ``_qlock``
+        only (NodeHost._wake_node unparking a quiesced node).  The
+        ``_pending_ticks`` read-modify-write therefore takes ``_qlock``
+        (uncontended in the common case); without it a node woken
+        concurrently with a fast-lane step could lose up to an election
+        window of credited ticks.  Returns ``(ticks, gc_ticks)``."""
         lane = self._ticks_in - self._ticks_taken
         self._ticks_taken += lane
-        total = self._pending_ticks + lane
-        ticks = min(total, self.config.election_rtt)
-        gc = total - ticks
-        if step_cap < 1:
-            step_cap = 1
-        if ticks > step_cap:
-            self._pending_ticks = ticks - step_cap
-            ticks = step_cap
-        else:
-            self._pending_ticks = 0
+        with self._qlock:
+            total = self._pending_ticks + lane
+            ticks = min(total, self.config.election_rtt)
+            gc = total - ticks
+            if step_cap < 1:
+                step_cap = 1
+            if ticks > step_cap:
+                self._pending_ticks = ticks - step_cap
+                ticks = step_cap
+            else:
+                self._pending_ticks = 0
         return ticks, gc
 
     def step(self) -> Optional[Update]:

@@ -202,6 +202,13 @@ class NodeHost:
                 "raft_transport_snapshots_sent_total",
                 lambda: self.transport.metrics["snapshots_sent"],
             )
+            def _proposals_total():
+                with self._nodes_lock:
+                    return sum(n.proposal_count for n in self._nodes.values())
+
+            self.metrics.gauge(
+                "raft_nodehost_proposals_total", _proposals_total
+            )
 
             step_engine = (
                 expert.step_engine_factory(self) if expert.step_engine_factory else None
@@ -273,10 +280,14 @@ class NodeHost:
     def _ticker_main(self) -> None:
         import os as _os
 
-        # experiment knob: sweep the per-node loop only every Nth
-        # period, crediting N ticks at once (same logical tick rate,
-        # 1/N the per-node host cost)
-        batch = max(1, int(_os.environ.get("TICK_SWEEP_BATCH", "1")))
+        # sweep the per-node loop only every Nth period, crediting N
+        # ticks at once (same logical tick rate, 1/N the per-node host
+        # cost); see NodeHostConfig.tick_sweep_batch for the timing-
+        # granularity caveats.  The env var remains the fallback for
+        # deployments that predate the config field.
+        batch = self.config.tick_sweep_batch or max(
+            1, int(_os.environ.get("TICK_SWEEP_BATCH", "1"))
+        )
         period = self.config.rtt_millisecond / 1000.0 * batch
         while not self._ticker_stop.wait(period):
             if self._ticks_paused:
@@ -703,6 +714,31 @@ class NodeHost:
                     for n in self._nodes.values()
                 ],
             }
+
+    def balance_shard_stats(self) -> list:
+        """Per-replica stats for the balance control plane's collector
+        (balance/view.py): leader identity, applied index, cumulative
+        proposal count and the replica's view of the shard membership.
+        Cheap reads off producer threads — same benign races as
+        :meth:`get_nodehost_info`."""
+        with self._nodes_lock:
+            nodes = list(self._nodes.values())
+        out = []
+        for n in nodes:
+            if n.stopped or n.stopping:
+                continue
+            out.append(
+                {
+                    "shard_id": n.shard_id,
+                    "replica_id": n.replica_id,
+                    "leader_id": n.leader_id,
+                    "term": n.peer.term(),
+                    "applied": n.sm.last_applied,
+                    "proposals": n.proposal_count,
+                    "membership": n.get_membership(),
+                }
+            )
+        return out
 
     def raft_address(self) -> str:
         return self.config.raft_address

@@ -92,6 +92,13 @@ from .types import (
     make_out,
 )
 
+# test hook (tests/test_kernel_parity.py): True forces every lax.cond
+# handler gate in _process_slot open, so each handler also runs under
+# an all-false mask — pinning the handler no-op invariant documented at
+# the campaign section header.  Read at trace time; never set this in
+# production code.
+_FORCE_GATES = False
+
 # ---------------------------------------------------------------------------
 # internal (G-last) layout plumbing
 # ---------------------------------------------------------------------------
@@ -663,6 +670,19 @@ def _become_leader(st, out, mask, E) -> Tuple[DeviceState, DeviceOut]:
 
 # ---------------------------------------------------------------------------
 # campaign (oracle: campaign / _handle_election)
+#
+# HANDLER INVARIANT (load-bearing for the _process_slot lax.cond gating):
+# every handler below — and every handler added later — must be a PURE
+# NO-OP under an all-false mask: all writes to ``st``/``out`` must be
+# mask-selected (jnp.where/_emit with the handler's mask), with NO
+# unmasked state normalization, clamping or counter maintenance outside
+# the mask.  _process_slot skips whole handler blocks via lax.cond when
+# a slot batch contains none of their message types; a handler that
+# mutated anything under an all-false mask would make gated and ungated
+# execution diverge, surfacing only as rare batch-composition-dependent
+# corruption.  tests/test_kernel_parity.py pins the equivalence by
+# running _process_slot with every gate forced open (_FORCE_GATES)
+# against the normally-gated path.
 # ---------------------------------------------------------------------------
 def _campaign(st, out, mask, pre, transfer, E) -> Tuple[DeviceState, DeviceOut]:
     pre_m = mask & pre
@@ -1344,6 +1364,12 @@ def _process_slot(st, out, msg, slot_i, E):
         return acc
 
     def _gate(pred, fn, st, out):
+        # _FORCE_GATES (test hook): run every handler regardless of
+        # batch presence, exercising them under all-false masks — the
+        # parity test's lever for pinning the handler no-op invariant
+        # (see the campaign section header)
+        if _FORCE_GATES:
+            return fn(st, out)
         return lax.cond(pred, fn, lambda s, o: (s, o), st, out)
 
     # LOCAL_TICK short-circuits the gate (oracle: handle); log_index

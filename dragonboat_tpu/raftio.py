@@ -190,6 +190,24 @@ class ConnectionInfo:
     snapshot_connection: bool
 
 
+@dataclass(frozen=True)
+class BalanceMoveInfo:
+    """One rebalancing move transition (balance/ control plane).
+
+    ``step`` is the move state the transition refers to: ``plan``,
+    ``add``, ``catchup``, ``transfer``, ``remove``, ``rollback``.
+    ``src``/``dst`` are host keys (raft addresses); for pure leadership
+    transfers ``replica_id`` is the transfer target.
+    """
+
+    shard_id: int
+    kind: str
+    src: str
+    dst: str
+    replica_id: int
+    step: str = ""
+
+
 class IRaftEventListener(abc.ABC):
     @abc.abstractmethod
     def leader_updated(self, info: LeaderInfo) -> None: ...
@@ -228,3 +246,15 @@ class ISystemEventListener:
     def log_compacted(self, info: EntryInfo) -> None: ...
 
     def log_db_compacted(self, info: EntryInfo) -> None: ...
+
+    # -- balance/ control-plane transitions (no reference equivalent:
+    # upstream stops at mechanism and leaves placement to the user) --
+    def balance_move_started(self, info: BalanceMoveInfo) -> None: ...
+
+    def balance_move_step(self, info: BalanceMoveInfo) -> None: ...
+
+    def balance_move_completed(self, info: BalanceMoveInfo) -> None: ...
+
+    def balance_move_failed(self, info: BalanceMoveInfo) -> None: ...
+
+    def balance_move_rolled_back(self, info: BalanceMoveInfo) -> None: ...

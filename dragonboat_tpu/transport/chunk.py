@@ -235,6 +235,13 @@ class ChunkSink:
             with self._lock:
                 if self._inflight.get(key) is fl:
                     del self._inflight[key]
+            # tell the SENDER over the wire, like the validation-failure
+            # path in _complete: on transports where the send is
+            # buffered (TCP) the returned False never reaches the
+            # sending stream job, and without the reject the leader's
+            # raft remote would wedge in SNAPSHOT state forever
+            if self.reject_fn is not None:
+                self.reject_fn(c.shard_id, c.from_, c.replica_id)
             return False
         fl.next_chunk = c.chunk_id + 1
         done = fl.next_chunk == fl.count
@@ -271,6 +278,8 @@ class ChunkSink:
             except Exception as e:  # noqa: BLE001 - disk trouble
                 _log.warning("receive sink finalize failed: %s", e)
                 fl.sink.abort()
+                if self.reject_fn is not None:
+                    self.reject_fn(last.shard_id, last.from_, last.replica_id)
                 return False
         ss = Snapshot(
             filepath=filepath,

@@ -51,22 +51,28 @@ def _encode_row(nhid: str, addr: str, ver: int) -> bytes:
 
 
 def _encode_packets(
-    table: Dict[str, Tuple[str, int]], sender: str
+    table: Dict[str, Tuple[str, int]], sender: str, sender_id: str = ""
 ) -> List[bytes]:
     """Shard the full table into UDP-safe packets (each under MAX_PACKET
     and under the decoder's 4096-row cap).  Every packet carries the
     ``__sender__`` row so receivers learn the peer address from any
-    fragment; merge is per-row, so fragments need no reassembly."""
-    sender_row = _encode_row("__sender__", sender, 0)
-    rows: List[List[bytes]] = [[sender_row]]
-    size = 8 + len(sender_row)
+    fragment, plus the ``__sender_id__`` row (the origin's NodeHostID)
+    so receivers can track per-host liveness from DIRECT contact — a
+    relayed row about X says nothing about X being alive; a packet FROM
+    X does.  Merge is per-row, so fragments need no reassembly."""
+    meta_rows = [_encode_row("__sender__", sender, 0)]
+    if sender_id:
+        meta_rows.append(_encode_row("__sender_id__", sender_id, 0))
+    meta_size = sum(len(r) for r in meta_rows)
+    rows: List[List[bytes]] = [list(meta_rows)]
+    size = 8 + meta_size
     for nhid, (addr, ver) in table.items():
         if len(nhid.encode()) > MAX_ROW_STR or len(addr.encode()) > MAX_ROW_STR:
             continue  # decoder would reject it anyway; don't waste a packet
         rb = _encode_row(nhid, addr, ver)
         if size + len(rb) > MAX_PACKET or len(rows[-1]) >= MAX_ROWS:
-            rows.append([sender_row])
-            size = 8 + len(sender_row)
+            rows.append(list(meta_rows))
+            size = 8 + meta_size
         rows[-1].append(rb)
         size += len(rb)
     return [
@@ -134,6 +140,10 @@ class GossipManager:
         self._table: Dict[str, Tuple[str, int]] = {nodehost_id: (raft_address, 1)}
         # gossip peer addresses we have heard from (for fanout selection)
         self._peers: set = set(seeds)
+        # nodehost_id -> monotonic instant of last DIRECT packet from it
+        # (liveness for the balance control plane; relayed rows don't
+        # count — see _encode_packets)
+        self._last_heard: Dict[str, float] = {}
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -182,9 +192,48 @@ class GossipManager:
         with self._lock:
             return {k: v[0] for k, v in self._table.items()}
 
-    # -- internals -------------------------------------------------------
-    def _merge(self, table: Dict[str, Tuple[str, int]], sender) -> None:
+    def last_heard(self, nodehost_id: str) -> Optional[float]:
+        """Monotonic instant of the last packet received directly from
+        the host, or None if never heard (self counts as now)."""
+        import time as _time
+
+        if nodehost_id == self.nodehost_id:
+            return _time.monotonic()
         with self._lock:
+            return self._last_heard.get(nodehost_id)
+
+    def alive_peers(self, window: Optional[float] = None) -> set:
+        """NodeHostIDs heard from directly within ``window`` seconds
+        (always includes self).  The balance collector's liveness
+        signal when hosts span processes.
+
+        The default window scales with fleet size: each push round
+        targets only ``fanout`` random peers (plus the seeds), so with
+        N hosts the expected gap between DIRECT contacts from a given
+        live peer is ~``interval * N / fanout`` — a fixed small window
+        would mark live hosts dead at moderate fleet sizes and the
+        balance repair invariant would churn their replicas.  Pass an
+        explicit window only with that math in mind."""
+        import time as _time
+
+        if window is None:
+            with self._lock:
+                n = max(len(self._table), 1)
+            window = max(2.0, self.interval * 5.0 * n / max(self.fanout, 1))
+        cutoff = _time.monotonic() - window
+        with self._lock:
+            alive = {k for k, t in self._last_heard.items() if t >= cutoff}
+        alive.add(self.nodehost_id)
+        return alive
+
+    # -- internals -------------------------------------------------------
+    def _merge(self, table: Dict[str, Tuple[str, int]], sender,
+               sender_id: Optional[str] = None) -> None:
+        import time as _time
+
+        with self._lock:
+            if sender_id:
+                self._last_heard[sender_id] = _time.monotonic()
             for nhid, (addr, ver) in table.items():
                 if nhid == self.nodehost_id:
                     # never accept a peer's view of OUR address: after a
@@ -211,9 +260,15 @@ class GossipManager:
             table = _decode_table(data)
             if table is None:
                 continue
-            # the packet's trailing row carries the sender's gossip addr
+            # the packet's meta rows carry the sender's gossip addr and
+            # NodeHostID (the liveness signal)
             sender = table.pop("__sender__", None)
-            self._merge(table, sender[0] if sender else None)
+            sender_id = table.pop("__sender_id__", None)
+            self._merge(
+                table,
+                sender[0] if sender else None,
+                sender_id[0] if sender_id else None,
+            )
 
     def _push_main(self) -> None:
         while not self._stop.is_set():
@@ -223,7 +278,7 @@ class GossipManager:
             with self._lock:
                 table = dict(self._table)
                 peers = list(self._peers)
-            pkts = _encode_packets(table, self.advertise_address)
+            pkts = _encode_packets(table, self.advertise_address, self.nodehost_id)
             random.shuffle(peers)
             targets = peers[: self.fanout]
             for seed in self.seeds:

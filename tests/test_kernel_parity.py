@@ -332,3 +332,102 @@ def test_fused_multi_tick_slot():
         if buf3[k][F_MTYPE] == MT_HEARTBEAT
     ]
     assert sorted(hb_targets) == [2, 3], hb_targets
+
+
+def test_forced_gates_equal_masked_false():
+    """Pin the handler no-op invariant behind the lax.cond gating: a
+    gate forced OFF (the cond skips the whole handler block) must be
+    bit-identical to running every handler with its all-false mask
+    (kernel._FORCE_GATES forces every gate open).  A handler with ANY
+    unmasked state normalization would diverge here instead of as rare
+    batch-composition-dependent corruption in production."""
+    import jax
+    import numpy as np
+
+    from dragonboat_tpu.ops import kernel as K
+    from dragonboat_tpu.ops import sync as S
+
+    from kernel_harness import Cluster, O
+
+    # two independently-traced copies of the un-jitted step: the flag is
+    # read at TRACE time, so the first call of each bakes its gating
+    # mode into the compiled program (eager _process_slot is minutes of
+    # per-op dispatch on CPU; two jit traces are seconds)
+    raw_step = K.step.__wrapped__
+    base_fn = jax.jit(raw_step, static_argnames=("out_capacity",))
+    forced_fn = jax.jit(raw_step, static_argnames=("out_capacity",))
+
+    def run_forced(state, inbox):
+        assert not K._FORCE_GATES
+        K._FORCE_GATES = True
+        try:
+            return forced_fn(state, inbox, out_capacity=O)
+        finally:
+            K._FORCE_GATES = False
+
+    def assert_parity(c, batches):
+        ordered = [list(batches.get(k, ())) for k in c.rows]
+        inbox, overflow = S.encode_inbox(ordered, M, E)
+        assert not overflow
+        base_st, base_out = base_fn(c.state, inbox, out_capacity=O)
+        forced_st, forced_out = run_forced(c.state, inbox)
+        for name in base_st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(base_st, name)),
+                np.asarray(getattr(forced_st, name)),
+                err_msg=f"state field {name!r} diverged under forced gates",
+            )
+        for name in base_out._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(base_out, name)),
+                np.asarray(getattr(forced_out, name)),
+                err_msg=f"out field {name!r} diverged under forced gates",
+            )
+
+    c = Cluster({1: [1, 2, 3]}, pre_vote=True, check_quorum=True)
+    # election phase: tick-only and vote-carrying batches leave most
+    # gates (propose/read/replicate/rare) closed every step
+    for _ in range(12):
+        b = c.deliver_batches(tick=True)
+        assert_parity(c, b)
+        c.step(b)
+    lid = c.elect(1)
+    key = (1, lid)
+    # replication phase: PROPOSE + REPLICATE/RESP traffic, vote gates
+    # closed
+    b = c.deliver_batches(tick=False, extra={key: [c.propose(1, lid, [b"a"])]})
+    assert_parity(c, b)
+    c.step(b)
+    for _ in range(4):
+        b = c.deliver_batches(tick=False)
+        assert_parity(c, b)
+        c.step(b)
+    # one step per rare/cold-path hot type, everything else closed
+    follower = next(r for r in (1, 2, 3) if r != lid)
+    for m in (
+        Message(type=MessageType.READ_INDEX, hint=7, hint_high=9),
+        Message(type=MessageType.UNREACHABLE, from_=follower),
+        Message(type=MessageType.SNAPSHOT_STATUS, from_=follower, reject=True),
+    ):
+        b = {key: [m]}
+        assert_parity(c, b)
+        c.step(b)
+        b = c.deliver_batches(tick=False)
+        if b:
+            assert_parity(c, b)
+            c.step(b)
+    # leadership transfer exercises the TIMEOUT_NOW gate on a follower
+    b = {
+        (1, follower): [
+            Message(
+                type=MessageType.TIMEOUT_NOW,
+                from_=lid,
+                to=follower,
+                term=c.rafts[key].term,
+            )
+        ]
+    }
+    assert_parity(c, b)
+    # the purest form: an all-empty inbox — every gate off vs every
+    # handler under an all-false mask
+    assert_parity(c, {})
