@@ -16,12 +16,13 @@ from .balance import (
     DrainTimeout,
     MoveFailed,
 )
-from .client import Session, call_with_retry, propose_with_retry
+from .client import LatencyBudget, Session, call_with_retry, propose_with_retry
 from .config import Config, EngineConfig, ExpertConfig, GossipConfig, NodeHostConfig
 from .faults import (
     Fault,
     FaultController,
     FaultPlan,
+    RecoverySLAAborted,
     RecoverySLAViolation,
     assert_recovery_sla,
 )
@@ -66,6 +67,7 @@ __all__ = [
     "BalanceAborted",
     "DrainTimeout",
     "MoveFailed",
+    "LatencyBudget",
     "Session",
     "call_with_retry",
     "propose_with_retry",

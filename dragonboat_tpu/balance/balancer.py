@@ -132,15 +132,18 @@ class Balancer:
     def plan(self) -> MovePlan:
         return self.planner.plan(self.view())
 
-    def rebalance_once(self) -> dict:
+    def rebalance_once(self, max_moves: Optional[int] = None) -> dict:
         """One collect -> plan -> execute pass.  Executes the plan's
         moves in order, re-collecting the view after each membership
         move (the next move must see the world the previous one made).
         Whole passes are serialized (``drain`` may overlap the ``run``
         loop; two executors moving concurrently would race membership).
-        Returns ``{"planned": n, "executed": n, "failed": n}``."""
+        ``max_moves`` caps how many of the planned moves execute this
+        pass (the churn nemesis races exactly ONE move against its
+        schedule; later passes converge the rest).  Returns
+        ``{"planned": n, "executed": n, "failed": n}``."""
         with self._pass_lock:
-            return self._rebalance_locked()
+            return self._rebalance_locked(max_moves)
 
     _TRIM_LIVE_PASSES = 3
 
@@ -167,7 +170,7 @@ class Balancer:
             if n >= self._TRIM_LIVE_PASSES
         }
 
-    def _rebalance_locked(self) -> dict:
+    def _rebalance_locked(self, max_moves: Optional[int] = None) -> dict:
         view = self.view()
         plan = self.planner.plan(view, self._update_surplus_streaks(view))
         self.metrics.gauge("balance_last_plan_size").set(len(plan))
@@ -176,6 +179,8 @@ class Balancer:
         self.executor.fault_injector = self.fault_injector
         for move in plan:
             if self._stop.is_set():
+                break
+            if max_moves is not None and executed + failed >= max_moves:
                 break
             try:
                 self.executor.execute(move, view)

@@ -27,6 +27,16 @@ every layer consumes:
   ``balance_abort`` kills the move mid-sequence (forcing the rollback
   path) and ``balance_stall`` stretches a step so other planes can
   strike while the move is in flight.
+* **churn** — drummer-style scheduled churn (:meth:`install_churn`):
+  ``leader_kill`` samples and kills the CURRENT leader of a shard,
+  ``leader_transfer`` forces leadership to another voter,
+  ``member_cycle`` adds/removes a non-voting member mid-traffic and
+  ``balance_move`` races one ``Balancer`` move against the schedule —
+  each optionally followed by a per-event recovery-SLA assert
+  (re-election bound + commit continuity; misses collect in
+  :attr:`FaultController.churn_violations`).  The linearizability
+  audit harness (``dragonboat_tpu.audit``, docs/AUDIT.md) records
+  client histories while this plane runs and checks them offline.
 
 Determinism contract: a plan is executed strictly in schedule order by
 one nemesis thread, and :attr:`FaultController.event_log` records each
@@ -77,7 +87,27 @@ PROCESS_KINDS = ("crash",)
 # at the fault point, widening the window in which wire/process faults
 # can land mid-move.
 BALANCE_KINDS = ("balance_abort", "balance_stall")
-ALL_KINDS = WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS + BALANCE_KINDS
+# churn plane (drummer-style scheduled churn; see install_churn):
+# ``leader_kill`` samples the CURRENT leader of a target shard and
+# kills its host replica through the harness kill handler (duration =
+# downtime before the restart handler fires); ``leader_transfer``
+# forces leadership to a deterministically-drawn other voter;
+# ``member_cycle`` adds a fresh non-voting member mid-traffic and
+# removes it again at heal; ``balance_move`` races one Balancer move
+# (balance/) against whatever else the schedule has active.  Targets
+# are shard ids (empty = one drawn from the installed churn shards).
+# The SCHEDULE stays byte-identical per seed (event_log records only
+# the declarative faults); runtime-sampled victims go to ``churn_log``.
+CHURN_KINDS = (
+    "leader_kill",
+    "leader_transfer",
+    "member_cycle",
+    "balance_move",
+)
+ALL_KINDS = (
+    WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS + BALANCE_KINDS
+    + CHURN_KINDS
+)
 
 
 class TornWriteError(OSError):
@@ -143,13 +173,16 @@ class FaultPlan:
         fs_keys: Sequence = (),
         crash_keys: Sequence = (),
         shards: Sequence[int] = (),
+        churn_shards: Sequence[int] = (),
         rounds: int = 8,
         mean_gap: float = 0.8,
         mean_duration: float = 0.8,
     ) -> "FaultPlan":
         """Generate a randomized-but-deterministic plan: same arguments
         and seed produce the identical plan (the soak entry point's
-        replay contract)."""
+        replay contract).  ``churn_shards`` adds the churn plane's
+        leader kills / transfers / membership cycles to the kind pool
+        (the consumer must have called ``install_churn``)."""
         rng = Random(seed)
         addrs = list(addrs)
         kinds = ["partition", "drop", "delay", "duplicate", "reorder"]
@@ -159,6 +192,8 @@ class FaultPlan:
             kinds.append("crash")
         if shards:
             kinds.append("escalate")
+        if churn_shards:
+            kinds += ["leader_kill", "leader_transfer", "member_cycle"]
         t = 0.0
         faults: List[Fault] = []
         for _ in range(rounds):
@@ -201,6 +236,15 @@ class FaultPlan:
                         targets=(rng.choice(list(crash_keys)),),
                     )
                 )
+            elif kind in CHURN_KINDS:
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=max(0.4, dur) if kind != "leader_transfer" else 0.0,
+                        targets=(rng.choice(list(churn_shards)),),
+                    )
+                )
             else:  # escalate
                 faults.append(
                     Fault(
@@ -234,18 +278,32 @@ class RecoverySLAViolation(AssertionError):
     the fault plan healed."""
 
 
+class RecoverySLAAborted(Exception):
+    """The SLA check was cut short by ``should_abort`` (teardown) —
+    no verdict, neither a pass nor a violation."""
+
+
 def assert_recovery_sla(
     nhs: Dict,
     shard_id: int = 1,
     sla_ticks: int = 5000,
     cmd: Optional[bytes] = None,
     rtt_ms: Optional[int] = None,
+    per_try_timeout: float = 1.0,
+    should_abort: Optional[Callable[[], bool]] = None,
 ) -> int:
     """Recovery-SLA invariant: after faults heal, the cluster must
     re-establish FULL leader coverage (every NodeHost knows the same
     leader) and — when ``cmd`` is given — resume commit progress, all
     within ``sla_ticks`` logical ticks (converted to wall time via the
-    hosts' rtt).  Returns the leader id.  Raises
+    hosts' rtt).  ``per_try_timeout`` must exceed the cluster's commit
+    latency (at launch-generation scale a 1s try can never witness its
+    own commit — derive it from an observed p99, e.g.
+    ``LatencyBudget.per_try_timeout()``).  ``should_abort`` is polled
+    between waits/tries (a caller's stop flag — the nemesis thread must
+    not sit in a minutes-long SLA wait while teardown joins it); when
+    it fires, :class:`RecoverySLAAborted` is raised — an aborted check
+    has NO verdict.  Returns the leader id.  Raises
     :class:`RecoverySLAViolation` otherwise."""
     hosts = list(nhs.values())
     if not hosts:
@@ -256,6 +314,8 @@ def assert_recovery_sla(
     deadline = time.monotonic() + budget
     leader = None
     while time.monotonic() < deadline:
+        if should_abort is not None and should_abort():
+            raise RecoverySLAAborted(f"shard {shard_id}: caller stopping")
         seen = set()
         for nh in hosts:
             try:
@@ -282,19 +342,33 @@ def assert_recovery_sla(
         from .client import propose_with_retry
 
         nh = hosts[0]
-        try:
-            propose_with_retry(
-                nh,
-                nh.get_noop_session(shard_id),
-                cmd,
-                deadline=deadline,
-                per_try_timeout=1.0,
+        # sliced so should_abort is polled between tries: one slice is
+        # a couple of tries, and an in-flight sync_propose blocks at
+        # most per_try_timeout — the bound on abort latency
+        while True:
+            if should_abort is not None and should_abort():
+                raise RecoverySLAAborted(f"shard {shard_id}: caller stopping")
+            slice_end = min(
+                deadline,
+                time.monotonic() + max(2.0 * per_try_timeout, 2.0),
             )
-        except Exception as e:  # noqa: BLE001 — any terminal error is a miss
-            raise RecoverySLAViolation(
-                f"no commit progress on shard {shard_id} within "
-                f"{sla_ticks} ticks ({budget:.1f}s): {e!r}"
-            ) from e
+            try:
+                propose_with_retry(
+                    nh,
+                    nh.get_noop_session(shard_id),
+                    cmd,
+                    deadline=slice_end,
+                    per_try_timeout=per_try_timeout,
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — retry until the SLA
+                # deadline; the verdict at the deadline is the same
+                # violation whether the error was transient or terminal
+                if time.monotonic() >= deadline:
+                    raise RecoverySLAViolation(
+                        f"no commit progress on shard {shard_id} within "
+                        f"{sla_ticks} ticks ({budget:.1f}s): {e!r}"
+                    ) from e
     return leader
 
 
@@ -332,6 +406,27 @@ class FaultController:
         self._restart_fn: Optional[Callable] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # -- churn plane (install_churn) --------------------------------
+        self._churn_hosts = None  # dict or callable -> {key: NodeHost}
+        self._churn_shards: Tuple = ()
+        self._churn_balancer = None
+        self._churn_kill_fn: Optional[Callable] = None
+        self._churn_restart_fn: Optional[Callable] = None
+        self._churn_sla_ticks = 0
+        self._churn_sla_cmd = None
+        self._churn_sla_per_try = 1.0
+        self._churn_member_seq = 0
+        self._churn_state: Dict[int, Tuple] = {}  # id(fault) -> victim
+        # runtime-sampled victims/outcomes (NOT part of the byte-
+        # identical event_log contract — leaders are schedule-dependent;
+        # churn_log has its OWN counter so notes never perturb the
+        # event_log sequence numbers)
+        self.churn_log: List[Tuple] = []
+        self._churn_seq = 0
+        # per-event recovery-SLA misses (re-election bound / commit
+        # continuity); tests assert this stays empty
+        self.churn_violations: List[str] = []
+        self.metrics = None  # set by install_churn (or directly)
 
     # ------------------------------------------------------------------
     # installation
@@ -372,6 +467,44 @@ class FaultController:
         self._crash_fn = crash_fn
         self._restart_fn = restart_fn
 
+    def install_churn(
+        self,
+        hosts,
+        *,
+        shards: Sequence[int] = (1,),
+        balancer=None,
+        kill_fn: Optional[Callable] = None,
+        restart_fn: Optional[Callable] = None,
+        sla_ticks: int = 0,
+        sla_cmd=None,
+        sla_per_try: float = 1.0,
+        metrics=None,
+    ) -> None:
+        """Arm the churn plane (kinds in :data:`CHURN_KINDS`).
+
+        ``hosts`` is a ``{host_key: NodeHost}`` dict or a zero-arg
+        callable returning one (re-read per event — churn kills hosts).
+        ``kill_fn(host_key, shard_id)`` / ``restart_fn(host_key,
+        shard_id)`` override the kill granularity; by default the
+        PROCESS-plane crash handlers are used (whole-host kill).  With
+        ``sla_ticks`` > 0 every churn event is followed by a
+        per-event recovery-SLA check — full re-election within the tick
+        bound plus (when ``sla_cmd`` bytes or a zero-arg callable
+        producing them is given) commit continuity — and misses are
+        appended to :attr:`churn_violations`.  ``metrics`` (a
+        MetricsRegistry) receives ``churn_events_total{kind=...}`` and
+        ``churn_sla_violations_total`` counters."""
+        self._churn_hosts = hosts
+        self._churn_shards = tuple(shards)
+        self._churn_balancer = balancer
+        self._churn_kill_fn = kill_fn
+        self._churn_restart_fn = restart_fn
+        self._churn_sla_ticks = sla_ticks
+        self._churn_sla_cmd = sla_cmd
+        self._churn_sla_per_try = sla_per_try
+        if metrics is not None:
+            self.metrics = metrics
+
     # ------------------------------------------------------------------
     # imperative fault control
     # ------------------------------------------------------------------
@@ -382,6 +515,8 @@ class FaultController:
         if fault.kind == "crash" and self._crash_fn is not None:
             for t in fault.targets:
                 self._crash_fn(t)
+        elif fault.kind in CHURN_KINDS:
+            self._churn_apply(fault)
         return fault
 
     def deactivate(self, fault: Fault) -> None:
@@ -403,6 +538,8 @@ class FaultController:
         if fault.kind == "crash" and self._restart_fn is not None:
             for t in fault.targets:
                 self._restart_fn(t)
+        elif fault.kind in CHURN_KINDS:
+            self._churn_heal(fault)
 
     def set_partition(self, side: Sequence[str], both_ways: bool = True) -> Fault:
         """Replace any current partition with a new one (test helper)."""
@@ -425,17 +562,27 @@ class FaultController:
 
     def _heal_kinds(self, kinds, restart: bool = True) -> None:
         crashed = []
+        churned = []
         with self._lock:
             for f in [f for f in self._active if f.kind in kinds]:
                 self._active.remove(f)
                 self._record("heal", f)
                 if f.kind == "crash":
                     crashed.append(f)
+                elif f.kind in CHURN_KINDS:
+                    churned.append(f)
             self._held.clear()
         if restart and self._restart_fn is not None:
             for f in crashed:
                 for t in f.targets:
                     self._restart_fn(t)
+        for f in churned:
+            if restart:
+                self._churn_heal(f)
+            else:
+                # teardown path: abandon victim state without restarting
+                # onto a cluster being closed (mirrors crash semantics)
+                self._churn_state.pop(id(f), None)
 
     def active_faults(self) -> List[Fault]:
         with self._lock:
@@ -501,7 +648,19 @@ class FaultController:
         for a mid-run heal that should restart crashed nodes."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            # bound the join by the nemesis thread's worst-case
+            # non-abortable wait: an SLA probe slice (~2 tries), a
+            # member_cycle call_with_retry (8s) or a balance-move
+            # worker join (10s), plus margin — healing while the
+            # nemesis still runs would race _churn_state
+            self._thread.join(
+                timeout=max(30.0, 2.0 * self._churn_sla_per_try + 10.0)
+            )
+            if self._thread.is_alive():
+                _log.warning(
+                    "nemesis thread did not exit before stop() healed; "
+                    "teardown may race a stuck churn event"
+                )
             self._thread = None
         self._heal_kinds(ALL_KINDS, restart=False)
 
@@ -666,3 +825,311 @@ class FaultController:
                 self._count("engine_escalations")
                 return True
         return False
+
+    # ------------------------------------------------------------------
+    # the churn plane (install_churn)
+    # ------------------------------------------------------------------
+    # churn_log actions that INITIATE an executed event — skips, errors,
+    # unresolved/leak notes and heal halves (restart, member_remove)
+    # must not inflate churn_events_total: one scheduled fault is one
+    # event, and a run where every event skipped must not look like one
+    # that churned
+    _CHURN_EXECUTED = frozenset(
+        ("kill", "transfer", "member_add", "balance")
+    )
+
+    def _churn_note(self, fault: Fault, action: str, detail: str) -> None:
+        with self._lock:
+            self.churn_log.append(
+                (self._churn_seq, fault.kind, action, detail)
+            )
+            self._churn_seq += 1
+        if self.metrics is not None and action in self._CHURN_EXECUTED:
+            self.metrics.counter(
+                "churn_events_total", {"kind": fault.kind}
+            ).add()
+
+    def _churn_live_hosts(self) -> Dict:
+        h = self._churn_hosts
+        if h is None:
+            return {}
+        d = h() if callable(h) else h
+        return {
+            k: nh for k, nh in d.items() if not getattr(nh, "_closed", False)
+        }
+
+    def _churn_pick_shard(self, fault: Fault) -> Optional[int]:
+        if fault.targets:
+            return fault.targets[0]
+        if not self._churn_shards:
+            return None
+        i = int(
+            self._draw("churn_shard", fault.kind, fault.at)
+            * len(self._churn_shards)
+        ) % len(self._churn_shards)
+        return self._churn_shards[i]
+
+    def _find_leader(self, shard_id: int):
+        """(host_key, nodehost, leader_replica_id) of the shard's
+        current leader, or None while leaderless/mid-restart."""
+        hosts = self._churn_live_hosts()
+        lid = 0
+        for nh in hosts.values():
+            try:
+                l, ok = nh.get_leader_id(shard_id)
+            except Exception:  # noqa: BLE001 — host may not hold the shard
+                continue
+            if ok and l:
+                lid = l
+                break
+        if not lid:
+            return None
+        for key, nh in hosts.items():
+            node = nh._nodes.get(shard_id)
+            if node is not None and node.replica_id == lid:
+                return key, nh, lid
+        return None
+
+    def _churn_apply(self, fault: Fault) -> None:
+        if self._churn_hosts is None:
+            self._churn_note(fault, "skip", "churn plane not installed")
+            return
+        try:
+            if fault.kind == "leader_kill":
+                self._churn_leader_kill(fault)
+            elif fault.kind == "leader_transfer":
+                self._churn_leader_transfer(fault)
+            elif fault.kind == "member_cycle":
+                self._churn_member_add(fault)
+            elif fault.kind == "balance_move":
+                self._churn_balance_move(fault)
+        except Exception as e:  # noqa: BLE001 — the schedule must go on
+            _log.warning("churn %s failed: %r", fault.kind, e)
+            self._churn_note(fault, "error", repr(e))
+
+    def _churn_heal(self, fault: Fault) -> None:
+        try:
+            if fault.kind == "leader_kill":
+                v = self._churn_state.pop(id(fault), None)
+                if v is not None:
+                    shard_id, key = v
+                    fn = self._churn_restart_fn
+                    if fn is not None:
+                        fn(key, shard_id)
+                    elif self._restart_fn is not None:
+                        self._restart_fn(key)
+                    self._churn_note(
+                        fault, "restart", f"shard={shard_id} host={key}"
+                    )
+                    self._churn_sla(shard_id)
+            elif fault.kind == "member_cycle":
+                v = self._churn_state.pop(id(fault), None)
+                if v is not None:
+                    self._churn_member_remove(fault, *v)
+            elif fault.kind == "balance_move":
+                t = self._churn_state.pop(id(fault), None)
+                if t is not None:
+                    t.join(timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            _log.warning("churn heal %s failed: %r", fault.kind, e)
+            self._churn_note(fault, "error", repr(e))
+
+    def _churn_leader_kill(self, fault: Fault) -> None:
+        shard_id = self._churn_pick_shard(fault)
+        found = shard_id and self._find_leader(shard_id)
+        if not found:
+            self._churn_note(
+                fault, "skip", f"no leader found (shard={shard_id})"
+            )
+            return
+        key, _nh, lid = found
+        self._churn_state[id(fault)] = (shard_id, key)
+        fn = self._churn_kill_fn
+        if fn is not None:
+            fn(key, shard_id)
+        elif self._crash_fn is not None:
+            self._crash_fn(key)
+        else:
+            self._churn_state.pop(id(fault), None)
+            self._churn_note(fault, "skip", "no kill handler installed")
+            return
+        self._count("churn_leader_kills")
+        self._churn_note(
+            fault, "kill", f"shard={shard_id} host={key} leader={lid}"
+        )
+
+    def _churn_leader_transfer(self, fault: Fault) -> None:
+        shard_id = self._churn_pick_shard(fault)
+        found = shard_id and self._find_leader(shard_id)
+        if not found:
+            self._churn_note(
+                fault, "skip", f"no leader found (shard={shard_id})"
+            )
+            return
+        key, nh, lid = found
+        node = nh._nodes.get(shard_id)
+        if node is None:
+            self._churn_note(fault, "skip", "leader node vanished")
+            return
+        voters = sorted(
+            r for r in node.get_membership().addresses if r != lid
+        )
+        if not voters:
+            self._churn_note(fault, "skip", "no transfer candidate")
+            return
+        target = voters[
+            int(self._draw("churn_transfer", shard_id, lid) * len(voters))
+            % len(voters)
+        ]
+        nh.request_leader_transfer(shard_id, target)
+        self._count("churn_leader_transfers")
+        self._churn_note(
+            fault, "transfer", f"shard={shard_id} {lid} -> {target}"
+        )
+        self._churn_sla(shard_id)
+
+    def _churn_member_add(self, fault: Fault) -> None:
+        shard_id = self._churn_pick_shard(fault)
+        hosts = self._churn_live_hosts()
+        if not shard_id or not hosts:
+            self._churn_note(fault, "skip", "no shard/hosts")
+            return
+        keys = sorted(hosts, key=str)
+        addr_key = keys[
+            int(self._draw("churn_member", shard_id, fault.at) * len(keys))
+            % len(keys)
+        ]
+        addr = hosts[addr_key].raft_address()
+        with self._lock:
+            self._churn_member_seq += 1
+            rid = 70_000 + self._churn_member_seq
+        api = self._churn_api_host(shard_id)
+        if api is None:
+            self._churn_note(fault, "skip", "no live host holds the shard")
+            return
+        from .client import call_with_retry
+
+        # record the victim BEFORE the RPC: an add whose ack times out
+        # may still have committed, and the heal must try the remove
+        # either way (removing a never-committed member just rejects,
+        # which the remove path counts as member_leak noise — better
+        # than a phantom non-voting member replicated-to forever)
+        self._churn_state[id(fault)] = (shard_id, rid)
+        # the new member is never started: a transiently-unreachable
+        # NON-VOTING add (quorum untouched) the heal removes again —
+        # the membership entries themselves are the churn
+        try:
+            call_with_retry(
+                lambda: api.sync_request_add_non_voting(
+                    shard_id, rid, addr, timeout=1.0
+                ),
+                timeout=8.0,
+            )
+        except Exception as e:  # noqa: BLE001 — maybe-committed add
+            self._count("churn_member_add_unresolved")
+            self._churn_note(
+                fault, "member_add_unresolved",
+                f"shard={shard_id} rid={rid}: {e!r}",
+            )
+            return
+        self._count("churn_member_adds")
+        self._churn_note(
+            fault, "member_add", f"shard={shard_id} rid={rid} addr={addr}"
+        )
+
+    def _churn_member_remove(self, fault: Fault, shard_id: int, rid: int) -> None:
+        api = self._churn_api_host(shard_id)
+        if api is None:
+            self._count("churn_member_failures")
+            self._churn_note(
+                fault, "member_leak", f"shard={shard_id} rid={rid}"
+            )
+            return
+        from .client import call_with_retry
+
+        try:
+            call_with_retry(
+                lambda: api.sync_request_delete_replica(
+                    shard_id, rid, timeout=1.0
+                ),
+                timeout=8.0,
+            )
+            self._count("churn_member_removes")
+            self._churn_note(
+                fault, "member_remove", f"shard={shard_id} rid={rid}"
+            )
+        except Exception as e:  # noqa: BLE001 — a leftover non-voting
+            # member is harmless to quorum; count it loudly instead of
+            # failing the schedule
+            self._count("churn_member_failures")
+            self._churn_note(
+                fault, "member_leak", f"shard={shard_id} rid={rid}: {e!r}"
+            )
+        self._churn_sla(shard_id)
+
+    def _churn_api_host(self, shard_id: int):
+        """A live host holding the shard (prefer the leader's)."""
+        found = self._find_leader(shard_id)
+        if found:
+            return found[1]
+        for nh in self._churn_live_hosts().values():
+            if nh._nodes.get(shard_id) is not None:
+                return nh
+        return None
+
+    def _churn_balance_move(self, fault: Fault) -> None:
+        b = self._churn_balancer
+        if b is None:
+            self._churn_note(fault, "skip", "no balancer installed")
+            return
+
+        def run():
+            try:
+                report = b.rebalance_once(max_moves=1)
+                self._count("churn_balance_moves")
+                self._churn_note(fault, "balance", repr(report))
+            except Exception as e:  # noqa: BLE001 — nemesis may abort it
+                self._churn_note(fault, "balance_abort", repr(e))
+
+        t = threading.Thread(
+            target=run, daemon=True, name="tpu-raft-churn-balance"
+        )
+        self._churn_state[id(fault)] = t
+        t.start()
+
+    def _churn_sla(self, shard_id: int) -> None:
+        """Per-event recovery-SLA assert: full re-election within the
+        tick bound + commit continuity (when a probe cmd is armed).
+        Runs on the nemesis thread — the next scheduled fault fires
+        after the cluster has either recovered or violated."""
+        if not self._churn_sla_ticks:
+            return
+        hosts = {
+            k: nh
+            for k, nh in self._churn_live_hosts().items()
+            if nh._nodes.get(shard_id) is not None
+        }
+        if not hosts:
+            self.churn_violations.append(
+                f"shard {shard_id}: no live replica after churn event"
+            )
+            return
+        cmd = self._churn_sla_cmd
+        if callable(cmd):
+            cmd = cmd()
+        try:
+            assert_recovery_sla(
+                hosts, shard_id, sla_ticks=self._churn_sla_ticks, cmd=cmd,
+                per_try_timeout=self._churn_sla_per_try,
+                should_abort=self._stop.is_set,
+            )
+            self._count("churn_sla_ok")
+        except RecoverySLAAborted:
+            # teardown raced the check: no verdict, and the nemesis
+            # thread exits promptly instead of outliving stop()'s join
+            self._count("churn_sla_aborted")
+        except RecoverySLAViolation as e:
+            self._count("churn_sla_violations")
+            if self.metrics is not None:
+                self.metrics.counter("churn_sla_violations_total").add()
+            self.churn_violations.append(f"shard {shard_id}: {e}")

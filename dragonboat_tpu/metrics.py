@@ -78,6 +78,24 @@ class Histogram:
                 return
         self.buckets[-1] += 1
 
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (0 < q <= 1) from the bucket counts:
+        the upper bound of the bucket containing the rank.  Overflow
+        (+Inf) observations clamp to the last finite bound — callers
+        deriving budgets from e.g. ``percentile(0.99)`` should size
+        ``bounds`` to their latency regime (a recorded histogram's p99
+        makes a ``client.LatencyBudget`` bootstrap when no raw samples
+        are at hand)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        acc = 0
+        for i, b in enumerate(self.bounds):
+            acc += self.buckets[i]
+            if acc >= rank:
+                return b
+        return self.bounds[-1]
+
 
 class _Noop:
     def add(self, n: int = 1) -> None: ...

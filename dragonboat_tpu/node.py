@@ -380,6 +380,14 @@ class Node:
             self.proposal_count += 1
             self._proposals.append(entry)
         self._wake()
+        # stop() sets `stopped` BEFORE its drop_all sweep, so a future
+        # allocated after the sweep always observes the flag here; one
+        # allocated before it was swept already (seal pops-once, so the
+        # overlap is benign).  Without this re-check a propose racing
+        # stop_shard leaks a table entry no step loop or tick GC will
+        # ever complete.
+        if self.stopped:
+            self.pending_proposal.seal(rs)
         return rs
 
     def propose_session_op(self, session: Session, timeout_ticks: int) -> RequestState:
@@ -389,6 +397,8 @@ class Node:
         with self._qlock:
             self._proposals.append(entry)
         self._wake()
+        if self.stopped:
+            self.pending_proposal.seal(rs)
         return rs
 
     def read_index(self, timeout_ticks: int) -> RequestState:
@@ -396,6 +406,8 @@ class Node:
         with self._qlock:
             self._read_indexes.append(ctx)
         self._wake()
+        if self.stopped:
+            self.pending_read_index.seal(rs)
         return rs
 
     def request_config_change(
@@ -407,6 +419,8 @@ class Node:
         with self._qlock:
             self._config_changes.append((key, cc))
         self._wake()
+        if self.stopped:
+            self.pending_config_change.seal(rs)
         return rs
 
     def request_snapshot(self, overhead: int, timeout_ticks: int) -> RequestState:
@@ -414,6 +428,8 @@ class Node:
         with self._qlock:
             self._snapshot_reqs.append((rs.key, overhead))
         self._wake()
+        if self.stopped:
+            self.pending_snapshot.seal(rs)
         return rs
 
     def request_leader_transfer(self, target: int, timeout_ticks: int) -> RequestState:
@@ -423,6 +439,8 @@ class Node:
         with self._qlock:
             self._leader_transfers.append(target)
         self._wake()
+        if self.stopped:
+            self.pending_leader_transfer.seal(rs)
         return rs
 
     def enqueue_received(self, m: Message) -> None:
@@ -700,6 +718,24 @@ class Node:
         if self.peer.is_leader():
             self.peer.raft.handle(Message(type=MessageType.LEADER_HEARTBEAT))
 
+    def broadcast_wake(self) -> None:
+        """Host-path quiesce-exit poke to every peer.  LEADER_HEARTBEAT
+        is 'activity' to the quiesce manager and a no-op to follower
+        raft, and mere DELIVERY unparks the peer's host node
+        (enqueue_received -> wake), so its election clock runs again —
+        the transport leg is what matters, not the payload."""
+        for pid in sorted(self.peer.raft.addresses):
+            if pid == self.replica_id:
+                continue
+            self.transport.send(
+                Message(
+                    type=MessageType.LEADER_HEARTBEAT,
+                    to=pid,
+                    from_=self.replica_id,
+                    shard_id=self.shard_id,
+                )
+            )
+
     def broadcast_quiesce_enter(self) -> None:
         """Announce entering quiesce so peers join promptly (reference:
         pb.Quiesce [U]) — staggered entry would leave the leader
@@ -722,6 +758,19 @@ class Node:
             self.leader_id = lid
             if lid != 0:
                 self.pending_leader_transfer.notify_leader(lid)
+            elif self.quiesce.enabled and (
+                self.quiesce.quiesced or self.quiesce.exit_grace > 0
+            ):
+                # the shard went LEADERLESS while (or right after)
+                # being quiesced — the dead-leader-of-an-idle-shard
+                # case.  Peer replicas may still be tick-PARKED on
+                # their hosts with a stale leader view: parked clocks
+                # never fire election timeouts, and device-routed
+                # pre-votes alone do not unpark them, so without a
+                # host-path poke the shard stays leaderless forever
+                # (churn-audit finding: a quiesced 500-shard cluster
+                # never re-elected after a leader kill).
+                self.broadcast_wake()
             if self.on_leader_updated is not None:
                 self.on_leader_updated(
                     self.shard_id, self.replica_id, self.peer.term(), lid

@@ -692,6 +692,22 @@ class NodeHost:
         return lid, lid != 0
 
     # -- info -------------------------------------------------------------
+    def pending_request_counts(self, shard_id: int) -> Dict[str, int]:
+        """Outstanding request futures per table for one LIVE shard
+        (the audit harness' leak probe; raises ShardNotFound once the
+        shard is stopped — to assert a stopped node's tables drained to
+        zero, hold the Node reference across ``stop_shard`` and len()
+        its tables directly, as tests/test_scale.py's churn phase
+        does)."""
+        node = self._get_node(shard_id)
+        return {
+            "proposal": len(node.pending_proposal),
+            "read_index": len(node.pending_read_index),
+            "config_change": len(node.pending_config_change),
+            "snapshot": len(node.pending_snapshot),
+            "leader_transfer": len(node.pending_leader_transfer),
+        }
+
     def write_health_metrics(self, writer) -> None:
         """Prometheus-text metric export (reference:
         NodeHost.WriteHealthMetrics [U]); enable via

@@ -130,9 +130,31 @@ class _PendingBase:
 
     def drop_all(self, code: RequestResultCode = RequestResultCode.TERMINATED):
         with self._lock:
+            keys = set(self._pending)
             for rs in self._pending.values():
                 rs.notify(code)
             self._pending.clear()
+            if keys:
+                self._gc_extra(keys)
+
+    def seal(self, rs: RequestState) -> None:
+        """Terminate a just-allocated future whose node stopped
+        concurrently.  ``Node.stop()`` runs ``drop_all`` right after
+        setting ``stopped``; a producer that allocated AFTER the sweep
+        would otherwise leave a future that no step loop will ever
+        complete and no tick will ever GC — a hung caller and a leaked
+        table entry (the history recorder counts on Terminated being
+        delivered).  Pop-once keeps the double-notify race with
+        drop_all benign.  ``_gc_extra`` runs UNCONDITIONALLY: a
+        read-index allocates its future and inserts its ctx-map entry
+        under two separate lock holds, so drop_all can sweep between
+        them — the swept key's late ctx insert must still be cleaned
+        here even though the future itself is already notified."""
+        with self._lock:
+            notified = self._pending.pop(rs.key, None) is not None
+            self._gc_extra({rs.key})
+        if notified:
+            rs.notify(RequestResultCode.TERMINATED)
 
     def __len__(self) -> int:
         with self._lock:

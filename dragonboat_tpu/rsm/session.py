@@ -46,6 +46,14 @@ class SessionManager:
     def __init__(self, max_sessions: Optional[int] = None):
         self._lru: "OrderedDict[int, Session]" = OrderedDict()
         self._max = max_sessions or settings.Hard.lru_max_session_count
+        # diagnostic counters (NOT serialized into snapshots — they are
+        # per-replica evidence for the audit harness, not state):
+        # dedupe_hits   = retried proposals answered from the cache
+        #                 instead of re-applying (exactly-once at work)
+        # responded_rejects = copies of an already-responded series
+        #                 rejected without applying
+        self.dedupe_hits = 0
+        self.responded_rejects = 0
 
     def register(self, client_id: int) -> Result:
         if client_id in self._lru:

@@ -243,10 +243,12 @@ class StateMachine:
             return ApplyResult(entry=e, result=Result(), rejected=True)
         s.clear_to(e.responded_to)
         if s.has_responded(e.series_id):
+            self.sessions.responded_rejects += 1
             self._advance(e)
             return ApplyResult(entry=e, result=Result(), rejected=True)
         cached, hit = s.get_response(e.series_id)
         if hit:
+            self.sessions.dedupe_hits += 1
             self._advance(e)
             return ApplyResult(entry=e, result=cached)
         return None

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Churn-nemesis + linearizability-audit soak: N seeded acceptance
+# rounds of tests/test_audit.py::test_audit_acceptance_256_shards
+# (256-shard cluster under leader kills + transfers + membership
+# churn + one Balancer move, checked per sampled shard).
+#
+#   scripts/audit_soak.sh [N] [BASE_SEED]
+#
+# N defaults to 5 (the acceptance bar), BASE_SEED to 1; round i runs
+# seed BASE_SEED+i-1.  Every round prints its seed first, so any
+# failure replays with:
+#
+#   DRAGONBOAT_TPU_AUDIT=1 DRAGONBOAT_TPU_SEED=<seed> \
+#     python -m pytest tests/test_audit.py -k acceptance -s
+#
+# Wired like the DRAGONBOAT_TPU_SOAK gate: the test is `slow`-marked
+# and skipped unless DRAGONBOAT_TPU_AUDIT=1, so tier-1 never pays for
+# it.  Shard count can be overridden via DRAGONBOAT_TPU_AUDIT_SHARDS.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+N=${1:-5}
+BASE=${2:-1}
+for i in $(seq 1 "$N"); do
+  seed=$((BASE + i - 1))
+  echo "=== audit round $i/$N seed=$seed ==="
+  if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
+      DRAGONBOAT_TPU_AUDIT=1 DRAGONBOAT_TPU_SEED=$seed \
+      python -m pytest tests/test_audit.py -q -s -k acceptance \
+      -p no:cacheprovider; then
+    echo "AUDIT SOAK FAILED at seed=$seed (replay: DRAGONBOAT_TPU_AUDIT=1 DRAGONBOAT_TPU_SEED=$seed)"
+    exit 1
+  fi
+done
+echo "AUDIT SOAK OK: $N rounds, seeds $BASE..$((BASE + N - 1))"

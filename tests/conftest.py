@@ -1,8 +1,11 @@
 """Test configuration.
 
-JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding is
-exercised without TPU hardware (SURVEY.md environment notes); the real-TPU
-bench path is bench.py.
+JAX tests run on the CPU backend with XLA forced to expose 8 host
+devices, so mesh-capable code paths CAN build a multi-device mesh —
+but the suite itself exercises device 0 only (no test constructs a
+Mesh or shards across devices; VERDICT r5 weak #4).  Multi-chip mesh
+placement is covered by the driver's `__graft_entry__.py` dryrun tiers
+and the real-TPU bench path in bench.py, not by pytest.
 """
 import os
 import sys

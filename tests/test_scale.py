@@ -39,9 +39,13 @@ from dragonboat_tpu import (
     EngineConfig,
     ExpertConfig,
     IOnDiskStateMachine,
+    LatencyBudget,
     NodeHost,
     NodeHostConfig,
+    RecoverySLAViolation,
     Result,
+    assert_recovery_sla,
+    propose_with_retry,
 )
 from dragonboat_tpu.ops.colocated import ColocatedEngineGroup
 from dragonboat_tpu.ops.engine import vector_step_engine_factory
@@ -133,8 +137,17 @@ def _pow2_at_least(n: int) -> int:
     return b
 
 
+def shard_churn_config(rid: int, shard: int) -> Config:
+    """The one Config both the start loop and churn restarts use."""
+    return Config(replica_id=rid, shard_id=shard,
+                  election_rtt=20, heartbeat_rtt=2,
+                  pre_vote=True, check_quorum=True,
+                  quiesce=True, snapshot_entries=0)
+
+
 def run_scale(shards: int, artifact_path: str = "",
-              engine: str = ENGINE, proposals: int = 100) -> dict:
+              engine: str = ENGINE, proposals: int = 100,
+              churn_kills: int = 0, rtt_ms: int = 50) -> dict:
     rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     total_rows = sum(len(shard_members(s)) for s in range(1, shards + 1))
     P_eng = max(MIXED_SIZES) if MIXED else REPLICAS
@@ -186,7 +199,8 @@ def run_scale(shards: int, artifact_path: str = "",
                 # slow logical clock: at 10k+ nodes the per-tick Python
                 # fan-out is the bottleneck, and the engine's deferred-
                 # tick backpressure keeps elections stable anyway
-                rtt_millisecond=50,
+                # (small churn variants pass a faster clock)
+                rtt_millisecond=rtt_ms,
                 raft_address=addr,
                 expert=ExpertConfig(
                     engine=EngineConfig(exec_shards=1, apply_shards=4),
@@ -214,10 +228,7 @@ def run_scale(shards: int, artifact_path: str = "",
             for rid in members:
                 nhs[rid].start_replica(
                     members, False, LazyDiskKV,
-                    Config(replica_id=rid, shard_id=shard,
-                           election_rtt=20, heartbeat_rtt=2,
-                           pre_vote=True, check_quorum=True,
-                           quiesce=True, snapshot_entries=0),
+                    shard_churn_config(rid, shard),
                 )
             if shard % 500 == 0:
                 print(f"started {shard}/{shards} shards "
@@ -274,23 +285,36 @@ def run_scale(shards: int, artifact_path: str = "",
 
         # commit latency at scale is ~2 launch GENERATIONS, and a
         # generation is minutes of host Python at 250k rows on a
-        # single core — fixed 90 s/240 s budgets expired mid-flight on
-        # every attempt of the 50k run while the commits were landing
-        # (the shards were all led and advancing).  Scale the budgets
-        # with the shard count instead of racing the wall clock.
-        p_timeout = min(300.0, max(90.0, shards * 0.005))
-        p_deadline = max(240.0, shards * 0.03)
+        # single core.  The budgets are LATENCY-AWARE, not hand-tuned
+        # per scale (VERDICT weak #8): the election phase just measured
+        # this cluster's latency scale directly, so it bootstraps the
+        # p99 estimate, and every landed commit refines it — per-try
+        # and total deadlines then track 2x/8x the observed p99 plus
+        # the election window instead of racing a fixed wall clock.
+        elec_win = 20 * rtt_ms / 1000.0  # election_rtt ticks x rtt_ms
+        budget = LatencyBudget(
+            election_window=elec_win,
+            bootstrap=max(2.0, report["election_secs"] / 3.0),
+            floor=5.0, cap=300.0,
+        )
+
+        # one FROZEN outer limit shared by every proposer: the budget
+        # mutates as commits land, and a per-failure re-evaluated bound
+        # could outgrow any join timeout computed before the threads
+        # started (the bootstrap already scales with election_secs, so
+        # freezing here loses nothing)
+        outer_limit = 3 * budget.total_timeout()
 
         def propose_one(shard):
             members = shard_members(shard)
             nh = nhs[1 + (shard % len(members))]
             s = nh.get_noop_session(shard)
-            end = time.time() + p_deadline
+            start = time.time()
             while True:
                 try:
-                    nh.sync_propose(
-                        s, pickle.dumps((f"k{shard}", shard)),
-                        timeout=p_timeout,
+                    propose_with_retry(
+                        nh, s, pickle.dumps((f"k{shard}", shard)),
+                        budget=budget,
                     )
                     with ok_lock:
                         ok[0] += 1
@@ -298,7 +322,7 @@ def run_scale(shards: int, artifact_path: str = "",
                 except Exception as e:
                     with ok_lock:
                         errs[type(e).__name__] += 1
-                    if time.time() > end:
+                    if time.time() - start > outer_limit:
                         return
                     time.sleep(0.5)
 
@@ -309,14 +333,21 @@ def run_scale(shards: int, artifact_path: str = "",
         for t in threads:
             t.start()
         for t in threads:
-            # must exceed a thread's worst-case lifetime (deadline + one
-            # last in-flight sync_propose) so no proposer outlives the
-            # report read / NodeHost teardown
-            t.join(timeout=p_deadline + p_timeout + 30.0)
+            # must exceed a thread's worst-case lifetime (frozen outer
+            # limit + one last in-flight propose_with_retry, which can
+            # run a FULL retry budget of attempts x capped tries) so no
+            # proposer outlives the report read / NodeHost teardown
+            t.join(timeout=outer_limit
+                   + budget.attempts * budget.cap + 30.0)
         report["proposals_attempted"] = len(sample)
         report["proposals_committed"] = ok[0]
         report["propose_errors"] = dict(errs.most_common(5))
         report["propose_secs"] = round(time.time() - t0, 1)
+        report["latency_budget"] = {
+            "p99_secs": round(budget.p99(), 2),
+            "per_try_secs": round(budget.per_try_timeout(), 2),
+            "total_secs": round(budget.total_timeout(), 2),
+        }
         # elections keep progressing during the propose phase; record
         # the FINAL coverage too so a slow-start run isn't misread
         report["final_leader_coverage"] = sum(
@@ -324,6 +355,80 @@ def run_scale(shards: int, artifact_path: str = "",
             for shard in range(1, shards + 1)
             if nhs[1]._nodes[shard].peer.raft.log.committed >= 1
         )
+
+        # --- churn phase (BASELINE config 4: leader-election churn) ---
+        # kill K sampled shards' leader replicas mid-run (stop_shard on
+        # the leader's host), assert the survivors re-elect AND resume
+        # committing within a bounded number of ticks, check the
+        # stopped replica leaked no request futures, then restart it.
+        if churn_kills:
+            import random as _random
+
+            t0 = time.time()
+            churn = {"kills": 0, "cold_kills": 0, "reelected": 0,
+                     "leaked_futures": 0, "violations": []}
+            rngc = _random.Random(4242)
+            # clamp: a small SCALE_SHARDS run with the default
+            # SCALE_CHURN=5 must not crash random.sample
+            churn_kills = min(churn_kills, shards)
+            for shard in sorted(rngc.sample(range(1, shards + 1),
+                                            churn_kills)):
+                members = shard_members(shard)
+                # prefer the COLD kill: wait (bounded) for the victim
+                # shard to quiesce-park everywhere first — a leader
+                # dying while the shard sleeps is the case that strands
+                # parked peers without the leaderless wake poke
+                # (node.broadcast_wake); warm kills recover trivially
+                cold_deadline = time.time() + 30.0
+                while time.time() < cold_deadline:
+                    if all(shard in nhs[r]._parked for r in members):
+                        churn["cold_kills"] += 1
+                        break
+                    time.sleep(0.2)
+                lid = None
+                for rid in members:
+                    try:
+                        l, led = nhs[rid].get_leader_id(shard)
+                    except Exception:
+                        continue
+                    if led and l in members:
+                        lid = l
+                        break
+                if lid is None:
+                    churn["violations"].append(f"shard {shard}: no leader")
+                    continue
+                victim_nh = nhs[lid]
+                node = victim_nh._nodes[shard]
+                victim_nh.stop_shard(shard)
+                churn["kills"] += 1
+                churn["leaked_futures"] += sum(
+                    len(t) for t in (
+                        node.pending_proposal, node.pending_read_index,
+                        node.pending_config_change, node.pending_snapshot,
+                        node.pending_leader_transfer,
+                    )
+                )
+                survivors = {r: nhs[r] for r in members if r != lid}
+                try:
+                    # recovery SLA: full re-election + commit progress
+                    # within 3000 logical ticks of the kill; each try
+                    # must outlive the cluster's OBSERVED commit p99
+                    # (at this scale a commit spans launch generations)
+                    assert_recovery_sla(
+                        survivors, shard, sla_ticks=3000,
+                        cmd=pickle.dumps((f"churn-{shard}", shard)),
+                        rtt_ms=rtt_ms,
+                        per_try_timeout=max(2.0, budget.per_try_timeout()),
+                    )
+                    churn["reelected"] += 1
+                except RecoverySLAViolation as e:
+                    churn["violations"].append(f"shard {shard}: {e}")
+                victim_nh.start_replica(
+                    members, False, LazyDiskKV,
+                    shard_churn_config(lid, shard),
+                )
+            churn["churn_secs"] = round(time.time() - t0, 1)
+            report["churn"] = churn
 
         stats = {}
         if engine == "colocated":
@@ -362,11 +467,20 @@ def run_scale(shards: int, artifact_path: str = "",
     SHARDS <= 0, reason="big scale run is env-gated: set SCALE_SHARDS=N"
 )
 def test_scale_shards():
-    report = run_scale(SHARDS, os.environ.get("SCALE_ARTIFACT", ""))
+    """Env-gated big run; SCALE_CHURN (default 5) leader kills make it
+    BASELINE config 4's leader-election-churn shape, not just a boot +
+    propose benchmark (VERDICT item 3)."""
+    churn = min(int(os.environ.get("SCALE_CHURN", "5")), SHARDS)
+    report = run_scale(SHARDS, os.environ.get("SCALE_ARTIFACT", ""),
+                       churn_kills=churn)
     print(json.dumps(report, indent=1))
     assert report["leader_coverage"] >= SHARDS * 0.98, report
     assert report["proposals_committed"] >= report["proposals_attempted"] * 0.9, report
     assert report["engine_stats"]["device_rows_stepped"] > 0, report
+    if churn:
+        ch = report["churn"]
+        assert ch["reelected"] == ch["kills"] >= max(1, churn - 1), report
+        assert ch["leaked_futures"] == 0, report
 
 
 def test_scale_small_always_on():
@@ -374,12 +488,39 @@ def test_scale_small_always_on():
     rows) through the colocated engine must elect everywhere and commit
     sampled client proposals — so the default suite carries a real scale
     signal instead of an env-gated artifact (r03 review finding).  The
-    geometry is the 10k artifact's exactly, scaled to suite runtime."""
+    geometry is the 10k artifact's exactly, scaled to suite runtime.
+    Churn stays OUT of this test: at 500 shards one cold leader kill
+    costs ~75s of launch-generation wall clock, and tier-1 must stay
+    inside its 870s budget — the default-suite churn signal lives in
+    test_scale_churn_small (fast clock, small geometry) and the full-
+    scale churn phase in the env-gated run below."""
     report = run_scale(500, "", engine="colocated", proposals=20)
     print(json.dumps(report, indent=1))
     assert report["final_leader_coverage"] >= 490, report
     assert report["proposals_committed"] >= report["proposals_attempted"] * 0.9, report
     assert report["engine_stats"]["device_rows_stepped"] > 0, report
+
+
+def test_scale_churn_small():
+    """The default-suite churn variant (VERDICT item 3 / BASELINE
+    config 4's leader-election churn): 64 shards x 5 replicas on the
+    colocated engine, one COLD leader kill — the victim shard is fully
+    quiesce-parked first, reproducing the leader-death-while-asleep
+    case whose re-election used to hang forever (parked peers' election
+    clocks are frozen and device-routed pre-votes don't unpark them;
+    fixed by Node.broadcast_wake).  Asserts the recovery SLA —
+    committed traffic resumes within a bounded number of ticks of the
+    kill — and zero pending-future leaks on the stopped replica.  Fast
+    logical clock keeps the whole test well under a minute."""
+    report = run_scale(64, "", engine="colocated", proposals=5,
+                       churn_kills=1, rtt_ms=10)
+    print(json.dumps(report, indent=1))
+    assert report["final_leader_coverage"] >= 63, report
+    ch = report["churn"]
+    assert ch["kills"] == 1 and ch["reelected"] == 1, report
+    assert ch["cold_kills"] == 1, report
+    assert ch["violations"] == [], report
+    assert ch["leaked_futures"] == 0, report
 
 
 if __name__ == "__main__":
