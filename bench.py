@@ -581,6 +581,125 @@ def _bench_sm_cls():
     return _BenchSM
 
 
+def phase_obs(
+    proposals: int = 400,
+    *,
+    rtt_ms: int = 2,
+    warmup: int = 50,
+) -> dict:
+    """Observability bench guard (obs tentpole, docs/OBSERVABILITY.md):
+    p50 proposal latency through the public NodeHost API on a 3-replica
+    in-proc shard, measured with ``enable_tracing=False`` (the default
+    — its hot-path cost is one attribute load) and again with tracing +
+    flight recorder fully on at sample rate 1.0.  The "off" number is
+    what the <2%-vs-seed acceptance gate compares; the on/off ratio
+    bounds the worst-case cost of turning the layer on.  Pure host path
+    — no device, no jax."""
+    import shutil
+
+    from dragonboat_tpu import (
+        Config,
+        EngineConfig,
+        ExpertConfig,
+        NodeHost,
+        NodeHostConfig,
+        RequestDropped,
+        TimeoutError_,
+    )
+    from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+    sm_cls = _bench_sm_cls()
+
+    def measure(tracing: bool) -> float:
+        reset_inproc_network()
+        tag = "on" if tracing else "off"
+        addrs = {r: f"bench-obs-{tag}-{r}" for r in (1, 2, 3)}
+        nhs = {}
+        for r, addr in addrs.items():
+            d = f"/tmp/nh-bench-obs-{tag}-{r}"
+            shutil.rmtree(d, ignore_errors=True)
+            nhs[r] = NodeHost(NodeHostConfig(
+                nodehost_dir=d,
+                rtt_millisecond=rtt_ms,
+                raft_address=addr,
+                enable_tracing=tracing,
+                enable_flight_recorder=tracing,
+                expert=ExpertConfig(
+                    engine=EngineConfig(exec_shards=2, apply_shards=2),
+                ),
+            ))
+        try:
+            for r, nh in nhs.items():
+                nh.start_replica(
+                    addrs, False, sm_cls,
+                    Config(shard_id=1, replica_id=r,
+                           election_rtt=10, heartbeat_rtt=1),
+                )
+            deadline = time.monotonic() + 30.0
+            leader = None
+            while time.monotonic() < deadline and leader is None:
+                lid, ok = nhs[1].get_leader_id(1)
+                if ok:
+                    leader = nhs[lid]
+                else:
+                    time.sleep(0.02)
+            if leader is None:
+                return -1.0
+            s = leader.get_noop_session(1)
+            lat = []
+            for i in range(warmup + proposals):
+                t0 = time.perf_counter()
+                # a freshly-elected leader drops proposals in its
+                # pre-noop-commit window, and a load spike can trigger
+                # re-election mid-run (timeout against the old leader):
+                # re-resolve the leader and retry, like a real client
+                # would — the retry wait lands in the sample, honestly
+                # fattening the tail
+                for attempt in range(4):
+                    try:
+                        leader.sync_propose(s, b"x" * 32, timeout=5.0)
+                        break
+                    except (RequestDropped, TimeoutError_) as e:
+                        if attempt == 3:
+                            e.args = (
+                                f"{e.args[0] if e.args else e} "
+                                f"(tracing={tracing} i={i})",
+                            )
+                            raise
+                        time.sleep(0.05)
+                        lid, ok = nhs[1].get_leader_id(1)
+                        if ok and lid in nhs and nhs[lid] is not leader:
+                            leader = nhs[lid]
+                            s = leader.get_noop_session(1)
+                if i >= warmup:
+                    lat.append(time.perf_counter() - t0)
+            lat.sort()
+            return lat[len(lat) // 2] * 1000.0
+        finally:
+            for nh in nhs.values():
+                try:
+                    nh.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+
+    p50_off = measure(False)
+    p50_on = measure(True)
+    if p50_off < 0 or p50_on < 0:
+        # the no-leader sentinel must not masquerade as a (negative,
+        # absurdly good) latency to the acceptance gate
+        return {
+            "proposals": proposals,
+            "error": "no leader within 30s "
+                     f"(off={p50_off >= 0} on={p50_on >= 0})",
+        }
+    return {
+        "proposals": proposals,
+        "p50_off_ms": round(p50_off, 4),
+        "p50_on_ms": round(p50_on, 4),
+        "tracing_overhead_pct": round((p50_on / p50_off - 1.0) * 100.0, 1),
+    }
+
+
 def phase_balance(
     shards: int = 16,
     hosts: int = 4,
@@ -728,7 +847,7 @@ def main() -> None:
     # own.  Whatever the driver's cutoff, the last line standing is a
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
-             balance=None) -> None:
+             balance=None, obs=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -751,6 +870,10 @@ def main() -> None:
                     # r06 schema addition: balance control-plane
                     # convergence (host-only; see phase_balance)
                     "balance": balance,
+                    # r07 schema addition: observability bench guard —
+                    # p50 proposal latency tracing-off (the default
+                    # path the <2%-vs-seed gate reads) vs fully on
+                    "obs": obs,
                 }
             ),
             flush=True,
@@ -882,6 +1005,21 @@ def main() -> None:
             balance = {"error": bal_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance)
 
+    # Observability bench guard (host path only — cheap, no device
+    # risk): p50 proposal latency with tracing off vs fully on
+    obs = None
+    if bool(int(os.environ.get("BENCH_OBS", "1"))) and remaining() > 60:
+        code = (
+            "import json, bench;"
+            "print('BENCHOBS ' + json.dumps(bench.phase_obs()))"
+        )
+        obs, obs_err = run_sub(
+            code, "BENCHOBS", max(60, min(240, int(remaining() - 30)))
+        )
+        if obs is None:
+            obs = {"error": obs_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -898,7 +1036,8 @@ def main() -> None:
         if val is not None:
             ticks_per_sec = float(val)
             a_groups = fallback
-            emit(ticks_per_sec, a_groups, device_loop, consensus, balance)
+            emit(ticks_per_sec, a_groups, device_loop, consensus, balance,
+                 obs)
 
     if profile_dir and remaining() > 60:
         # profiling runs a small phase A in-process with the tracer on;

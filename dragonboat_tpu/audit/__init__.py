@@ -27,9 +27,11 @@ membership churn / balancer moves) is the ``churn`` plane of
 docs/FAULTS.md.
 """
 from .checker import (
+    AuditGateError,
     AuditReport,
     CheckResult,
     Violation,
+    assert_audit_ok,
     check_linearizable,
     check_sessions,
     check_stale_reads,
@@ -40,8 +42,10 @@ from .model import AuditKV, audit_set_cmd, collect_journals, settle_journals
 
 __all__ = [
     "AuditClient",
+    "AuditGateError",
     "AuditKV",
     "AuditReport",
+    "assert_audit_ok",
     "CheckResult",
     "HistoryRecorder",
     "Op",

@@ -42,7 +42,10 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..logger import get_logger
 from .history import Op
+
+_log = get_logger("audit")
 
 DEFAULT_BOUND = 200_000
 
@@ -349,3 +352,32 @@ def run_audit(
         stale=check_stale_reads(ops),
         sessions=None if journals is None else check_sessions(ops, journals),
     )
+
+
+class AuditGateError(AssertionError):
+    """The audit gate failed.  ``timeline`` carries the merged
+    flight-recorder/trace timeline of the audited hosts at failure
+    time when any of them has observability enabled (obs/,
+    docs/OBSERVABILITY.md) — the incident evidence is captured the
+    moment the gate trips, not reconstructed afterwards."""
+
+    timeline: str = ""
+
+
+def assert_audit_ok(report: AuditReport, hosts=(), label: str = "audit"):
+    """The audit gate with flight-recorder auto-dump: raise
+    :class:`AuditGateError` unless ``report.ok``.  ``hosts`` is the
+    audited cluster ({key: NodeHost} dict or iterable of NodeHosts);
+    hosts with ``enable_flight_recorder``/``enable_tracing`` contribute
+    their rings to the dump attached as ``exc.timeline`` (also logged,
+    tail-truncated)."""
+    if report.ok:
+        return
+    exc = AuditGateError(f"{label} gate failed:\n{report.describe()}")
+    try:
+        from ..obs import attach_timeline
+    except Exception:  # noqa: BLE001 — the dump must not mask the verdict
+        raise exc from None
+    raise attach_timeline(
+        exc, hosts, label=f"{label} gate failed", log=_log
+    ) from None

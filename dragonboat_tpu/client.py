@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from .obs.trace import UNSAMPLED
+
 NOOP_SERIES_ID = 0
 SERIES_ID_REGISTER = 0xFFFFFFFFFFFFFFFD
 SERIES_ID_UNREGISTER = 0xFFFFFFFFFFFFFFFC
@@ -245,22 +247,60 @@ def propose_with_retry(
     if deadline is None:
         deadline = _time.monotonic() + timeout
 
+    # obs/: one CLIENT root span over the whole retry loop — each
+    # attempt's nodehost "propose" span parents under it, so a trace of
+    # a shaken-cluster proposal shows every failed try AND the one that
+    # committed.  None when tracing is off/unsampled (one attribute
+    # load + a falsy test per call).
+    tracer = getattr(nodehost, "tracer", None)
+    root = (
+        tracer.start_trace("client:propose_with_retry",
+                           shard_id=session.shard_id)
+        if tracer is not None
+        else None
+    )
+
     last_try_at = [0.0]
+    tries = [0]
 
     def attempt():
         remaining = max(deadline - _time.monotonic(), 0.001)
         last_try_at[0] = _time.monotonic()
+        tries[0] += 1
+        if tracer is None:
+            # no parent kwarg on the untraced path: hosts only need to
+            # accept it when they themselves handed out a tracer
+            return nodehost.sync_propose(
+                session, cmd, timeout=min(per_try_timeout, remaining)
+            )
+        if root is None:
+            # the root's sampling draw said NO — tell the nodehost so
+            # it doesn't make a second independent draw per attempt
+            # (sampled once, at the root)
+            return nodehost.sync_propose(
+                session, cmd, timeout=min(per_try_timeout, remaining),
+                parent=UNSAMPLED,
+            )
+        root.annotate(f"client:attempt={tries[0]}")
         return nodehost.sync_propose(
-            session, cmd, timeout=min(per_try_timeout, remaining)
+            session, cmd, timeout=min(per_try_timeout, remaining),
+            parent=root,
         )
 
-    result = call_with_retry(
-        attempt,
-        deadline=deadline,
-        base_backoff=base_backoff,
-        max_backoff=max_backoff,
-        rng=rng,
-    )
+    try:
+        result = call_with_retry(
+            attempt,
+            deadline=deadline,
+            base_backoff=base_backoff,
+            max_backoff=max_backoff,
+            rng=rng,
+        )
+    except BaseException as e:
+        if root is not None:
+            root.end(status=type(e).__name__)
+        raise
+    if root is not None:
+        root.end()
     if budget is not None:
         # feed the SUCCESSFUL attempt's latency, not the whole retry
         # loop's: backoff sleeps and failed tries in the sample would

@@ -154,6 +154,13 @@ class NodeHostConfig:
     max_snapshot_recv_bytes_per_second: int = 0
     notify_commit: bool = False
     enable_metrics: bool = False
+    # observability (dragonboat_tpu.obs, docs/OBSERVABILITY.md): both
+    # off by default; the disabled hot paths cost one attribute load.
+    # ``trace_sample_rate`` bounds per-request tracing cost at high
+    # rates (the sampling decision is made once, at the root span).
+    enable_tracing: bool = False
+    trace_sample_rate: float = 1.0
+    enable_flight_recorder: bool = False
     tick_sweep_batch: int = 0  # 0 = TICK_SWEEP_BATCH env var, else 1
     gossip: GossipConfig = field(default_factory=GossipConfig)
     expert: ExpertConfig = field(default_factory=ExpertConfig)
@@ -167,6 +174,8 @@ class NodeHostConfig:
             raise ConfigError("rtt_millisecond must be > 0")
         if self.tick_sweep_batch < 0:
             raise ConfigError("tick_sweep_batch must be >= 0")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ConfigError("trace_sample_rate must be in [0, 1]")
         if not self.raft_address:
             raise ConfigError("raft_address not set")
         if self.address_by_nodehost_id and self.gossip.is_empty():

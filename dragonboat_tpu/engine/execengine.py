@@ -137,6 +137,15 @@ class ExecEngine:
         self._step_iters = self.metrics.counter(
             "raft_engine_step_iterations_total"
         )
+        # obs tentpole: the step-batch-size distribution is THE signal
+        # separating "many idle wakeups" from "healthy batching" (the
+        # single-fsync-per-iteration trick only pays when batches > 1);
+        # bucket bounds are shard counts, not seconds
+        self._step_batch_hist = self.metrics.histogram(
+            "raft_engine_step_batch_size",
+            bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._apply_hist = self.metrics.histogram("raft_engine_apply_seconds")
         self.step_ready = WorkReady(step_workers)
         self.apply_ready = WorkReady(apply_workers)
         self.step_engine = step_engine or HostStepEngine(logdb)
@@ -223,6 +232,7 @@ class ExecEngine:
                 t0 = time.perf_counter()
                 self.step_engine.step_shards(nodes, worker_id)
                 self._step_hist.observe(time.perf_counter() - t0)
+                self._step_batch_hist.observe(len(nodes))
                 self._step_iters.add()
             except Exception:  # noqa: BLE001
                 _log.exception("step worker %d failed", worker_id)
@@ -240,7 +250,9 @@ class ExecEngine:
                 nodes = [self._nodes[s] for s in ready if s in self._nodes]
             for node in nodes:
                 try:
+                    t0 = time.perf_counter()
                     node.apply()
+                    self._apply_hist.observe(time.perf_counter() - t0)
                 except Exception:  # noqa: BLE001
                     _log.exception(
                         "apply worker %d shard %d failed", worker_id, node.shard_id

@@ -2,13 +2,20 @@
 
 reference: event.go [U].  Listener callbacks run on a dedicated thread so
 a slow listener can never stall the step loop; the queue is bounded and
-drops (with a log line) under pressure, as the reference does.
+drops under pressure, as the reference does — every drop increments
+``event_fanout_dropped_total`` (the registry is passed in by NodeHost)
+and the warning names the callback that lost its event.
+
+``tap`` is the flight recorder's synchronous hook (obs/recorder.py): it
+sees every SYSTEM event at post time, including ones the bounded queue
+would drop — a recorder that misses state transitions under pressure
+would be useless exactly when it matters.
 """
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 from .logger import get_logger
 from .raftio import IRaftEventListener, ISystemEventListener, LeaderInfo
@@ -29,9 +36,17 @@ class EventFanout:
         raft_listener: Optional[IRaftEventListener] = None,
         system_listener: Optional[ISystemEventListener] = None,
         maxsize: int = 4096,
+        metrics=None,
+        tap: Optional[Callable] = None,
     ):
         self.raft_listener = raft_listener
         self.system_listener = system_listener
+        self.tap = tap
+        self._dropped = (
+            metrics.counter("event_fanout_dropped_total")
+            if metrics is not None
+            else None
+        )
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -41,12 +56,25 @@ class EventFanout:
 
     def close(self) -> None:
         self._stop.set()
-        self._q.put(None)
+        try:
+            # non-blocking: a full queue means the drain thread has
+            # items to chew through and will see _stop within one get
+            # timeout; a blocking put here deadlocks when the thread
+            # exits via the _stop check with the queue still full
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
         self._thread.join(timeout=1.0)
 
     def _main(self) -> None:
+        # the get must be timed: when close()'s sentinel is dropped by
+        # a full queue, an untimed get would block forever once the
+        # backlog drains and the thread would leak past join()
         while not self._stop.is_set():
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
             if item is None:
                 return
             fn, args = item
@@ -59,7 +87,13 @@ class EventFanout:
         try:
             self._q.put_nowait((fn, args))
         except queue.Full:
-            _log.warning("event queue full, dropping event")
+            if self._dropped is not None:
+                self._dropped.add()
+            _log.warning(
+                "event queue full, dropping event for %s",
+                getattr(fn, "__qualname__", None)
+                or getattr(fn, "__name__", repr(fn)),
+            )
 
     # -- raft events ------------------------------------------------------
     def leader_updated(self, info: LeaderInfo) -> None:
@@ -76,6 +110,13 @@ class EventFanout:
             raise AttributeError(name)
 
         def forward(*args):
+            tap = self.tap
+            if tap is not None:
+                try:
+                    tap(name, args)
+                except Exception:  # noqa: BLE001 — observability must
+                    # never break the event path
+                    _log.exception("event tap raised")
             if self.system_listener is not None:
                 target = getattr(self.system_listener, name, None)
                 if target is not None:

@@ -275,7 +275,27 @@ class _BoundFS:
 
 class RecoverySLAViolation(AssertionError):
     """The cluster failed to re-converge within the tick bound after
-    the fault plan healed."""
+    the fault plan healed.  When any host in the checked cluster has a
+    flight recorder/tracer, ``timeline`` carries the merged cross-host
+    timeline captured at violation time (also logged) — the
+    post-incident view, taken automatically (obs/, docs/OBSERVABILITY.md)."""
+
+    timeline: str = ""
+
+
+def _sla_violation(hosts, shard_id: int, msg: str) -> RecoverySLAViolation:
+    """Build the violation with the merged flight-recorder/trace
+    timeline auto-dumped into it (obs.attach_timeline; a dump failure
+    must never mask the violation itself)."""
+    exc = RecoverySLAViolation(msg)
+    try:
+        from .obs import attach_timeline
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        return exc
+    return attach_timeline(
+        exc, hosts, shard_id=shard_id,
+        label=f"recovery SLA violated for shard {shard_id}", log=_log,
+    )
 
 
 class RecoverySLAAborted(Exception):
@@ -334,9 +354,10 @@ def assert_recovery_sla(
                 break
         time.sleep(0.02)
     if leader is None:
-        raise RecoverySLAViolation(
+        raise _sla_violation(
+            hosts, shard_id,
             f"no full leader coverage for shard {shard_id} within "
-            f"{sla_ticks} ticks ({budget:.1f}s)"
+            f"{sla_ticks} ticks ({budget:.1f}s)",
         )
     if cmd is not None:
         from .client import propose_with_retry
@@ -365,9 +386,10 @@ def assert_recovery_sla(
                 # deadline; the verdict at the deadline is the same
                 # violation whether the error was transient or terminal
                 if time.monotonic() >= deadline:
-                    raise RecoverySLAViolation(
+                    raise _sla_violation(
+                        hosts, shard_id,
                         f"no commit progress on shard {shard_id} within "
-                        f"{sla_ticks} ticks ({budget:.1f}s): {e!r}"
+                        f"{sla_ticks} ticks ({budget:.1f}s): {e!r}",
                     ) from e
     return leader
 
@@ -427,6 +449,11 @@ class FaultController:
         # continuity); tests assert this stays empty
         self.churn_violations: List[str] = []
         self.metrics = None  # set by install_churn (or directly)
+        # flight recorders tapped into the fault plane (obs/): every
+        # activate/heal and churn action lands in the recorders' rings
+        # so a post-incident dump shows WHAT the nemesis did between
+        # the cluster's own state transitions
+        self._recorders: List = []
 
     # ------------------------------------------------------------------
     # installation
@@ -450,9 +477,23 @@ class FaultController:
         engine.fault_injector = self
 
     def install_nodehost(self, key, nh) -> None:
-        """Wire one NodeHost's transport + logdb in one call."""
+        """Wire one NodeHost's transport + logdb in one call (plus its
+        flight recorder, when NodeHostConfig.enable_flight_recorder is
+        on — nemesis actions belong on the same timeline as the state
+        transitions they cause)."""
         self.install_transport(nh.transport)
         self.install_logdb(key, nh.logdb)
+        rec = getattr(nh, "recorder", None)
+        if rec is not None:
+            self.install_recorder(rec)
+
+    def install_recorder(self, recorder) -> None:
+        """Tap the fault plane into an obs.FlightRecorder: fault
+        activations/heals and churn actions are recorded alongside the
+        cluster's own state transitions."""
+        with self._lock:
+            if recorder not in self._recorders:
+                self._recorders.append(recorder)
 
     def install_balancer(self, balancer) -> None:
         """Install on a balance-plane Balancer (its executor consults
@@ -598,6 +639,28 @@ class FaultController:
         # plan-step-indexed, wall-clock-free: the determinism contract
         self.event_log.append((self._seq, action, fault.describe()))
         self._seq += 1
+        self._rec_fr(self._fault_shard(fault), f"fault:{action}",
+                     fault.describe())
+
+    @staticmethod
+    def _fault_shard(fault: Fault) -> int:
+        """Flight-recorder lane for a fault: churn faults target shard
+        ids (record in that shard's ring); wire/fs/process faults
+        target host/component keys (global lane 0)."""
+        if fault.kind in CHURN_KINDS and fault.targets:
+            t = fault.targets[0]
+            if isinstance(t, int):
+                return t
+        return 0
+
+    def _rec_fr(self, shard_id: int, kind: str, detail: str) -> None:
+        """Fan a nemesis event out to the tapped flight recorders —
+        observability must never break the fault plane."""
+        for r in self._recorders:
+            try:
+                r.record(shard_id, kind, detail)
+            except Exception:  # noqa: BLE001
+                _log.exception("flight recorder tap raised")
 
     def _count(self, key: str) -> None:
         with self._lock:
@@ -844,6 +907,12 @@ class FaultController:
                 (self._churn_seq, fault.kind, action, detail)
             )
             self._churn_seq += 1
+        # the victim-resolved action (e.g. WHICH host a leader_kill hit)
+        # belongs on the shard's flight-recorder timeline — this is the
+        # "injected leader-kill" marker the post-incident dump shows
+        # between the last pre-kill apply and the re-election
+        self._rec_fr(self._fault_shard(fault),
+                     f"churn:{fault.kind}:{action}", detail)
         if self.metrics is not None and action in self._CHURN_EXECUTED:
             self.metrics.counter(
                 "churn_events_total", {"kind": fault.kind}

@@ -13,12 +13,31 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from .logger import get_logger
+
+_log = get_logger("metrics")
+
+
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped or the exposition line is
+    malformed (the spec's only three escapes; backslash FIRST so the
+    others aren't double-escaped)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
 
 def _labeled(name: str, labels) -> str:
     """Prometheus-style labelled series name: name{k="v",...}."""
     if not labels:
         return name
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(labels[k])}"' for k in sorted(labels)
+    )
     return f"{name}{{{inner}}}"
 
 
@@ -38,18 +57,30 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("name", "fn", "value")
+    __slots__ = ("name", "fn", "value", "_warned")
 
     def __init__(self, name: str, fn: Optional[Callable[[], float]] = None):
         self.name = name
         self.fn = fn
         self.value = 0.0
+        self._warned = False
 
     def set(self, v: float) -> None:
         self.value = v
 
     def get(self) -> float:
-        return float(self.fn()) if self.fn is not None else self.value
+        if self.fn is None:
+            return self.value
+        try:
+            return float(self.fn())
+        except Exception:  # noqa: BLE001 — a callback bug must not
+            # poison the whole scrape: export NaN for THIS series and
+            # log once per gauge (not once per scrape)
+            if not self._warned:
+                self._warned = True
+                _log.exception("gauge %s callback raised; exporting NaN",
+                               self.name)
+            return float("nan")
 
 
 class Histogram:

@@ -8,6 +8,7 @@ only the thread-safe queues and pending tables.
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
@@ -113,7 +114,7 @@ class Node:
         "_applied_since_snapshot", "_retired_snapshots", "_apply_lock",
         "_sm_close_lock", "notify_work", "engine_apply_ready",
         "log_reader", "sm", "_stop_event", "peer", "quiesce",
-        "wake", "parked_at_tick",
+        "wake", "parked_at_tick", "tracer", "_trace_spans",
     )
 
     def __init__(
@@ -128,10 +129,21 @@ class Node:
         on_leader_updated: Optional[Callable] = None,
         event_listener=None,
         registry=None,
+        tracer=None,
     ):
         self.config = config
         self.shard_id = config.shard_id
         self.replica_id = config.replica_id
+        # obs/ tracing: None when disabled — every hot-path gate is one
+        # attribute load.  _trace_spans maps in-flight entry key ->
+        # root span so the step/apply workers can annotate the path;
+        # eager (not lazy) when tracing is on, because a lazy create
+        # races concurrent producer threads (one fresh dict overwrites
+        # the other, losing registrations).  Untraced nodes keep None.
+        self.tracer = tracer
+        self._trace_spans: Optional[Dict[int, object]] = (
+            {} if tracer is not None else None
+        )
         self.logdb = logdb
         self.snapshot_storage = snapshot_storage
         self.transport = transport
@@ -364,8 +376,36 @@ class Node:
         # single host cost of the r5 scale run
         self._ticks_in += 1
 
+    def _trace_register(self, key: int, span) -> None:
+        """Associate an in-flight entry key with its root span so the
+        step/apply workers can annotate it.  The map is bounded: spans
+        of entries that never reach apply (timeouts GC the FUTURE via
+        the tick sweep, which ends the span, but nothing pops the key)
+        are pruned once ended, with a soft cap behind them.
+
+        Concurrency: producer threads insert here while step/apply
+        workers ``pop`` — individual dict ops are GIL-atomic, but
+        iterating the live dict is not (a concurrent pop raises
+        "changed size during iteration"), so the prune walks a
+        ``list(m.items())`` snapshot, which CPython builds without
+        dropping the GIL."""
+        m = self._trace_spans
+        m[key] = span
+        if len(m) > 4096:
+            items = list(m.items())
+            for k, s in items:
+                if s.ended:
+                    m.pop(k, None)
+            # pathological: (almost) all still open — shed the oldest
+            # (insertion order) down to 3/4 cap, so the next O(n) scan
+            # is ~1k inserts away (amortized, not per-propose)
+            overflow = len(m) - 3072
+            if len(m) > 4096 and overflow > 0:
+                for k, _ in items[:overflow]:
+                    m.pop(k, None)
+
     def propose(
-        self, session: Session, cmd: bytes, timeout_ticks: int
+        self, session: Session, cmd: bytes, timeout_ticks: int, span=None
     ) -> RequestState:
         if self.peer.raft.rate_limited():
             # MaxInMemLogSize exceeded: refuse new load until the window
@@ -376,6 +416,10 @@ class Node:
         entry, rs = self.pending_proposal.propose(
             session, cmd, self.tick_count + timeout_ticks
         )
+        if span is not None:
+            rs.span = span
+            span.annotate("request:queued")
+            self._trace_register(entry.key, span)
         with self._qlock:
             self.proposal_count += 1
             self._proposals.append(entry)
@@ -401,8 +445,11 @@ class Node:
             self.pending_proposal.seal(rs)
         return rs
 
-    def read_index(self, timeout_ticks: int) -> RequestState:
+    def read_index(self, timeout_ticks: int, span=None) -> RequestState:
         ctx, rs = self.pending_read_index.read(self.tick_count + timeout_ticks)
+        if span is not None:
+            rs.span = span
+            span.annotate("request:queued")
         with self._qlock:
             self._read_indexes.append(ctx)
         self._wake()
@@ -463,6 +510,24 @@ class Node:
         briefly instead of the row thrashing off the device)."""
         with self._qlock:
             self._pending_ticks += n
+
+    def queued_inputs(self) -> int:
+        """Depth of the step input queues (lock-free snapshot; scrape-
+        time observability — same benign races as has_work)."""
+        return (
+            len(self._received)
+            + len(self._proposals)
+            + len(self._read_indexes)
+            + len(self._config_changes)
+            + len(self._cc_to_apply)
+            + len(self._snapshot_reqs)
+            + len(self._leader_transfers)
+        )
+
+    def tick_lag(self) -> int:
+        """Ticks granted by the host but not yet consumed by step
+        (the engine-backlog signal; lock-free)."""
+        return (self._ticks_in - self._ticks_taken) + self._pending_ticks
 
     def has_work(self) -> bool:
         # lock-free reads: each container's truthiness/len is atomic
@@ -627,10 +692,36 @@ class Node:
             if m.type == MessageType.INSTALL_SNAPSHOT and m.snapshot.filepath
         ]
 
-        for m in received:
-            self.peer.handle(m)
+        tracer = self.tracer
+        if tracer is None:
+            for m in received:
+                self.peer.handle(m)
+        else:
+            for m in received:
+                if m.trace_id:
+                    # follower side of a traced replicate: parent the
+                    # append span to the leader's proposal span carried
+                    # in the message — the cross-host stitch
+                    fs = tracer.start_span(
+                        "follower:append", m.trace_id, m.span_id,
+                        shard_id=self.shard_id,
+                    )
+                    fs.annotate(
+                        f"recv:{m.type.name} from={m.from_} "
+                        f"entries={len(m.entries)}"
+                    )
+                    self.peer.handle(m)
+                    fs.end()
+                else:
+                    self.peer.handle(m)
 
         if proposals:
+            ts = self._trace_spans
+            if ts:
+                for e in proposals:
+                    s = ts.get(e.key)
+                    if s is not None:
+                        s.annotate(f"step:proposed batch={len(proposals)}")
             self.peer.propose_entries(proposals)
         for key, cc in config_changes:
             self.peer.propose_config_change(cc, key)
@@ -683,8 +774,56 @@ class Node:
         self.dispatch_dropped(u)
         return u
 
+    def _trace_update(self, u: Update) -> None:
+        """Annotate traced proposals along the raft path of one Update
+        (step worker only) and stamp outbound REPLICATEs with trace
+        context so the follower-side append spans stitch in.  Runs
+        BEFORE process_update's send/persist so the stamped messages
+        are what the transport actually carries."""
+        # lookups are gated on APPLICATION entries: config-change keys
+        # come from an INDEPENDENT sequential counter (request.py
+        # _PendingBase) and collide with proposal keys — an ungated
+        # ts.get would annotate (and stamp) the wrong span
+        ts = self._trace_spans
+        app = EntryType.APPLICATION
+        for e in u.entries_to_save:
+            if e.type != app:
+                continue
+            s = ts.get(e.key)
+            if s is not None:
+                s.annotate(f"raft:append index={e.index} term={e.term}")
+        msgs = u.messages
+        for i, m in enumerate(msgs):
+            if m.type != MessageType.REPLICATE or not m.entries:
+                continue
+            for e in m.entries:
+                if e.type != app:
+                    continue
+                s = ts.get(e.key)
+                if s is not None:
+                    msgs[i] = dataclasses.replace(
+                        m, trace_id=s.trace_id, span_id=s.span_id
+                    )
+                    s.annotate(
+                        f"raft:replicate to={m.to} entries={len(m.entries)}"
+                    )
+                    break
+        for e in u.committed_entries:
+            if e.type != app:
+                continue
+            s = ts.get(e.key)
+            if s is not None:
+                s.annotate(f"raft:committed index={e.index}")
+
     def dispatch_dropped(self, u: Update) -> None:
         """Fail dropped-request futures fast (both step engines call this)."""
+        ts = self._trace_spans
+        if ts:
+            for e in u.dropped_entries:
+                # APPLICATION only: a config-change key colliding with a
+                # live proposal key must not evict the proposal's span
+                if e.type == EntryType.APPLICATION:
+                    ts.pop(e.key, None)  # notify(DROPPED) ends the span
         for e in u.dropped_entries:
             # route by entry kind: proposal and config-change futures live
             # in different tables with independent key spaces
@@ -782,6 +921,8 @@ class Node:
     def process_update(self, u: Update) -> bool:
         """reference: node.processRaftUpdate + commitRaftUpdate [U].
         Returns True if apply work was scheduled."""
+        if self._trace_spans:
+            self._trace_update(u)
         if not u.snapshot.is_empty():
             self._install_snapshot(u.snapshot)
         if u.entries_to_save:
@@ -880,6 +1021,14 @@ class Node:
                         NodeInfoEvent(self.shard_id, self.replica_id)
                     )
             elif e.key:
+                ts = self._trace_spans
+                if ts:
+                    s = ts.pop(e.key, None)
+                    if s is not None:
+                        s.annotate(
+                            f"rsm:applied index={e.index}"
+                            f"{' rejected' if r.rejected else ''}"
+                        )
                 self.pending_proposal.applied(e.key, r.result, r.rejected)
 
     # ------------------------------------------------------------------
@@ -925,8 +1074,6 @@ class Node:
         membership through it, resolving external files to absolute
         paths in the snapshot dir (reference: rsm recover +
         ISnapshotFileCollection restore [U])."""
-        import dataclasses
-
         from .storage.snapshotio import SnapshotReader
 
         f = self.snapshot_storage.open_read(ss.filepath)

@@ -20,6 +20,7 @@ from .events import EventFanout
 from .logger import get_logger
 from .metrics import MetricsRegistry
 from .node import Node
+from .obs.trace import UNSAMPLED
 from .pb import (
     ConfigChange,
     ConfigChangeType,
@@ -140,8 +141,32 @@ class NodeHost:
                 self.registry = GossipRegistry(self.gossip)
             else:
                 self.registry = Registry()
+            # metrics exist before everything that registers series
+            # (event fanout, per-target breakers, the engine)
+            self.metrics = MetricsRegistry(enabled=config.enable_metrics)
+            # observability (obs/, docs/OBSERVABILITY.md): both gates
+            # default off and leave the attribute None — every hot-path
+            # check is one attribute load
+            from .obs import FlightRecorder, Tracer
+
+            self.tracer = (
+                Tracer(
+                    host=config.raft_address,
+                    sample_rate=config.trace_sample_rate,
+                )
+                if config.enable_tracing
+                else None
+            )
+            self.recorder = (
+                FlightRecorder(host=config.raft_address)
+                if config.enable_flight_recorder
+                else None
+            )
             self.events = EventFanout(
-                config.raft_event_listener, config.system_event_listener
+                config.raft_event_listener,
+                config.system_event_listener,
+                metrics=self.metrics,
+                tap=self._recorder_tap if self.recorder is not None else None,
             )
 
             # received snapshots get a unique suffix: re-streams of the same
@@ -166,9 +191,6 @@ class NodeHost:
                     self._chunk_sink.add,
                 )
             )
-            # registry exists before the transport so per-target breaker
-            # metrics can register as send queues appear
-            self.metrics = MetricsRegistry(enabled=config.enable_metrics)
             self.transport = Transport(
                 raw_transport,
                 self.registry.resolve,
@@ -208,6 +230,18 @@ class NodeHost:
 
             self.metrics.gauge(
                 "raft_nodehost_proposals_total", _proposals_total
+            )
+            # engine-health gauges (obs tentpole): scrape-time O(nodes)
+            # walks over lock-free per-node counters — the step/apply
+            # hot paths pay nothing
+            self.metrics.gauge(
+                "raft_nodehost_tick_lag_max", self._tick_lag_max
+            )
+            self.metrics.gauge(
+                "raft_nodehost_queue_depth_total", self._queue_depth_total
+            )
+            self.metrics.gauge(
+                "raft_nodehost_apply_lag_max", self._apply_lag_max
             )
 
             step_engine = (
@@ -313,6 +347,12 @@ class NodeHost:
                         ):
                             n.parked_at_tick = self._global_ticks
                             self._parked[n.shard_id] = n
+                            rec = self.recorder
+                            if rec is not None:
+                                rec.record(
+                                    n.shard_id, "park",
+                                    f"tick={self._global_ticks}",
+                                )
                             continue
                 for _ in range(batch):
                     n.add_tick()
@@ -336,6 +376,13 @@ class NodeHost:
             n = self._parked.pop(node.shard_id, None)
         if n is not None:
             n.grant_ticks(self._global_ticks - n.parked_at_tick)
+            rec = self.recorder
+            if rec is not None:
+                rec.record(
+                    n.shard_id, "unpark",
+                    f"tick={self._global_ticks} "
+                    f"parked_at={n.parked_at_tick}",
+                )
             if n.notify_work is not None:
                 n.notify_work()
 
@@ -390,6 +437,7 @@ class NodeHost:
                 on_leader_updated=self._on_leader_updated,
                 event_listener=self.events,
                 registry=self.registry,
+                tracer=self.tracer,
             )
             self._nodes[config.shard_id] = node
             node.wake = functools.partial(self._wake_node, node)
@@ -516,6 +564,12 @@ class NodeHost:
     def _on_leader_updated(
         self, shard_id: int, replica_id: int, term: int, leader_id: int
     ) -> None:
+        rec = self.recorder
+        if rec is not None:
+            rec.record(
+                shard_id, "leader_change",
+                f"replica={replica_id} term={term} leader={leader_id}",
+            )
         self.events.leader_updated(
             LeaderInfo(
                 shard_id=shard_id,
@@ -544,14 +598,43 @@ class NodeHost:
         return Session.noop(shard_id)
 
     # -- proposals --------------------------------------------------------
-    def propose(self, session: Session, cmd: bytes, timeout: float) -> RequestState:
+    def propose(
+        self, session: Session, cmd: bytes, timeout: float, parent=None
+    ) -> RequestState:
         node = self._get_node(session.shard_id)
-        rs = node.propose(session, cmd, self._timeout_ticks(timeout))
+        tracer = self.tracer  # None when disabled: one attribute load
+        span = None
+        if tracer is not None and parent is not UNSAMPLED:
+            if parent is not None:
+                # continue a caller-held trace (e.g. the client retry
+                # loop's root span) — already sampled at its root
+                span = tracer.start_span(
+                    "propose", parent.trace_id, parent.span_id,
+                    shard_id=session.shard_id,
+                )
+            else:
+                span = tracer.start_trace("propose", shard_id=session.shard_id)
+            if span is not None:
+                span.annotate(f"client:propose bytes={len(cmd)}")
+        try:
+            rs = node.propose(
+                session, cmd, self._timeout_ticks(timeout), span=span
+            )
+        except Exception as e:
+            # a rejected request (SystemBusy, closed shard, ...) must
+            # still reach the finished-span ring — the weakly-held open
+            # span would otherwise be GC'd unended and the very
+            # requests an operator debugs would vanish from dumps
+            if span is not None:
+                span.end(status=type(e).__name__)
+            raise
         self.engine.notify(session.shard_id)
         return rs
 
-    def sync_propose(self, session: Session, cmd: bytes, timeout: float = 5.0) -> Result:
-        rs = self.propose(session, cmd, timeout)
+    def sync_propose(
+        self, session: Session, cmd: bytes, timeout: float = 5.0, parent=None
+    ) -> Result:
+        rs = self.propose(session, cmd, timeout, parent=parent)
         return _check(rs.wait(timeout), rs)
 
     # -- sessions ---------------------------------------------------------
@@ -574,7 +657,16 @@ class NodeHost:
     # -- reads ------------------------------------------------------------
     def read_index(self, shard_id: int, timeout: float) -> RequestState:
         node = self._get_node(shard_id)
-        rs = node.read_index(self._timeout_ticks(timeout))
+        tracer = self.tracer
+        span = None
+        if tracer is not None:
+            span = tracer.start_trace("read_index", shard_id=shard_id)
+        try:
+            rs = node.read_index(self._timeout_ticks(timeout), span=span)
+        except Exception as e:
+            if span is not None:
+                span.end(status=type(e).__name__)
+            raise
         self.engine.notify(shard_id)
         return rs
 
@@ -713,6 +805,72 @@ class NodeHost:
         NodeHost.WriteHealthMetrics [U]); enable via
         NodeHostConfig.enable_metrics."""
         writer.write(self.metrics.export_text())
+
+    # -- observability (obs/, docs/OBSERVABILITY.md) -------------------
+    def _recorder_tap(self, name: str, args) -> None:
+        """EventFanout tap: every system event also lands in the flight
+        recorder, synchronously (the fanout queue can drop under
+        pressure; the recorder must not miss state transitions)."""
+        rec = self.recorder
+        if rec is None:
+            return
+        info = args[0] if args else None
+        shard = getattr(info, "shard_id", 0) or 0
+        rec.record(shard, f"event:{name}", repr(info) if info is not None else "")
+
+    def _tick_lag_max(self) -> int:
+        with self._nodes_lock:
+            nodes = list(self._nodes.values())
+        return max((n.tick_lag() for n in nodes), default=0)
+
+    def _queue_depth_total(self) -> int:
+        with self._nodes_lock:
+            nodes = list(self._nodes.values())
+        return sum(n.queued_inputs() for n in nodes)
+
+    def _apply_lag_max(self) -> int:
+        with self._nodes_lock:
+            nodes = list(self._nodes.values())
+        lag = 0
+        for n in nodes:
+            try:
+                lag = max(lag, n.peer.committed() - n.sm.last_applied)
+            except Exception:  # noqa: BLE001 — node mid-stop
+                continue
+        return lag
+
+    def dump_timeline(self, shard_id=None, writer=None) -> str:
+        """Merged human-readable timeline for this host: flight-recorder
+        state transitions interleaved with trace spans/annotations.
+        This is the "where did these 4 seconds go?" view; cross-host
+        merges use :func:`dragonboat_tpu.obs.merged_timeline` over the
+        hosts' recorders/tracers."""
+        from .obs import format_timeline, merged_timeline
+
+        out = format_timeline(
+            merged_timeline(
+                recorders=(self.recorder,),
+                tracers=(self.tracer,),
+                shard_id=shard_id,
+            )
+        )
+        if writer is not None:
+            writer.write(out)
+        return out
+
+    def export_trace_json(self, path: Optional[str] = None) -> str:
+        """Chrome/Perfetto ``trace_event`` JSON of this host's recorded
+        spans (open in ui.perfetto.dev).  Empty trace when tracing is
+        disabled."""
+        data = (
+            self.tracer.export_json()
+            if self.tracer is not None
+            else '{"traceEvents": []}'
+        )
+        if path:
+            with open(path, "w") as f:
+                f.write(data)
+        return data
 
     def get_nodehost_info(self) -> dict:
         with self._nodes_lock:

@@ -1,0 +1,43 @@
+"""Observability: end-to-end request tracing + per-shard flight
+recorder (docs/OBSERVABILITY.md).
+
+* :mod:`.trace` — the span model threaded through the proposal/read
+  path with trace context carried in wire messages, plus the
+  Chrome/Perfetto ``trace_event`` exporter;
+* :mod:`.recorder` — the per-shard flight recorder ring buffers,
+  dumped on demand (``NodeHost.dump_timeline``) and automatically when
+  ``assert_recovery_sla`` trips or an audit gate fails.
+
+Both are off by default (``NodeHostConfig.enable_tracing`` /
+``enable_flight_recorder``); the disabled hot paths cost one attribute
+load.
+"""
+from .recorder import (
+    FlightRecorder,
+    attach_timeline,
+    format_timeline,
+    hosts_timeline,
+    merged_timeline,
+)
+from .trace import (
+    Span,
+    Tracer,
+    UNSAMPLED,
+    export_merged_json,
+    spans_to_trace_events,
+    stitched_traces,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "Span",
+    "Tracer",
+    "UNSAMPLED",
+    "attach_timeline",
+    "export_merged_json",
+    "format_timeline",
+    "hosts_timeline",
+    "merged_timeline",
+    "spans_to_trace_events",
+    "stitched_traces",
+]

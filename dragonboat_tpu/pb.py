@@ -243,6 +243,12 @@ class Message:
     ``log_term``/``log_index`` carry prevLogTerm/prevLogIndex for REPLICATE
     and the candidate's last log position for votes. ``hint``/``hint_high``
     carry the ReadIndex SystemCtx and the log-matching reject hint.
+
+    ``trace_id``/``span_id`` are OBSERVABILITY context, not protocol
+    state: a leader replicating a traced proposal stamps the proposal
+    span's context onto the REPLICATE so the follower's append span
+    stitches into the same cross-host trace (dragonboat_tpu.obs).  0
+    means untraced; the raft core ignores both fields.
     """
 
     type: MessageType = MessageType.NO_OP
@@ -258,6 +264,8 @@ class Message:
     hint_high: int = 0
     entries: Tuple[Entry, ...] = ()
     snapshot: Snapshot = EMPTY_SNAPSHOT
+    trace_id: int = 0
+    span_id: int = 0
 
     def is_local(self) -> bool:
         return self.type in _LOCAL_TYPES
@@ -359,6 +367,15 @@ class Update:
         )
 
 
+# message-batch wire format version (reference: raftio TransportBinVersion
+# [U]).  v1: every Message carries a trace-context flag byte (+ ids when
+# traced) after the snapshot field.  decode_batch still reads v0 (no
+# flag byte — rolling upgrades keep talking) and rejects unknown FUTURE
+# versions loudly instead of shifting every subsequent field into
+# garbage; the encoder always emits the current version.
+MESSAGE_BATCH_BIN_VER = 1
+
+
 @dataclass(frozen=True)
 class MessageBatch:
     """Coalesced wire unit between hosts (reference: raftpb.MessageBatch [U])."""
@@ -366,7 +383,7 @@ class MessageBatch:
     messages: Tuple[Message, ...] = ()
     source_address: str = ""
     deployment_id: int = 0
-    bin_ver: int = 0
+    bin_ver: int = MESSAGE_BATCH_BIN_VER
 
 
 @dataclass(frozen=True)

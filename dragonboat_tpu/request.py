@@ -49,9 +49,15 @@ class RequestResultCode(enum.IntEnum):
 
 
 class RequestState:
-    """A single pending operation's future (reference: RequestState [U])."""
+    """A single pending operation's future (reference: RequestState [U]).
 
-    __slots__ = ("key", "deadline", "_event", "code", "result", "_committed")
+    ``span`` is the request's root trace span (obs/; None when tracing
+    is off or the request unsampled): every completion path funnels
+    through ``notify``, so ending it here covers applied, dropped,
+    timed-out, terminated and sealed futures alike."""
+
+    __slots__ = ("key", "deadline", "_event", "code", "result", "_committed",
+                 "span")
 
     def __init__(self, key: int, deadline: int):
         self.key = key
@@ -60,12 +66,16 @@ class RequestState:
         self.code: Optional[RequestResultCode] = None
         self.result: Result = Result()
         self._committed = False
+        self.span = None
 
     # -- completion (engine side) ---------------------------------------
     def notify(self, code: RequestResultCode, result: Optional[Result] = None):
         self.code = code
         if result is not None:
             self.result = result
+        s = self.span
+        if s is not None:
+            s.end(status=code.name if code is not None else "unknown")
         self._event.set()
 
     def notify_committed(self):

@@ -29,6 +29,7 @@ from ..pb import (
     ConfigChangeType,
     Entry,
     EntryType,
+    MESSAGE_BATCH_BIN_VER,
     Membership,
     Message,
     MessageBatch,
@@ -321,9 +322,16 @@ def _w_message(b: BytesIO, m: Message) -> None:
     _wu8(b, int(has_ss))
     if has_ss:
         _w_snapshot(b, m.snapshot)
+    # trace context (obs/): one flag byte when untraced, so the
+    # tracing-off wire cost is a single zero byte per message
+    has_trace = m.trace_id != 0
+    _wu8(b, int(has_trace))
+    if has_trace:
+        _wu64(b, m.trace_id)
+        _wu64(b, m.span_id)
 
 
-def _r_message(r: _R) -> Message:
+def _r_message(r: _R, bin_ver: int = MESSAGE_BATCH_BIN_VER) -> Message:
     mtype = MessageType(r.u8())
     reject = bool(r.u8())
     to, from_, shard_id, term, log_term, log_index, commit, hint, hint_high = (
@@ -331,6 +339,11 @@ def _r_message(r: _R) -> Message:
     )
     entries = tuple(_r_entry(r) for _ in range(r.count()))
     snapshot = _r_snapshot(r) if r.u8() else Snapshot()
+    trace_id = span_id = 0
+    # v0 predates the trace-context flag byte: nothing more to read
+    if bin_ver >= 1 and r.u8():
+        trace_id = r.u64()
+        span_id = r.u64()
     return Message(
         type=mtype,
         to=to,
@@ -345,6 +358,8 @@ def _r_message(r: _R) -> Message:
         hint_high=hint_high,
         entries=entries,
         snapshot=snapshot,
+        trace_id=trace_id,
+        span_id=span_id,
     )
 
 
@@ -355,7 +370,10 @@ def encode_batch(batch: MessageBatch) -> bytes:
     b = BytesIO()
     _ws(b, batch.source_address)
     _wu64(b, batch.deployment_id)
-    _wu32(b, batch.bin_ver)
+    # the encoder only emits the CURRENT per-message layout, so the
+    # header always says so — batch.bin_ver is what the decoder READ,
+    # not a request to re-encode an old format
+    _wu32(b, MESSAGE_BATCH_BIN_VER)
     _wu32(b, len(batch.messages))
     for m in batch.messages:
         _w_message(b, m)
@@ -367,7 +385,16 @@ def decode_batch(data: bytes) -> MessageBatch:
     source_address = r.s()
     deployment_id = r.u64()
     bin_ver = r.u32()
-    messages = tuple(_r_message(r) for _ in range(r.count()))
+    if bin_ver > MESSAGE_BATCH_BIN_VER:
+        # the per-message layout is versioned by this field; parsing an
+        # unknown FUTURE version would silently shift every subsequent
+        # field.  Known past versions still decode (v0 lacks the
+        # trace-context flag byte) so a rolling upgrade keeps talking.
+        raise WireError(
+            f"message batch bin_ver {bin_ver} is newer than supported "
+            f"{MESSAGE_BATCH_BIN_VER}"
+        )
+    messages = tuple(_r_message(r, bin_ver) for _ in range(r.count()))
     if r.pos != len(data):
         raise WireError(f"trailing bytes: {len(data) - r.pos}")
     return MessageBatch(
