@@ -511,9 +511,10 @@ def phase_c(jax, SHARDS: int, duration: float, *, inflight: int = 8,
                 probe_ms.append((_time.time() - t1) * 1000.0)
 
         threads = [
-            threading.Thread(target=worker, args=(w,), daemon=True)
+            threading.Thread(target=worker, args=(w,), daemon=True,
+                             name=f"bench-c-worker-{w}")
             for w in range(workers)
-        ] + [threading.Thread(target=prober, daemon=True)]
+        ] + [threading.Thread(target=prober, daemon=True, name="bench-c-probe")]
         t0 = _time.time()
         for t in threads:
             t.start()
@@ -581,20 +582,23 @@ def _bench_sm_cls():
     return _BenchSM
 
 
-def phase_obs(
-    proposals: int = 400,
+def _measure_3replica_proposals(
+    tag: str,
     *,
-    rtt_ms: int = 2,
-    warmup: int = 50,
-) -> dict:
-    """Observability bench guard (obs tentpole, docs/OBSERVABILITY.md):
-    p50 proposal latency through the public NodeHost API on a 3-replica
-    in-proc shard, measured with ``enable_tracing=False`` (the default
-    — its hot-path cost is one attribute load) and again with tracing +
-    flight recorder fully on at sample rate 1.0.  The "off" number is
-    what the <2%-vs-seed acceptance gate compares; the on/off ratio
-    bounds the worst-case cost of turning the layer on.  Pure host path
-    — no device, no jax."""
+    proposals: int,
+    warmup: int,
+    rtt_ms: int,
+    nh_extra=None,
+    mid_run=None,
+):
+    """Shared 3-replica in-proc proposal harness for the host-path
+    bench guards (phase_obs / phase_lockcheck): bring-up, 30s leader
+    wait, warmup + timed proposal loop with the 4-attempt
+    leader-failover retry.  ``nh_extra`` adds NodeHostConfig kwargs;
+    ``mid_run(nhs, leader)`` fires once at the loop midpoint (e.g. a
+    leader transfer).  Returns ``{"p50_ms", "wall_s"}`` or
+    ``{"error"}``.  One harness, one drift surface (review finding:
+    two near-identical copies had already diverged)."""
     import shutil
 
     from dragonboat_tpu import (
@@ -609,78 +613,109 @@ def phase_obs(
     from dragonboat_tpu.transport.inproc import reset_inproc_network
 
     sm_cls = _bench_sm_cls()
+    reset_inproc_network()
+    addrs = {r: f"bench-{tag}-{r}" for r in (1, 2, 3)}
+    nhs = {}
+    for r, addr in addrs.items():
+        d = f"/tmp/nh-bench-{tag}-{r}"
+        shutil.rmtree(d, ignore_errors=True)
+        nhs[r] = NodeHost(NodeHostConfig(
+            nodehost_dir=d,
+            rtt_millisecond=rtt_ms,
+            raft_address=addr,
+            expert=ExpertConfig(
+                engine=EngineConfig(exec_shards=2, apply_shards=2),
+            ),
+            **(nh_extra or {}),
+        ))
+    try:
+        for r, nh in nhs.items():
+            nh.start_replica(
+                addrs, False, sm_cls,
+                Config(shard_id=1, replica_id=r,
+                       election_rtt=10, heartbeat_rtt=1),
+            )
+        deadline = time.monotonic() + 30.0
+        leader = None
+        while time.monotonic() < deadline and leader is None:
+            lid, ok = nhs[1].get_leader_id(1)
+            if ok:
+                leader = nhs[lid]
+            else:
+                time.sleep(0.02)
+        if leader is None:
+            return {"error": f"no leader within 30s ({tag})"}
+        s = leader.get_noop_session(1)
+        lat = []
+        t_wall = time.perf_counter()
+        for i in range(warmup + proposals):
+            if mid_run is not None and i == warmup + proposals // 2:
+                mid_run(nhs, leader)
+            t0 = time.perf_counter()
+            # a freshly-elected leader drops proposals in its
+            # pre-noop-commit window, and a load spike can trigger
+            # re-election mid-run (timeout against the old leader):
+            # re-resolve the leader and retry, like a real client
+            # would — the retry wait lands in the sample, honestly
+            # fattening the tail
+            for attempt in range(4):
+                try:
+                    leader.sync_propose(s, b"x" * 32, timeout=5.0)
+                    break
+                except (RequestDropped, TimeoutError_) as e:
+                    if attempt == 3:
+                        e.args = (
+                            f"{e.args[0] if e.args else e} "
+                            f"(tag={tag} i={i})",
+                        )
+                        raise
+                    time.sleep(0.05)
+                    lid, ok = nhs[1].get_leader_id(1)
+                    if ok and lid in nhs and nhs[lid] is not leader:
+                        leader = nhs[lid]
+                        s = leader.get_noop_session(1)
+            if i >= warmup:
+                lat.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_wall
+        lat.sort()
+        return {
+            "p50_ms": round(lat[len(lat) // 2] * 1000.0, 4),
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        for nh in nhs.values():
+            try:
+                nh.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
+def phase_obs(
+    proposals: int = 400,
+    *,
+    rtt_ms: int = 2,
+    warmup: int = 50,
+) -> dict:
+    """Observability bench guard (obs tentpole, docs/OBSERVABILITY.md):
+    p50 proposal latency through the public NodeHost API on a 3-replica
+    in-proc shard, measured with ``enable_tracing=False`` (the default
+    — its hot-path cost is one attribute load) and again with tracing +
+    flight recorder fully on at sample rate 1.0.  The "off" number is
+    what the <2%-vs-seed acceptance gate compares; the on/off ratio
+    bounds the worst-case cost of turning the layer on.  Pure host path
+    — no device, no jax."""
 
     def measure(tracing: bool) -> float:
-        reset_inproc_network()
-        tag = "on" if tracing else "off"
-        addrs = {r: f"bench-obs-{tag}-{r}" for r in (1, 2, 3)}
-        nhs = {}
-        for r, addr in addrs.items():
-            d = f"/tmp/nh-bench-obs-{tag}-{r}"
-            shutil.rmtree(d, ignore_errors=True)
-            nhs[r] = NodeHost(NodeHostConfig(
-                nodehost_dir=d,
-                rtt_millisecond=rtt_ms,
-                raft_address=addr,
-                enable_tracing=tracing,
-                enable_flight_recorder=tracing,
-                expert=ExpertConfig(
-                    engine=EngineConfig(exec_shards=2, apply_shards=2),
-                ),
-            ))
-        try:
-            for r, nh in nhs.items():
-                nh.start_replica(
-                    addrs, False, sm_cls,
-                    Config(shard_id=1, replica_id=r,
-                           election_rtt=10, heartbeat_rtt=1),
-                )
-            deadline = time.monotonic() + 30.0
-            leader = None
-            while time.monotonic() < deadline and leader is None:
-                lid, ok = nhs[1].get_leader_id(1)
-                if ok:
-                    leader = nhs[lid]
-                else:
-                    time.sleep(0.02)
-            if leader is None:
-                return -1.0
-            s = leader.get_noop_session(1)
-            lat = []
-            for i in range(warmup + proposals):
-                t0 = time.perf_counter()
-                # a freshly-elected leader drops proposals in its
-                # pre-noop-commit window, and a load spike can trigger
-                # re-election mid-run (timeout against the old leader):
-                # re-resolve the leader and retry, like a real client
-                # would — the retry wait lands in the sample, honestly
-                # fattening the tail
-                for attempt in range(4):
-                    try:
-                        leader.sync_propose(s, b"x" * 32, timeout=5.0)
-                        break
-                    except (RequestDropped, TimeoutError_) as e:
-                        if attempt == 3:
-                            e.args = (
-                                f"{e.args[0] if e.args else e} "
-                                f"(tracing={tracing} i={i})",
-                            )
-                            raise
-                        time.sleep(0.05)
-                        lid, ok = nhs[1].get_leader_id(1)
-                        if ok and lid in nhs and nhs[lid] is not leader:
-                            leader = nhs[lid]
-                            s = leader.get_noop_session(1)
-                if i >= warmup:
-                    lat.append(time.perf_counter() - t0)
-            lat.sort()
-            return lat[len(lat) // 2] * 1000.0
-        finally:
-            for nh in nhs.values():
-                try:
-                    nh.close()
-                except Exception:  # noqa: BLE001 — best-effort teardown
-                    pass
+        r = _measure_3replica_proposals(
+            f"obs-{'on' if tracing else 'off'}",
+            proposals=proposals,
+            warmup=warmup,
+            rtt_ms=rtt_ms,
+            nh_extra=dict(
+                enable_tracing=tracing, enable_flight_recorder=tracing
+            ),
+        )
+        return -1.0 if "error" in r else r["p50_ms"]
 
     p50_off = measure(False)
     p50_on = measure(True)
@@ -697,6 +732,102 @@ def phase_obs(
         "p50_off_ms": round(p50_off, 4),
         "p50_on_ms": round(p50_on, 4),
         "tracing_overhead_pct": round((p50_on / p50_off - 1.0) * 100.0, 1),
+    }
+
+
+def _acquire_cost_ns(lock, iters: int = 200_000) -> float:
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        lock.acquire()
+        lock.release()
+    return (time.perf_counter() - t0) / iters * 1e9
+
+
+def phase_lockcheck(
+    proposals: int = 300,
+    *,
+    rtt_ms: int = 2,
+    warmup: int = 40,
+) -> dict:
+    """Lock-order-witness bench guard (analysis/, docs/ANALYSIS.md).
+
+    The number that actually PREDICTS what the witness costs the
+    lock-churning chaos tests is the CPU-bound per-acquire micro-cost
+    (``acquire_ns``: real lock vs tracked lock, uncontended and with
+    another lock held — the held-stack/edge bookkeeping path); the
+    cluster workload below is rtt-sleep-dominated, so its wall numbers
+    are a sanity floor, not a bound (review finding: a wall-only guard
+    would show ~0%% while the witness silently ate tier-1's headroom).
+    The cluster pass still runs off vs on — with a mid-run leader
+    transfer to churn election/transfer lock paths — to catch
+    functional regressions (cycles on a green run, lost tracking).
+    Pure host path — no device, no jax."""
+    import threading
+
+    from dragonboat_tpu.analysis import lockcheck
+
+    real_ns = _acquire_cost_ns(threading.Lock())
+    w_micro = lockcheck.install()
+    try:
+        tracked = w_micro.make_lock("bench:micro")
+        on_ns = _acquire_cost_ns(tracked)
+        with w_micro.make_lock("bench:outer"):
+            on_held_ns = _acquire_cost_ns(tracked)
+    finally:
+        lockcheck.uninstall()
+
+    def transfer(nhs, leader):
+        lid, ok = nhs[1].get_leader_id(1)
+        if ok:
+            leader.request_leader_transfer(1, (lid % 3) + 1)
+
+    witness_stats: dict = {}
+
+    def measure(check: bool) -> dict:
+        witness = lockcheck.install() if check else None
+        try:
+            return _measure_3replica_proposals(
+                f"lck-{'on' if check else 'off'}",
+                proposals=proposals,
+                warmup=warmup,
+                rtt_ms=rtt_ms,
+                mid_run=transfer,
+            )
+        finally:
+            if witness is not None:
+                lockcheck.uninstall()
+                r = witness.report()
+                witness_stats.update(
+                    tracked_locks=r["tracked_locks"],
+                    acquires=r["acquires"],
+                    edges=r["edges"],
+                    cycles=len(r["cycles"]),
+                    slow_waits=len(r["slow_waits"]),
+                )
+
+    off = measure(False)
+    on = measure(True)
+    acquire_ns = {
+        "real": round(real_ns, 1),
+        "tracked": round(on_ns, 1),
+        "tracked_holding_another": round(on_held_ns, 1),
+        "x_overhead": round(on_ns / real_ns, 2) if real_ns else None,
+    }
+    if "error" in off or "error" in on:
+        return {
+            "proposals": proposals,
+            "acquire_ns": acquire_ns,
+            "error": off.get("error") or on.get("error"),
+        }
+    return {
+        "proposals": proposals,
+        "acquire_ns": acquire_ns,
+        "p50_off_ms": off["p50_ms"],
+        "p50_on_ms": on["p50_ms"],
+        "wall_off_s": off["wall_s"],
+        "wall_on_s": on["wall_s"],
+        "overhead_pct": round((on["wall_s"] / off["wall_s"] - 1.0) * 100.0, 1),
+        "witness": witness_stats,
     }
 
 
@@ -847,7 +978,7 @@ def main() -> None:
     # own.  Whatever the driver's cutoff, the last line standing is a
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
-             balance=None, obs=None) -> None:
+             balance=None, obs=None, lockcheck=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -874,6 +1005,10 @@ def main() -> None:
                     # p50 proposal latency tracing-off (the default
                     # path the <2%-vs-seed gate reads) vs fully on
                     "obs": obs,
+                    # r08 schema addition: lock-order-witness overhead
+                    # guard (analysis/lockcheck; what the chaos/fault
+                    # test modules pay for running under the sanitizer)
+                    "lockcheck": lockcheck,
                 }
             ),
             flush=True,
@@ -1020,6 +1155,22 @@ def main() -> None:
             obs = {"error": obs_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs)
 
+    # Lock-order-witness overhead guard (host path only — cheap, no
+    # device risk): same workload with the sanitizer off vs installed
+    lck = None
+    if bool(int(os.environ.get("BENCH_LOCKCHECK", "1"))) and remaining() > 60:
+        code = (
+            "import json, bench;"
+            "print('BENCHLCK ' + json.dumps(bench.phase_lockcheck()))"
+        )
+        lck, lck_err = run_sub(
+            code, "BENCHLCK", max(60, min(240, int(remaining() - 30)))
+        )
+        if lck is None:
+            lck = {"error": lck_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -1037,7 +1188,7 @@ def main() -> None:
             ticks_per_sec = float(val)
             a_groups = fallback
             emit(ticks_per_sec, a_groups, device_loop, consensus, balance,
-                 obs)
+                 obs, lck)
 
     if profile_dir and remaining() > 60:
         # profiling runs a small phase A in-process with the tracer on;

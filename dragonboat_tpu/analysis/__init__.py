@@ -1,0 +1,26 @@
+"""Correctness tooling: static AST linting + dynamic lock-order witness.
+
+reference: upstream dragonboat keeps its 40+-goroutine-per-host system
+honest with the Go race detector, build-tag-gated ``internal/invariants``
+checks and monkeytest CI [U].  Python has none of those out of the box;
+this package is the port's equivalent discipline, grown after three
+concurrency bugs in a row were found only by hand in review (the
+EventFanout close deadlock, the ``drain_ticks_only`` missing ``_qlock``,
+the ``Span.end`` double-fire race):
+
+* :mod:`.raftlint` — a stdlib-``ast`` linter with project-specific rules
+  (guarded-by field discipline, no blocking calls under a lock,
+  determinism-plane clock/rng bans, the 64-bit pack-width policy,
+  import/thread hygiene).  Gate: zero findings not recorded in
+  ``analysis/baseline.txt`` (``scripts/lint.sh``, wired into tier-1).
+* :mod:`.lockcheck` — an env-gated (``DRAGONBOAT_TPU_LOCKCHECK``)
+  runtime witness wrapping the project's Lock/RLock constructors into a
+  global lock-order graph: any cycle (potential deadlock) is reported
+  with both witness stacks, and waits past a threshold while another
+  lock is held are flagged.  conftest enables it for the chaos/fault
+  test modules.
+
+See docs/ANALYSIS.md for the rule catalog and workflows.
+"""
+from .raftlint import Finding, lint_paths, lint_source, load_baseline  # noqa: F401
+from . import lockcheck  # noqa: F401

@@ -1,0 +1,6 @@
+"""CLI: ``python -m dragonboat_tpu.analysis [--baseline F] [paths...]``."""
+import sys
+
+from .raftlint import main
+
+sys.exit(main())

@@ -1,0 +1,726 @@
+"""raftlint: the project-native AST linter (stdlib ``ast``, no deps).
+
+Rules (ids are stable — baseline entries and ignore comments key on them):
+
+``guarded-by``
+    A field whose defining assignment carries ``# guarded-by: <lock>``
+    may only be accessed (read or write) via ``self.<field>`` inside a
+    lexical ``with self.<lock>:`` block.  The function containing the
+    defining assignment (normally ``__init__``) is exempt — state is
+    unpublished there.  A ``def`` line carrying ``# guarded-by: <lock>``
+    declares the whole function runs with the lock already held
+    (callees of locked sections, e.g. ``_gc_extra``).
+
+``block-under-lock``
+    No potentially-unbounded blocking call lexically inside a ``with
+    <lock>:`` body: ``.put(...)`` without a timeout/``block=False``
+    (the exact shape of the PR 4 EventFanout close deadlock),
+    zero-argument ``.get()`` (queue get; ``dict.get`` always takes a
+    key), zero-argument ``.join()`` (thread join; ``str.join`` takes an
+    iterable), ``time.sleep``, and socket ops (connect/accept/recv/
+    send/sendall/recvfrom/sendto).  ``Condition.wait`` is fine — it
+    releases the lock.
+
+``determinism``
+    The determinism plane (``faults.py``, ``balance/planner.py`` — the
+    modules whose byte-deterministic event logs and seeded schedules
+    the chaos/audit harnesses replay) must not read wall clocks or
+    global rng: ``time.time()`` and module-level ``random.*`` calls are
+    banned.  Allowed indirections: ``random.Random(seed)`` /
+    ``random.SystemRandom`` construction, methods on rng instances,
+    ``time.monotonic`` (deadlines, not identity) and ``time.sleep``.
+
+``width-64``
+    Codec modules (wire/tan/kvlogdb/snapshotio/gossip) pack protocol
+    integers as uint64; every value feeding a ``Q`` slot of a
+    ``struct`` pack must be masked ``& MASK64`` (docs/PARITY.md 64-bit
+    policy) so encode wraps like the reference's uint64 instead of
+    raising ``struct.error`` mid-persist.  Literals and ``len(...)``
+    are exempt.
+
+``import-hot``
+    No function-level imports in the hot modules (``node.py``,
+    ``request.py``, ``engine/``): a first call on the step/apply path
+    must not pay an import-lock round trip.
+
+``bare-except``
+    No ``except:`` — it swallows KeyboardInterrupt/SystemExit.  The
+    project idiom for intentional breadth is ``except Exception:`` with
+    a ``# noqa: BLE001`` note.
+
+``thread-discipline``
+    Every ``threading.Thread(...)`` must pass ``name=`` (leak reports
+    and timelines are useless full of ``Thread-12``) and an explicit
+    ``daemon=`` (forcing the author to choose daemon-or-joined).
+
+Point suppression: ``# raftlint: ignore[rule-id] <reason>`` on the
+finding's line or on the first line of its enclosing statement.
+Pre-existing accepted findings live in ``analysis/baseline.txt`` as
+``<path> <rule> <count>`` lines; the gate fails only when a
+(file, rule) count exceeds its baseline — zero new findings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+GUARDED_RE = re.compile(r"#.*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+IGNORE_RE = re.compile(r"#\s*raftlint:\s*ignore\[([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*)\]")
+
+MASK64 = 0xFFFFFFFFFFFFFFFF
+MASK64_NAMES = {"MASK64", "_M64", "M64"}
+
+# rule scoping (matched as posix-relpath suffixes/prefixes)
+HOT_IMPORT_MODULES = (
+    "dragonboat_tpu/node.py",
+    "dragonboat_tpu/request.py",
+    "dragonboat_tpu/engine/",
+)
+DETERMINISM_MODULES = (
+    "dragonboat_tpu/faults.py",
+    "dragonboat_tpu/balance/planner.py",
+)
+WIDTH_MODULES = (
+    "dragonboat_tpu/transport/wire.py",
+    "dragonboat_tpu/transport/gossip.py",
+    "dragonboat_tpu/storage/tan.py",
+    "dragonboat_tpu/storage/kvlogdb.py",
+    "dragonboat_tpu/storage/snapshotio.py",
+)
+
+BLOCKING_SOCKET_METHODS = {
+    "connect", "accept", "recv", "send", "sendall", "recvfrom", "sendto",
+}
+# names that make a `with X:` item count as a lock for block-under-lock:
+# the FINAL underscore-segment must itself be a lock word — an
+# unanchored `lock$` would swallow clock/block/unlock (review finding)
+LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|qlock|glock|mu|mutex)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+def _module_matches(relpath: str, scopes) -> bool:
+    p = relpath.replace(os.sep, "/")
+    for s in scopes:
+        if s.endswith("/"):
+            if f"/{s}" in f"/{p}" or p.startswith(s):
+                return True
+        elif p == s or p.endswith("/" + s) or p.endswith(s):
+            return True
+    return False
+
+
+def _parse_q_slots(fmt: str) -> Optional[List[int]]:
+    """Indices of pack() args that land in 64-bit ('Q'/'q') slots.
+    Returns None for formats raftlint cannot map (e.g. 's' with counts,
+    which consumes one arg per run)."""
+    slots: List[int] = []
+    arg_i = 0
+    count = ""
+    for ch in fmt:
+        if ch in "<>=!@ ":
+            continue
+        if ch.isdigit():
+            count += ch
+            continue
+        n = int(count) if count else 1
+        count = ""
+        if ch in "sp":
+            # one arg regardless of count
+            arg_i += 1
+            continue
+        if ch == "x":
+            continue
+        for _ in range(n):
+            if ch in "Qq":
+                slots.append(arg_i)
+            arg_i += 1
+    return slots
+
+
+def _is_masked64(node: ast.AST) -> bool:
+    """True for expressions the width rule accepts in a Q slot."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "len":
+            return True
+        if isinstance(f, ast.Attribute) and f.attr == "crc32":
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitAnd):
+        for side in (node.left, node.right):
+            if isinstance(side, ast.Name) and side.id in MASK64_NAMES:
+                return True
+            if isinstance(side, ast.Attribute) and side.attr in MASK64_NAMES:
+                return True
+            if isinstance(side, ast.Constant) and side.value == MASK64:
+                return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: List[Finding] = []
+        # rule scoping resolved once
+        self.check_imports = _module_matches(self.relpath, HOT_IMPORT_MODULES)
+        self.check_determinism = _module_matches(
+            self.relpath, DETERMINISM_MODULES
+        )
+        self.check_width = _module_matches(self.relpath, WIDTH_MODULES)
+        # file-wide guarded fields: attr -> (lock attr, defining func node)
+        self.guarded: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
+        # module-level struct.Struct assignments: name -> Q slot indices
+        self.structs: Dict[str, List[int]] = {}
+        # walk state
+        self._held: List[str] = []  # lock names currently held (lexically)
+        # locks held specifically via `with self.<lock>:` — the only form
+        # that satisfies guarded-by (holding ANOTHER object's same-named
+        # lock is exactly the bug class the rule exists to catch)
+        self._held_self: List[str] = []
+        self._func_stack: List[ast.AST] = []  # enclosing function defs
+        self._stmt_stack: List[int] = []  # enclosing statement linenos
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def _guard_annot(self, node: ast.AST) -> Optional[str]:
+        """The guarded-by lock name annotated on a node's line, or on a
+        pure-comment line directly above it."""
+        m = GUARDED_RE.search(self._line(node.lineno))
+        if m is None and self._line(node.lineno - 1).strip().startswith("#"):
+            m = GUARDED_RE.search(self._line(node.lineno - 1))
+        return m.group(1) if m else None
+
+    def _suppressed(self, rule: str, lineno: int) -> bool:
+        candidates = {lineno}
+        if self._stmt_stack:
+            candidates.add(self._stmt_stack[-1])
+        # a pure-comment line directly above the finding/statement also
+        # counts (the ignore-next-line style keeps code lines readable)
+        for ln in list(candidates):
+            if self._line(ln - 1).strip().startswith("#"):
+                candidates.add(ln - 1)
+        for ln in candidates:
+            m = IGNORE_RE.search(self._line(ln))
+            if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                return True
+        return False
+
+    def _emit(self, rule: str, lineno: int, message: str) -> None:
+        if not self._suppressed(rule, lineno):
+            self.findings.append(Finding(self.relpath, lineno, rule, message))
+
+    # -- pass 1: collect annotations and struct tables --------------------
+
+    def collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                lock = self._guard_annot(node)
+                if lock:
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            self.guarded[t.attr] = (lock, None)
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr == "Struct"
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Constant)
+                    and isinstance(node.value.args[0].value, str)
+                ):
+                    slots = _parse_q_slots(node.value.args[0].value)
+                    if slots:
+                        self.structs[node.targets[0].id] = slots
+        # resolve each guarded field's defining function (the function
+        # whose body contains the annotated assignment)
+        for func in ast.walk(self.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(func):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    if self._guard_annot(node) is None:
+                        continue
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr in self.guarded
+                            and self.guarded[t.attr][1] is None
+                        ):
+                            self.guarded[t.attr] = (
+                                self.guarded[t.attr][0],
+                                func,
+                            )
+
+    # -- pass 2: the walk -------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self.collect()
+        self.visit(self.tree)
+        return self.findings
+
+    def visit(self, node: ast.AST) -> None:
+        pushed_stmt = False
+        if isinstance(node, ast.stmt):
+            self._stmt_stack.append(node.lineno)
+            pushed_stmt = True
+        try:
+            super().visit(node)
+        finally:
+            if pushed_stmt:
+                self._stmt_stack.pop()
+
+    # ---- functions: reset lexical lock context, track nesting ----------
+
+    def _visit_func(self, node) -> None:
+        held, self._held = self._held, []
+        held_self, self._held_self = self._held_self, []
+        # a `# guarded-by: <lock>` on the def line declares the function
+        # runs with the lock already held (the caller's self.<lock>)
+        m = GUARDED_RE.search(self._line(node.lineno))
+        if m:
+            self._held.append(m.group(1))
+            self._held_self.append(m.group(1))
+        self._func_stack.append(node)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._func_stack.pop()
+            self._held = held
+            self._held_self = held_self
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        held, self._held = self._held, []
+        held_self, self._held_self = self._held_self, []
+        try:
+            self.generic_visit(node)
+        finally:
+            self._held = held
+            self._held_self = held_self
+
+    # ---- with: enter/exit lock scopes ----------------------------------
+
+    @staticmethod
+    def _lock_name(expr: ast.AST) -> Optional[str]:
+        """The lock attr/name of a with-item, or None if not lock-like."""
+        target = expr
+        if isinstance(target, ast.Call):
+            return None  # with open(...) etc.
+        if isinstance(target, ast.Attribute):
+            name = target.attr
+        elif isinstance(target, ast.Name):
+            name = target.id
+        else:
+            return None
+        return name if LOCKISH_RE.search(name) else None
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        entered_self: List[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            ln = self._lock_name(expr)
+            if ln is not None:
+                entered.append(ln)
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                ):
+                    entered_self.append(ln)
+        self._held.extend(entered)
+        self._held_self.extend(entered_self)
+        try:
+            self.generic_visit(node)
+        finally:
+            for _ in entered:
+                self._held.pop()
+            for _ in entered_self:
+                self._held_self.pop()
+
+    # ---- guarded-by -----------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.guarded
+        ):
+            lock, def_func = self.guarded[node.attr]
+            in_def_func = def_func is not None and any(
+                f is def_func for f in self._func_stack
+            )
+            if not in_def_func and lock not in self._held_self:
+                self._emit(
+                    "guarded-by",
+                    node.lineno,
+                    f"self.{node.attr} accessed outside `with self.{lock}:`",
+                )
+        self.generic_visit(node)
+
+    # ---- block-under-lock + determinism + width (all calls) ------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._held:
+            self._check_blocking(node)
+        if self.check_determinism:
+            self._check_determinism(node)
+        if self.check_width:
+            self._check_width(node)
+        self._check_thread(node)
+        self.generic_visit(node)
+
+    def _kw(self, node: ast.Call, name: str) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        meth = f.attr
+        lineno = node.lineno
+        if meth == "put" and len(node.args) == 1:
+            # one positional arg = the queue.put(item) shape; kv-store
+            # put(key, value) is a dict write, not a blocking call
+            blk = self._kw(node, "block")
+            if (
+                self._kw(node, "timeout") is None
+                and not (isinstance(blk, ast.Constant) and blk.value is False)
+            ):
+                self._emit(
+                    "block-under-lock",
+                    lineno,
+                    "blocking .put() under a held lock (use put_nowait or "
+                    "a timeout; the EventFanout close deadlock shape)",
+                )
+        elif meth == "get" and not node.args and not node.keywords:
+            self._emit(
+                "block-under-lock",
+                lineno,
+                "blocking zero-arg .get() under a held lock",
+            )
+        elif meth == "join" and not node.args and self._kw(node, "timeout") is None:
+            self._emit(
+                "block-under-lock",
+                lineno,
+                "unbounded .join() under a held lock",
+            )
+        elif meth == "sleep" and isinstance(f.value, ast.Name) and (
+            f.value.id in ("time", "_time")
+        ):
+            self._emit(
+                "block-under-lock", lineno, "time.sleep under a held lock"
+            )
+        elif meth in BLOCKING_SOCKET_METHODS and isinstance(
+            f.value, (ast.Name, ast.Attribute)
+        ):
+            recv = f.value.attr if isinstance(f.value, ast.Attribute) else f.value.id
+            if "sock" in recv or recv == "s":
+                self._emit(
+                    "block-under-lock",
+                    lineno,
+                    f"socket .{meth}() under a held lock",
+                )
+
+    def _check_determinism(self, node: ast.Call) -> None:
+        f = node.func
+        if not isinstance(f, ast.Attribute) or not isinstance(f.value, ast.Name):
+            return
+        mod = f.value.id
+        if mod in ("time", "_time") and f.attr == "time":
+            self._emit(
+                "determinism",
+                node.lineno,
+                "naked wall clock time.time() in the determinism plane "
+                "(use the seeded schedule / time.monotonic deadlines)",
+            )
+        elif mod in ("random", "_random") and f.attr not in (
+            "Random",
+            "SystemRandom",
+        ):
+            self._emit(
+                "determinism",
+                node.lineno,
+                f"global rng random.{f.attr}() in the determinism plane "
+                "(use a seeded random.Random instance)",
+            )
+
+    def _check_width(self, node: ast.Call) -> None:
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr != "pack":
+            return
+        slots: Optional[List[int]] = None
+        if isinstance(f.value, ast.Name):
+            if f.value.id == "struct":
+                if node.args and isinstance(node.args[0], ast.Constant) and (
+                    isinstance(node.args[0].value, str)
+                ):
+                    slots = [
+                        i + 1
+                        for i in _parse_q_slots(node.args[0].value) or []
+                    ]
+            elif f.value.id in self.structs:
+                slots = self.structs[f.value.id]
+        if not slots:
+            return
+        for i in slots:
+            if i < len(node.args) and not _is_masked64(node.args[i]):
+                self._emit(
+                    "width-64",
+                    node.lineno,
+                    "u64 pack of unmasked value (append `& MASK64`; "
+                    "docs/PARITY.md 64-bit policy)",
+                )
+
+    # ---- hygiene --------------------------------------------------------
+
+    def _check_import(self, node) -> None:
+        if self.check_imports and self._func_stack:
+            self._emit(
+                "import-hot",
+                node.lineno,
+                "function-level import in a hot module (hoist to module "
+                "level; the step/apply path must not pay the import lock)",
+            )
+
+    def visit_Import(self, node: ast.Import) -> None:
+        self._check_import(node)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        self._check_import(node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._emit(
+                "bare-except",
+                node.lineno,
+                "bare `except:` (catches KeyboardInterrupt/SystemExit; "
+                "use `except Exception:` at most)",
+            )
+        self.generic_visit(node)
+
+    def _check_thread(self, value: ast.Call) -> None:
+        f = value.func
+        is_thread = (
+            isinstance(f, ast.Attribute)
+            and f.attr == "Thread"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading"
+        ) or (isinstance(f, ast.Name) and f.id == "Thread")
+        if not is_thread:
+            return
+        kwargs = {kw.arg for kw in value.keywords}
+        if "name" not in kwargs:
+            self._emit(
+                "thread-discipline",
+                value.lineno,
+                "thread started without name= (leak reports and timelines "
+                "need named threads)",
+            )
+        if "daemon" not in kwargs:
+            self._emit(
+                "thread-discipline",
+                value.lineno,
+                "thread without explicit daemon= (choose daemon-or-joined)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def lint_source(source: str, relpath: str) -> List[Finding]:
+    """Lint one source blob as if it lived at ``relpath`` (fixtures use
+    fake paths to trigger module-scoped rules)."""
+    tree = ast.parse(source, filename=relpath)
+    return _Linter(relpath, source, tree).run()
+
+
+def _iter_py_files(paths) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [
+                    d for d in dirs if d != "__pycache__" and not d.startswith(".")
+                ]
+                out.extend(
+                    os.path.join(root, f) for f in files if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(out)
+
+
+def lint_paths(paths) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        rel = os.path.relpath(path).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            findings.extend(lint_source(src, rel))
+        except SyntaxError as e:
+            findings.append(
+                Finding(rel, e.lineno or 0, "parse-error", str(e.msg))
+            )
+    return findings
+
+
+def _counts(findings) -> Dict[Tuple[str, str], int]:
+    out: Dict[Tuple[str, str], int] = {}
+    for f in findings:
+        out[(f.path, f.rule)] = out.get((f.path, f.rule), 0) + 1
+    return out
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str], int]:
+    """``<path> <rule> <count>`` lines; '#' comments and blanks ignored."""
+    out: Dict[Tuple[str, str], int] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) != 3:
+                raise ValueError(f"bad baseline line: {raw.rstrip()}")
+            out[(parts[0], parts[1])] = int(parts[2])
+    return out
+
+
+def write_baseline(path: str, findings) -> None:
+    counts = _counts(findings)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(
+            "# raftlint baseline: accepted pre-existing findings as\n"
+            "# `<path> <rule> <count>` — the gate fails only on counts\n"
+            "# ABOVE these.  Shrink it whenever you clean a finding up;\n"
+            "# never grow it to sneak new debt in.\n"
+        )
+        for (p, rule), n in sorted(counts.items()):
+            f.write(f"{p} {rule} {n}\n")
+
+
+def gate(findings, baseline: Dict[Tuple[str, str], int]):
+    """(new_findings, stale_entries): findings beyond baseline counts, and
+    baseline entries whose debt shrank (candidates for ratcheting down)."""
+    counts = _counts(findings)
+    new: List[Finding] = []
+    for (path, rule), n in sorted(counts.items()):
+        allowed = baseline.get((path, rule), 0)
+        if n > allowed:
+            per = [f for f in findings if f.path == path and f.rule == rule]
+            # report the whole group: line numbers drift, so naming
+            # exactly the "new" ones is guesswork — show all candidates
+            new.extend(per)
+    stale = [
+        (path, rule, allowed, counts.get((path, rule), 0))
+        for (path, rule), allowed in sorted(baseline.items())
+        if counts.get((path, rule), 0) < allowed
+    ]
+    return new, stale
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="raftlint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="*", default=["dragonboat_tpu"])
+    ap.add_argument("--baseline", default=None, help="baseline file to gate against")
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths or ["dragonboat_tpu"])
+    if args.update_baseline:
+        if not args.baseline:
+            ap.error("--update-baseline requires --baseline")
+        write_baseline(args.baseline, findings)
+        print(f"raftlint: baseline written ({len(findings)} findings)")
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    new, stale = gate(findings, baseline)
+    for f in new:
+        print(f.render())
+    for path, rule, allowed, now in stale:
+        print(
+            f"raftlint: note: baseline for {path} {rule} is {allowed}, "
+            f"tree has {now} — ratchet it down",
+            file=sys.stderr,
+        )
+    if new:
+        print(
+            f"raftlint: {len(new)} unbaselined finding(s) "
+            f"({len(findings)} total, baseline covers "
+            f"{sum(baseline.values())})",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"raftlint: clean ({len(findings)} finding(s), all baselined)"
+        if findings
+        else "raftlint: clean"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,7 +130,7 @@ class LatencyBudget:
         self.cap = cap
         self.try_factor = try_factor
         self.attempts = attempts
-        self._lat = deque(maxlen=window)
+        self._lat = deque(maxlen=window)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, secs: float) -> None:

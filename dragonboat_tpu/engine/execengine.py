@@ -23,6 +23,7 @@ import time
 from typing import Dict, List, Optional, TYPE_CHECKING
 
 from ..logger import get_logger
+from ..metrics import MetricsRegistry
 from ..utils.stopper import Stopper
 
 if TYPE_CHECKING:
@@ -126,8 +127,6 @@ class ExecEngine:
         step_engine: Optional[IStepEngine] = None,
         metrics=None,
     ):
-        from ..metrics import MetricsRegistry
-
         self.logdb = logdb
         # a disabled registry no-ops every record call, so the worker
         # loop needs no metrics-enabled branch; resolve the instruments

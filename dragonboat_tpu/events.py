@@ -10,6 +10,15 @@ and the warning names the callback that lost its event.
 sees every SYSTEM event at post time, including ones the bounded queue
 would drop — a recorder that misses state transitions under pressure
 would be useless exactly when it matters.
+
+Thread-safety is by construction, not by lock: ``_q``/``_stop`` are
+inherently thread-safe, and the listener/tap fields are written once in
+``__init__`` and only read afterwards — so there is nothing here for a
+``# guarded-by:`` annotation to guard.  The discipline that DOES bind
+this module is raftlint's ``block-under-lock`` rule: the PR 4 close()
+deadlock (a blocking ``put`` wedged against a full queue) is its seeded
+true-positive fixture (tests/test_analysis.py), and the non-blocking
+``put_nowait``/timed-``get`` shape below is the sanctioned pattern.
 """
 from __future__ import annotations
 

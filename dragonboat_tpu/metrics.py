@@ -216,28 +216,38 @@ class MetricsRegistry:
                 typed.add(base)
                 out.append(f"# TYPE {base} {kind}")
 
+        # snapshot the instrument lists under the lock, format OUTSIDE
+        # it: Gauge.get() runs arbitrary user callbacks that routinely
+        # take other locks (NodeHost gauges take _nodes_lock), and
+        # calling out of a critical section is a lock-order edge away
+        # from a deadlock (raftlint block-under-lock finding; the
+        # lockcheck witness graphs exactly this edge).  Value reads are
+        # the usual GIL-benign races.
         with self._lock:
-            for c in sorted(self._counters.values(), key=lambda x: x.name):
-                type_line(c.name, "counter")
-                out.append(f"{c.name} {c.value}")
-            for g in sorted(self._gauges.values(), key=lambda x: x.name):
-                type_line(g.name, "gauge")
-                out.append(f"{g.name} {g.get()}")
-            for h in sorted(self._hists.values(), key=lambda x: x.name):
-                type_line(h.name, "histogram")
-                base = _base_name(h.name)
-                # merge any labels into the bucket brace set: the le
-                # label must join the series labels, not follow them
-                inner = h.name[len(base):].strip("{}")
-                pre = f"{inner}," if inner else ""
-                acc = 0
-                for i, b in enumerate(h.bounds):
-                    acc += h.buckets[i]
-                    out.append(f'{base}_bucket{{{pre}le="{b}"}} {acc}')
-                out.append(f'{base}_bucket{{{pre}le="+Inf"}} {h.count}')
-                suffix = f"{{{inner}}}" if inner else ""
-                out.append(f"{base}_sum{suffix} {h.total}")
-                out.append(f"{base}_count{suffix} {h.count}")
+            counters = sorted(self._counters.values(), key=lambda x: x.name)
+            gauges = sorted(self._gauges.values(), key=lambda x: x.name)
+            hists = sorted(self._hists.values(), key=lambda x: x.name)
+        for c in counters:
+            type_line(c.name, "counter")
+            out.append(f"{c.name} {c.value}")
+        for g in gauges:
+            type_line(g.name, "gauge")
+            out.append(f"{g.name} {g.get()}")
+        for h in hists:
+            type_line(h.name, "histogram")
+            base = _base_name(h.name)
+            # merge any labels into the bucket brace set: the le
+            # label must join the series labels, not follow them
+            inner = h.name[len(base):].strip("{}")
+            pre = f"{inner}," if inner else ""
+            acc = 0
+            for i, b in enumerate(h.bounds):
+                acc += h.buckets[i]
+                out.append(f'{base}_bucket{{{pre}le="{b}"}} {acc}')
+            out.append(f'{base}_bucket{{{pre}le="+Inf"}} {h.count}')
+            suffix = f"{{{inner}}}" if inner else ""
+            out.append(f"{base}_sum{suffix} {h.total}")
+            out.append(f"{base}_count{suffix} {h.count}")
         return "\n".join(out) + "\n"
 
 

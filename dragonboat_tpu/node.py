@@ -10,14 +10,17 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .client import Session
 from .config import Config
+from .invariants import check
 from .logger import get_logger
 from .pb import (
     Bootstrap,
+    CompressionType,
     ConfigChange,
     ConfigChangeType,
     Entry,
@@ -33,6 +36,7 @@ from .pb import (
 from .raft.peer import Peer
 from .raft.quiesce import QuiesceManager
 from .raft.read_index import ReadIndex as _DeviceReadIndex
+from .raftio import EntryInfo, NodeInfoEvent, SnapshotInfo
 from .request import (
     PendingConfigChange,
     PendingLeaderTransfer,
@@ -43,9 +47,18 @@ from .request import (
     SystemBusy,
 )
 from .rsm.managed import wrap_state_machine
-from .rsm.statemachine import ApplyResult, StateMachine, Task, TaskType
+from .rsm.statemachine import (
+    ApplyResult,
+    SnapshotFileCollection,
+    StateMachine,
+    Task,
+    TaskType,
+)
 from .statemachine import Result
 from .storage.logdb import LogDBLogReader
+from .storage.snapshotio import SnapshotReader, _try_snappy
+
+_SYSRAND = random.SystemRandom()
 
 _log = get_logger("nodehost")
 
@@ -157,14 +170,14 @@ class Node:
         # 50k replica rows the seven deques alone were ~250 MB of idle
         # host footprint
         self._qlock = threading.Lock()
-        self._received: list = []
-        self._proposals: list = []  # Entry
-        self._read_indexes: list = []  # SystemCtx
-        self._config_changes: list = []  # (key, ConfigChange)
-        self._cc_to_apply: list = []  # (ConfigChange|None, accepted)
-        self._snapshot_reqs: list = []  # (key, overhead)
-        self._leader_transfers: list = []  # target
-        self._pending_ticks = 0
+        self._received: list = []  # guarded-by: _qlock
+        self._proposals: list = []  # Entry; guarded-by: _qlock
+        self._read_indexes: list = []  # SystemCtx; guarded-by: _qlock
+        self._config_changes: list = []  # (key, ConfigChange); guarded-by: _qlock
+        self._cc_to_apply: list = []  # (ConfigChange|None, accepted); guarded-by: _qlock
+        self._snapshot_reqs: list = []  # (key, overhead); guarded-by: _qlock
+        self._leader_transfers: list = []  # target; guarded-by: _qlock
+        self._pending_ticks = 0  # guarded-by: _qlock
         # single-writer tick lane: the HOST TICKER is the only writer of
         # _ticks_in and the owning step worker the only writer of
         # _ticks_taken, so the per-tick fan-out needs NO lock — at 50k
@@ -182,24 +195,33 @@ class Node:
         # proposal that may never commit (observed as acked-write loss in
         # chaos).  The reference seeds its key generator randomly per
         # start [U]; 47 random bits leave the counter ~2^47 of headroom.
-        import random as _random
-
-        _rand = _random.SystemRandom()
-
+        # request._PendingBase randomizes its own base when none is given;
+        # the replica-id salt here additionally makes CROSS-REPLICA
+        # distinctness structural (top bits differ by construction, not
+        # by luck), closing the ROADMAP cross-replica collision window —
+        # ALL five tables get a base, snapshot/transfer included.
         def key_base() -> int:
-            # 61 bits: read-index ctx keys must split into two sub-2^31
-            # halves for the device inbox (request.PendingReadIndex.read)
-            return ((config.replica_id & 0xFFF) << 48) | _rand.getrandbits(47)
+            # 60 bits (< request.KEY_BASE_BITS): read-index ctx keys must
+            # split into two sub-2^31 halves for the device inbox
+            # (request.PendingReadIndex.read)
+            return ((config.replica_id & 0xFFF) << 48) | _SYSRAND.getrandbits(47)
 
         _tables_lock = threading.Lock()  # shared: see _PendingBase
-        self.pending_proposal = PendingProposal(_tables_lock)
-        self.pending_proposal._next_key = key_base()
-        self.pending_read_index = PendingReadIndex(_tables_lock)
-        self.pending_read_index._next_key = key_base()
-        self.pending_config_change = PendingConfigChange(_tables_lock)
-        self.pending_config_change._next_key = key_base()
-        self.pending_snapshot = PendingSnapshot(_tables_lock)
-        self.pending_leader_transfer = PendingLeaderTransfer(_tables_lock)
+        self.pending_proposal = PendingProposal(
+            _tables_lock, key_base=key_base()
+        )
+        self.pending_read_index = PendingReadIndex(
+            _tables_lock, key_base=key_base()
+        )
+        self.pending_config_change = PendingConfigChange(
+            _tables_lock, key_base=key_base()
+        )
+        self.pending_snapshot = PendingSnapshot(
+            _tables_lock, key_base=key_base()
+        )
+        self.pending_leader_transfer = PendingLeaderTransfer(
+            _tables_lock, key_base=key_base()
+        )
         # ctx/quorum table for DEVICE-resident reads (ops/engine.py): the
         # kernel serves the protocol (gate + ctx heartbeats); the host
         # tracks which voters echoed each ctx.  Scalar-path reads use
@@ -348,6 +370,7 @@ class Node:
         finding: the table must mirror has_work, not just the two hot
         tables).  Lock-free reads — a producer racing in also calls
         wake(), which unparks immediately."""
+        # raftlint: ignore[guarded-by] lock-free probe; ticker re-checks under lock
         return (
             self.quiesce.enabled
             and self.quiesce.quiesced
@@ -514,6 +537,7 @@ class Node:
     def queued_inputs(self) -> int:
         """Depth of the step input queues (lock-free snapshot; scrape-
         time observability — same benign races as has_work)."""
+        # raftlint: ignore[guarded-by] lock-free scrape-time snapshot
         return (
             len(self._received)
             + len(self._proposals)
@@ -527,6 +551,7 @@ class Node:
     def tick_lag(self) -> int:
         """Ticks granted by the host but not yet consumed by step
         (the engine-backlog signal; lock-free)."""
+        # raftlint: ignore[guarded-by] lock-free scrape-time snapshot
         return (self._ticks_in - self._ticks_taken) + self._pending_ticks
 
     def has_work(self) -> bool:
@@ -536,6 +561,7 @@ class Node:
         # coalesce scan calls this once per resident node per launch
         # generation, and the lock acquisition alone was ~60% of a
         # 294 s coalesce bill at 50k rows (SCALE_r05)
+        # raftlint: ignore[guarded-by] lock-free hint; drain under _qlock linearizes
         if (
             self._received
             or self._proposals
@@ -926,8 +952,6 @@ class Node:
         if not u.snapshot.is_empty():
             self._install_snapshot(u.snapshot)
         if u.entries_to_save:
-            from .invariants import check
-
             ents = u.entries_to_save
             check(
                 all(
@@ -1015,8 +1039,6 @@ class Node:
                     self.notify_work()
                 self.pending_config_change.applied(e.key, r.rejected)
                 if self.events is not None and not r.rejected:
-                    from .raftio import NodeInfoEvent
-
                     self.events.membership_changed(
                         NodeInfoEvent(self.shard_id, self.replica_id)
                     )
@@ -1074,8 +1096,6 @@ class Node:
         membership through it, resolving external files to absolute
         paths in the snapshot dir (reference: rsm recover +
         ISnapshotFileCollection restore [U])."""
-        from .storage.snapshotio import SnapshotReader
-
         f = self.snapshot_storage.open_read(ss.filepath)
         try:
             reader = SnapshotReader(f)
@@ -1120,8 +1140,6 @@ class Node:
             raise
         self._sync_registry(ss.membership)
         if self.events is not None:
-            from .raftio import SnapshotInfo
-
             self.events.snapshot_recovered(
                 SnapshotInfo(self.shard_id, self.replica_id, ss.replica_id, ss.index)
             )
@@ -1135,14 +1153,9 @@ class Node:
         Compression now lives INSIDE the v2 container (per block, self-
         describing), so cross-host recovery never depends on out-of-band
         metadata surviving the chunk lane."""
-        from .pb import CompressionType as CT
-
-        want = CT(self.config.snapshot_compression)
-        if want == CT.SNAPPY:
-            from .storage.snapshotio import _try_snappy
-
-            if _try_snappy() is None:
-                return CT.ZLIB  # meta records what is actually used
+        want = CompressionType(self.config.snapshot_compression)
+        if want == CompressionType.SNAPPY and _try_snappy() is None:
+            return CompressionType.ZLIB  # meta records what is actually used
         return want
 
     def _save_snapshot_request(self, key: int, overhead: int) -> None:
@@ -1168,8 +1181,6 @@ class Node:
                 compression = self._snapshot_compression()
 
             def build(fileobj, copy_fn):
-                from .rsm.statemachine import SnapshotFileCollection
-
                 coll = SnapshotFileCollection(copy_fn)
                 # the SM streams through the v2 block writer with
                 # bounded memory (storage/snapshotio.py); external
@@ -1234,8 +1245,6 @@ class Node:
             if key:
                 self.pending_snapshot.done(key, index)
             if self.events is not None:
-                from .raftio import SnapshotInfo, EntryInfo
-
                 self.events.snapshot_created(
                     SnapshotInfo(self.shard_id, self.replica_id, 0, index)
                 )

@@ -84,14 +84,15 @@ class NodeHost:
     def __init__(self, config: NodeHostConfig):
         config.validate()
         self.config = config
-        self._nodes: Dict[int, Node] = {}  # shard_id -> node (one replica/shard)
+        # shard_id -> node (one replica/shard); guarded-by: _nodes_lock
+        self._nodes: Dict[int, Node] = {}
         # quiesce tick-parking: quiesced-idle nodes leave the active
         # tick set entirely (their logical clocks freeze) and rejoin via
         # node.wake() when any producer touches them — the host-side
         # analogue of the reference's 'millions of idle groups cost ~0'
         # (quiesce + workReady [U]); at 50k rows the flat per-tick
         # fan-out alone was ~1M lock-ops/sec of pure Python
-        self._parked: Dict[int, Node] = {}  # shard_id -> parked node
+        self._parked: Dict[int, Node] = {}  # shard_id -> parked node; guarded-by: _nodes_lock
         self._global_ticks = 0
         self._nodes_lock = threading.RLock()
         self._closed = False
@@ -363,6 +364,7 @@ class NodeHost:
     def _wake_node(self, node) -> None:
         """Producer-side unpark (node.wake): rejoin the active tick set
         and credit the ticks that elapsed while parked."""
+        # raftlint: ignore[guarded-by] lock-free fast path; see below
         if node.shard_id not in self._parked:
             # lock-free fast path: wake() rides EVERY producer call
             # (propose, enqueue_received, ...); taking the host-global

@@ -20,6 +20,14 @@ import enum
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+# 64-bit width policy (docs/PARITY.md): every protocol integer is a
+# uint64, as in the reference's raftpb.  Host structures carry Python's
+# unbounded ints, so every serialization boundary masks with MASK64 —
+# encode wraps like the reference's uint64 arithmetic instead of raising
+# struct.error mid-persist.  raftlint's `width-64` rule pins the policy
+# at the codec pack sites.
+MASK64 = 0xFFFFFFFFFFFFFFFF
+
 
 class MessageType(enum.IntEnum):
     """Raft message types (reference: raftpb MessageType enum [U]).

@@ -9,6 +9,7 @@ path, so behavior is reproducible and cheap at high request rates.
 from __future__ import annotations
 
 import enum
+import random
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -16,6 +17,28 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .client import Session
 from .pb import Entry, EntryType, SystemCtx
 from .statemachine import Result
+
+# Pending-table keys ride Entry.key across every boundary as a uint64
+# (transport/wire._w_entry, the tan WAL, kvlogdb — docs/PARITY.md 64-bit
+# policy), and read-index keys additionally split into two sub-2^31
+# SystemCtx halves for the device inbox's int32 hint lanes
+# (PendingReadIndex.read).  Bases are therefore 61-bit: keys stay below
+# 2^62 with >= 2^61 increments of headroom, the low/high ctx split stays
+# injective, and the wire codecs never see an out-of-range value.
+KEY_BASE_BITS = 61
+_SYSRAND = random.SystemRandom()
+
+
+def random_key_base() -> int:
+    """Random per-table key base (reference: every node seeds its
+    keyGenerator randomly at start [U]).  Sequential-from-zero keys were
+    the ROADMAP latent: every table of every replica counted 1, 2, 3 …,
+    so a follower's brief in-flight local proposal could share a key
+    with a leader-origin committed entry and ``applied(e.key, …)`` would
+    complete the WRONG future — a false ack.  With per-table random
+    bases a cross-table/cross-replica/cross-incarnation collision needs
+    the counters' live windows to overlap within ~2^61."""
+    return _SYSRAND.getrandbits(KEY_BASE_BITS)
 
 
 class RequestError(Exception):
@@ -94,13 +117,21 @@ class RequestState:
 class _PendingBase:
     __slots__ = ("_lock", "_next_key", "_pending")
 
-    def __init__(self, lock: Optional[threading.Lock] = None):
+    def __init__(
+        self,
+        lock: Optional[threading.Lock] = None,
+        key_base: Optional[int] = None,
+    ):
         # a node's five tables share one lock (pass it in): contention
         # is per-replica and tiny, while 4 saved locks x 50k rows is
         # real host footprint
         self._lock = lock if lock is not None else threading.Lock()
-        self._pending: Dict[int, RequestState] = {}
-        self._next_key = 0
+        self._pending: Dict[int, RequestState] = {}  # guarded-by: _lock
+        # randomized unless the owner supplies one (Node salts with the
+        # replica id); see random_key_base for why 0 was a correctness bug
+        self._next_key = (  # guarded-by: _lock
+            random_key_base() if key_base is None else key_base
+        )
 
     def _alloc(self, deadline: int) -> RequestState:
         with self._lock:
@@ -119,6 +150,7 @@ class _PendingBase:
             rs.notify(RequestResultCode.DROPPED)
 
     def gc(self, now_tick: int) -> None:
+        # raftlint: ignore[guarded-by] lock-free empty probe (benign race, see below)
         if not self._pending:
             # lock-free empty check: the sweep runs five-tables deep per
             # tick per replica row — at 50k rows that is millions of
@@ -134,7 +166,7 @@ class _PendingBase:
             if expired:
                 self._gc_extra(set(expired))
 
-    def _gc_extra(self, expired_keys) -> None:
+    def _gc_extra(self, expired_keys) -> None:  # guarded-by: _lock
         """Subclass hook, called under self._lock, to drop side-table state
         for expired keys."""
 
@@ -212,12 +244,16 @@ class PendingReadIndex(_PendingBase):
     quorum -> learn the read index; (2) applied index reaches it ->
     complete."""
 
-    def __init__(self, lock: Optional[threading.Lock] = None):
-        super().__init__(lock)
-        self._ctx_map: Dict[Tuple[int, int], int] = {}  # ctx -> key
-        self._waiting: List[Tuple[int, int]] = []  # (read_index, key)
+    def __init__(
+        self,
+        lock: Optional[threading.Lock] = None,
+        key_base: Optional[int] = None,
+    ):
+        super().__init__(lock, key_base)
+        self._ctx_map: Dict[Tuple[int, int], int] = {}  # ctx->key; guarded-by: _lock
+        self._waiting: List[Tuple[int, int]] = []  # (read_index, key); guarded-by: _lock
 
-    def _gc_extra(self, expired_keys) -> None:
+    def _gc_extra(self, expired_keys) -> None:  # guarded-by: _lock
         self._ctx_map = {
             c: k for c, k in self._ctx_map.items() if k not in expired_keys
         }

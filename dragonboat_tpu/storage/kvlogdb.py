@@ -21,7 +21,7 @@ from collections import OrderedDict
 from io import BytesIO
 from typing import Dict, List, Optional, Tuple
 
-from ..pb import Bootstrap, Entry, Snapshot, State, Update
+from ..pb import MASK64, Bootstrap, Entry, Snapshot, State, Update
 from ..raftio import ILogDB, NodeInfo, RaftState
 from ..transport.wire import (
     _R,
@@ -49,11 +49,13 @@ CACHE_RECORDS = 512
 
 
 def _pk(kind: int, shard_id: int, replica_id: int) -> bytes:
-    return _pair.pack(kind, shard_id, replica_id)
+    return _pair.pack(kind, shard_id & MASK64, replica_id & MASK64)
 
 
 def _ek(shard_id: int, replica_id: int, index: int) -> bytes:
-    return _entry_key.pack(K_ENTRY, shard_id, replica_id, index)
+    return _entry_key.pack(
+        K_ENTRY, shard_id & MASK64, replica_id & MASK64, index & MASK64
+    )
 
 
 def _enc_entries(entries: List[Entry]) -> bytes:
@@ -70,7 +72,9 @@ def _dec_entries(data: bytes) -> List[Entry]:
 
 
 def _enc_state(st: State) -> bytes:
-    return struct.pack("<QQQ", st.term, st.vote, st.commit)
+    return struct.pack(
+        "<QQQ", st.term & MASK64, st.vote & MASK64, st.commit & MASK64
+    )
 
 
 def _dec_state(data: bytes) -> State:
@@ -82,7 +86,7 @@ def _enc_bootstrap(bs: Bootstrap) -> bytes:
     b = BytesIO()
     b.write(struct.pack("<I", len(bs.addresses)))
     for rid in sorted(bs.addresses):
-        b.write(struct.pack("<Q", rid))
+        b.write(struct.pack("<Q", rid & MASK64))
         raw = bs.addresses[rid].encode("utf-8")
         b.write(struct.pack("<I", len(raw)))
         b.write(raw)
@@ -333,7 +337,10 @@ class ShardedKVLogDB(ILogDB):
         for i in range(0, len(keep), self.batch_size):
             run = keep[i : i + self.batch_size]
             wb.put(_ek(shard_id, replica_id, run[0].index), _enc_entries(run))
-        wb.put(_pk(K_MININDEX, shard_id, replica_id), struct.pack("<Q", index + 1))
+        wb.put(
+            _pk(K_MININDEX, shard_id, replica_id),
+            struct.pack("<Q", (index + 1) & MASK64),
+        )
         store.commit(wb, sync=False)  # advisory, like the tan path
         self._bump(shard_id, replica_id)  # invalidate AFTER the commit
 
@@ -390,7 +397,7 @@ class ShardedKVLogDB(ILogDB):
         )
         wb.put(
             _pk(K_MININDEX, s, replica_id),
-            struct.pack("<Q", snapshot.index + 1),
+            struct.pack("<Q", (snapshot.index + 1) & MASK64),
         )
         self._store(s).commit(wb)
         self._bump(s, replica_id)  # invalidate AFTER the commit

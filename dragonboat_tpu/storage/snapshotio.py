@@ -30,7 +30,7 @@ import zlib
 from io import BytesIO
 from typing import BinaryIO, List, Optional
 
-from ..pb import CompressionType, Membership, SnapshotFile
+from ..pb import MASK64, CompressionType, Membership, SnapshotFile
 from ..transport.wire import (
     WireError,
     _R,
@@ -190,7 +190,7 @@ class SnapshotWriter:
         f.write(_u32.pack(len(table)))
         f.write(_u32.pack(zlib.crc32(table)))
         f.write(table)
-        head = struct.pack("<QQ", self._sm_size, table_off)
+        head = struct.pack("<QQ", self._sm_size & MASK64, table_off & MASK64)
         f.write(head)
         f.write(_u32.pack(zlib.crc32(head)))
         f.write(_u32.pack(MAGIC))
@@ -305,7 +305,7 @@ class SnapshotReader:
         sm_size, table_off, tcrc, tmagic = _trailer.unpack(
             f.read(_trailer.size)
         )
-        head = struct.pack("<QQ", sm_size, table_off)
+        head = struct.pack("<QQ", sm_size & MASK64, table_off & MASK64)
         if tmagic != MAGIC or zlib.crc32(head) != tcrc:
             raise SnapshotCorruptError("trailer corrupt")
         self.sm_size = sm_size

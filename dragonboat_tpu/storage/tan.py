@@ -25,7 +25,7 @@ from io import BytesIO
 from typing import List, Optional
 
 from ..logger import get_logger
-from ..pb import Bootstrap, Entry, Snapshot, State, Update
+from ..pb import MASK64, Bootstrap, Entry, Snapshot, State, Update
 from ..raftio import ILogDB, NodeInfo
 from ..transport.wire import (
     MAX_PAYLOAD,
@@ -70,7 +70,8 @@ class CorruptLogError(CorruptJournalError):
 
 
 def _wu64(b: BytesIO, v: int) -> None:
-    b.write(_u64.pack(v))
+    # mask, don't raise: uint64 wraparound parity (pb.MASK64 policy)
+    b.write(_u64.pack(v & MASK64))
 
 
 def _wb(b: BytesIO, v: bytes) -> None:

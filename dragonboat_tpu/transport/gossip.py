@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..id import is_nodehost_id
 from ..logger import get_logger
+from ..pb import MASK64
 from .registry import Registry
 from .tcp import parse_address
 
@@ -46,7 +47,7 @@ def _encode_row(nhid: str, addr: str, ver: int) -> bytes:
         raw = s.encode("utf-8")
         b.write(_u32.pack(len(raw)))
         b.write(raw)
-    b.write(_u64.pack(ver))
+    b.write(_u64.pack(ver & MASK64))
     return b.getvalue()
 
 

@@ -29,6 +29,7 @@ from ..pb import (
     ConfigChangeType,
     Entry,
     EntryType,
+    MASK64,
     MESSAGE_BATCH_BIN_VER,
     Membership,
     Message,
@@ -101,7 +102,8 @@ def bounded_decompress(payload: bytes, max_out: int) -> bytes:
 # primitives
 # ---------------------------------------------------------------------------
 def _wu64(b: BytesIO, v: int) -> None:
-    b.write(_u64.pack(v))
+    # mask, don't raise: uint64 wraparound parity (pb.MASK64 policy)
+    b.write(_u64.pack(v & MASK64))
 
 
 def _wu32(b: BytesIO, v: int) -> None:

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Tier-1 verify: the ROADMAP.md command, verbatim, runnable from any cwd,
-# plus the observability smoke (scripts/obs_smoke.sh) as a cheap (~5s)
-# post-step.  Prints DOTS_PASSED=<n> and exits non-zero if either fails.
+# plus two cheap post-steps: the observability smoke (scripts/obs_smoke.sh,
+# ~5s) and the raftlint gate + analyzer self-tests (scripts/lint.sh, <60s).
+# Prints DOTS_PASSED=<n> and exits non-zero if any step fails.
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 timeout -k 10 120 bash scripts/obs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 200 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

@@ -14,6 +14,15 @@ import sys
 # build-tag-gated internal/invariants checks enabled in CI builds [U])
 os.environ.setdefault("DRAGONBOAT_TPU_INVARIANTS", "1")
 
+# run the chaos/fault test modules under the lock-order witness
+# (analysis/lockcheck, docs/ANALYSIS.md): any lock-order cycle a chaotic
+# schedule merely GRAZES — even if this run got lucky with timing —
+# fails the test with both witness stacks.  Same env-gate pattern as
+# invariants; set =0 to opt out.  Scoped to the modules that churn
+# clusters hardest rather than suite-wide to bound the tier-1 budget
+# (overhead tracked by bench.phase_lockcheck).
+os.environ.setdefault("DRAGONBOAT_TPU_LOCKCHECK", "1")
+
 # NOTE: this image's sitecustomize imports jax at interpreter start to
 # register the TPU tunnel plugin, so mutating JAX_PLATFORMS here is too
 # late — pin the backend via jax.config before first backend init instead.
@@ -43,3 +52,40 @@ def pytest_configure(config):
         "markers",
         "slow: long-running soak/chaos schedules (tier-1 runs -m 'not slow')",
     )
+
+
+# -- lock-order witness for the chaos/fault modules -----------------------
+_LOCKCHECK_MODULES = frozenset(
+    ("test_chaos", "test_chaos_extended", "test_chaos_colocated", "test_faults")
+)
+
+
+def _lockcheck_wanted(item) -> bool:
+    from dragonboat_tpu.analysis import lockcheck
+
+    mod = getattr(item, "module", None)
+    return lockcheck.ENABLED and getattr(mod, "__name__", "") in _LOCKCHECK_MODULES
+
+
+def pytest_runtest_setup(item):
+    if _lockcheck_wanted(item):
+        from dragonboat_tpu.analysis import lockcheck
+
+        item._lockcheck_witness = lockcheck.install()
+
+
+def pytest_runtest_teardown(item, nextitem):
+    w = getattr(item, "_lockcheck_witness", None)
+    if w is None:
+        return
+    del item._lockcheck_witness
+    from dragonboat_tpu.analysis import lockcheck
+    import pytest as _pytest
+
+    lockcheck.uninstall()
+    if w.cycles:
+        _pytest.fail(
+            "lock-order witness: cycle(s) recorded during this test\n"
+            + w.format_cycles(),
+            pytrace=False,
+        )

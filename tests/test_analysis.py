@@ -1,0 +1,556 @@
+"""analysis/ correctness-tooling tests: raftlint true-positive fixtures
+(every rule must catch a seeded violation), baseline/ignore machinery,
+the zero-unbaselined-findings tree gate, and the lock-order witness
+(cycle detection with witness stacks, slow-wait flagging, Condition
+integration, install/uninstall hygiene)."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragonboat_tpu.analysis import lockcheck, raftlint
+from dragonboat_tpu.analysis.raftlint import (
+    Finding,
+    gate,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+GUARDED_SRC = '''
+import threading
+
+class Node:
+    def __init__(self):
+        self._qlock = threading.Lock()
+        self._proposals = []  # guarded-by: _qlock
+
+    def ok(self, e):
+        with self._qlock:
+            self._proposals.append(e)
+
+    def bad(self, e):
+        self._proposals.append(e)  # unlocked access
+
+    def held_throughout(self):  # guarded-by: _qlock
+        return len(self._proposals)
+'''
+
+
+def test_guarded_by_catches_unlocked_access():
+    fs = lint_source(GUARDED_SRC, "dragonboat_tpu/node.py")
+    assert [f.rule for f in fs] == ["guarded-by"]
+    (f,) = fs
+    assert "_proposals" in f.message and "_qlock" in f.message
+    # the finding names the unlocked line in bad(), not ok()/__init__
+    assert "self._proposals.append(e)  # unlocked access" in (
+        GUARDED_SRC.splitlines()[f.line - 1]
+    )
+
+
+def test_guarded_by_def_annotation_declares_lock_held():
+    # held_throughout carries the def-line annotation -> no finding there
+    fs = lint_source(GUARDED_SRC, "dragonboat_tpu/node.py")
+    assert all("held_throughout" not in GUARDED_SRC.splitlines()[f.line - 1]
+               for f in fs)
+
+
+def test_guarded_by_ignore_comment_suppresses():
+    src = GUARDED_SRC.replace(
+        "self._proposals.append(e)  # unlocked access",
+        "self._proposals.append(e)  # raftlint: ignore[guarded-by] test",
+    )
+    assert lint_source(src, "dragonboat_tpu/node.py") == []
+
+
+def test_guarded_by_ignore_next_line_style():
+    src = GUARDED_SRC.replace(
+        "        self._proposals.append(e)  # unlocked access",
+        "        # raftlint: ignore[guarded-by] reason\n"
+        "        self._proposals.append(e)",
+    )
+    assert lint_source(src, "dragonboat_tpu/node.py") == []
+
+
+def test_guarded_by_annotation_above_assignment():
+    src = '''
+class H:
+    def __init__(self):
+        self._lock = __import__("threading").Lock()
+        # shard map; guarded-by: _lock
+        self._nodes = {}
+
+    def bad(self):
+        return self._nodes.get(1)
+'''
+    fs = lint_source(src, "dragonboat_tpu/nodehost.py")
+    assert rules_of(fs) == {"guarded-by"}
+
+
+def test_guarded_by_rejects_holding_another_objects_lock():
+    """Holding a PEER object's same-named lock must NOT satisfy the
+    guard — mutating one node's _qlock-guarded queue while holding
+    another node's _qlock is exactly the bug class the rule exists to
+    catch (review finding)."""
+    src = '''
+import threading
+
+class Node:
+    def __init__(self):
+        self._qlock = threading.Lock()
+        self._items = []  # guarded-by: _qlock
+
+    def cross_drain(self, other):
+        with other._qlock:
+            self._items.append(1)
+'''
+    fs = lint_source(src, "dragonboat_tpu/node.py")
+    assert rules_of(fs) == {"guarded-by"}
+
+
+def test_guarded_by_lambda_body_is_not_covered_by_enclosing_with():
+    # a lambda defined under the lock RUNS later, without it
+    src = '''
+class H:
+    def __init__(self):
+        self._lock = __import__("threading").Lock()
+        self._m = {}  # guarded-by: _lock
+
+    def arm(self, reg):
+        with self._lock:
+            reg.gauge("x", lambda: len(self._m))
+'''
+    fs = lint_source(src, "dragonboat_tpu/nodehost.py")
+    assert rules_of(fs) == {"guarded-by"}
+
+
+# ---------------------------------------------------------------------------
+# block-under-lock — incl. the PR 4 EventFanout deadlock reconstruction
+# ---------------------------------------------------------------------------
+EVENTFANOUT_PR4_SRC = '''
+import queue
+import threading
+
+class EventFanout:
+    """Reconstruction of the PR 4 close() deadlock: a BLOCKING put on a
+    full queue while holding the fanout lock — the drain thread exits
+    via the stop flag with the queue still full, so the put never
+    returns and close() hangs forever."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(maxsize=2)
+        self._thread = threading.Thread(target=self._main, daemon=True,
+                                        name="ev")
+
+    def close(self):
+        with self._lock:
+            self._q.put(None)      # the deadlock: blocking put under lock
+            self._thread.join()    # and an unbounded join under lock
+'''
+
+
+def test_block_under_lock_catches_pr4_eventfanout_shape():
+    fs = lint_source(EVENTFANOUT_PR4_SRC, "dragonboat_tpu/events.py")
+    msgs = [f.message for f in fs if f.rule == "block-under-lock"]
+    assert len(msgs) == 2
+    assert any(".put()" in m for m in msgs)
+    assert any(".join()" in m for m in msgs)
+
+
+def test_block_under_lock_allows_nowait_timeout_and_unlocked():
+    src = '''
+class F:
+    def ok(self):
+        with self._lock:
+            self._q.put_nowait(None)
+            self._q.put(None, timeout=0.5)
+            self._q.get(timeout=0.2)
+            self._thread.join(timeout=1.0)
+    def also_ok(self):
+        self._q.put(None)  # not under a lock: fine
+'''
+    assert lint_source(src, "dragonboat_tpu/events.py") == []
+
+
+def test_block_under_lock_sleep_and_zero_arg_get():
+    src = '''
+import time
+class F:
+    def bad(self):
+        with self._mu:
+            time.sleep(0.1)
+            item = self._q.get()
+'''
+    fs = lint_source(src, "dragonboat_tpu/x.py")
+    assert len([f for f in fs if f.rule == "block-under-lock"]) == 2
+
+
+def test_lockish_names_are_segment_anchored():
+    """`clock`/`block`/`unlock` context managers are NOT locks — an
+    unanchored lock$ match would force bogus ignores (review finding)."""
+    src = '''
+import time
+class F:
+    def fine(self):
+        with self.clock:
+            time.sleep(0.1)
+        with self.block:
+            time.sleep(0.1)
+        with self.unlock:
+            time.sleep(0.1)
+    def caught(self):
+        with self._nodes_lock:
+            time.sleep(0.1)
+'''
+    fs = lint_source(src, "dragonboat_tpu/x.py")
+    assert len(fs) == 1 and fs[0].rule == "block-under-lock"
+
+
+# ---------------------------------------------------------------------------
+# determinism plane
+# ---------------------------------------------------------------------------
+def test_determinism_catches_wall_clock_and_global_rng():
+    src = '''
+import random
+import time
+
+def schedule():
+    t = time.time()
+    return t + random.random()
+'''
+    fs = lint_source(src, "dragonboat_tpu/faults.py")
+    assert len([f for f in fs if f.rule == "determinism"]) == 2
+
+
+def test_determinism_allows_seeded_rng_and_monotonic():
+    src = '''
+import random
+import time
+
+def schedule(seed):
+    rng = random.Random(seed)
+    deadline = time.monotonic() + rng.uniform(0, 1)
+    time.sleep(0.01)
+    return deadline
+'''
+    assert lint_source(src, "dragonboat_tpu/balance/planner.py") == []
+
+
+def test_determinism_rule_scoped_to_plane_modules():
+    src = "import time\nnow = time.time()\n"
+    assert lint_source(src, "dragonboat_tpu/metrics.py") == []
+    assert rules_of(lint_source(src, "dragonboat_tpu/faults.py")) == {
+        "determinism"
+    }
+
+
+# ---------------------------------------------------------------------------
+# width-64
+# ---------------------------------------------------------------------------
+def test_width64_catches_unmasked_q_pack():
+    src = '''
+import struct
+_u64 = struct.Struct("<Q")
+
+def encode(v):
+    return _u64.pack(v)
+'''
+    fs = lint_source(src, "dragonboat_tpu/transport/wire.py")
+    assert rules_of(fs) == {"width-64"}
+
+
+def test_width64_accepts_masked_len_and_literals():
+    src = '''
+import struct
+from ..pb import MASK64
+_u64 = struct.Struct("<Q")
+
+def encode(b, v, blob):
+    b.write(_u64.pack(v & MASK64))
+    b.write(struct.pack("<Q", len(blob)))
+    b.write(struct.pack("<QQ", 7, v & 0xFFFFFFFFFFFFFFFF))
+'''
+    assert lint_source(src, "dragonboat_tpu/transport/wire.py") == []
+
+
+def test_width64_maps_q_slots_in_mixed_formats():
+    src = '''
+import struct
+_hdr = struct.Struct(">BQQ")
+
+def key(kind, shard, replica):
+    return _hdr.pack(kind, shard, replica)
+'''
+    fs = lint_source(src, "dragonboat_tpu/storage/kvlogdb.py")
+    # the B slot (kind) is exempt; both Q slots flagged
+    assert len(fs) == 2 and rules_of(fs) == {"width-64"}
+
+
+# ---------------------------------------------------------------------------
+# hygiene: import-hot, bare-except, thread-discipline
+# ---------------------------------------------------------------------------
+def test_import_hot_flags_function_level_imports_in_hot_modules():
+    src = "def apply():\n    from .raftio import NodeInfoEvent\n    return 1\n"
+    assert rules_of(lint_source(src, "dragonboat_tpu/node.py")) == {
+        "import-hot"
+    }
+    assert rules_of(lint_source(src, "dragonboat_tpu/engine/execengine.py")) == {
+        "import-hot"
+    }
+    # cold modules may lazy-import (circularity breaks etc.)
+    assert lint_source(src, "dragonboat_tpu/tools.py") == []
+
+
+def test_bare_except_flagged_everywhere():
+    src = "try:\n    x = 1\nexcept:\n    pass\n"
+    assert rules_of(lint_source(src, "dragonboat_tpu/anything.py")) == {
+        "bare-except"
+    }
+
+
+def test_thread_discipline_requires_name_and_daemon():
+    src = '''
+import threading
+t = threading.Thread(target=print)
+u = threading.Thread(target=print, name="ok", daemon=True)
+'''
+    fs = lint_source(src, "dragonboat_tpu/x.py")
+    assert len(fs) == 2  # missing name AND missing daemon, once each
+    assert rules_of(fs) == {"thread-discipline"}
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery + the tree gate
+# ---------------------------------------------------------------------------
+def test_baseline_roundtrip_and_gate(tmp_path):
+    fs = [
+        Finding("a.py", 3, "bare-except", "m"),
+        Finding("a.py", 9, "bare-except", "m"),
+        Finding("b.py", 1, "width-64", "m"),
+    ]
+    p = tmp_path / "baseline.txt"
+    write_baseline(str(p), fs)
+    bl = load_baseline(str(p))
+    assert bl == {("a.py", "bare-except"): 2, ("b.py", "width-64"): 1}
+    # covered exactly -> no new findings
+    new, stale = gate(fs, bl)
+    assert new == [] and stale == []
+    # one more finding in a covered file -> the whole group is reported
+    new, _ = gate(fs + [Finding("a.py", 20, "bare-except", "m")], bl)
+    assert len(new) == 3 and all(f.path == "a.py" for f in new)
+    # debt shrank -> stale note for the ratchet
+    new, stale = gate(fs[1:], bl)
+    assert new == [] and stale == [("a.py", "bare-except", 2, 1)]
+
+
+def test_baseline_rejects_malformed_lines(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("a.py bare-except\n")
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+def test_tree_is_lint_clean_with_checked_in_baseline():
+    """THE gate, same invocation as scripts/lint.sh: zero unbaselined
+    findings over the package (+ bench.py)."""
+    old = os.getcwd()
+    os.chdir(REPO)
+    try:
+        findings = lint_paths(["dragonboat_tpu", "bench.py"])
+        baseline = load_baseline(
+            os.path.join(REPO, "dragonboat_tpu/analysis/baseline.txt")
+        )
+        new, _ = gate(findings, baseline)
+    finally:
+        os.chdir(old)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_real_tree_annotations_are_live():
+    """The seed guarded-by annotations actually register (the rule must
+    not be passing vacuously): stripping node.py's inline ignores must
+    surface the documented lock-free reads as findings."""
+    path = os.path.join(REPO, "dragonboat_tpu/node.py")
+    src = open(path).read()
+    assert lint_source(src, "dragonboat_tpu/node.py") == []
+    stripped = src.replace("# raftlint: ignore[guarded-by]", "# stripped")
+    fs = lint_source(stripped, "dragonboat_tpu/node.py")
+    assert len(fs) >= 8 and rules_of(fs) == {"guarded-by"}
+
+
+# ---------------------------------------------------------------------------
+# lockcheck: the dynamic witness
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def witness():
+    w = lockcheck.install(slow_wait_s=0.2)
+    try:
+        yield w
+    finally:
+        lockcheck.uninstall()
+
+
+def test_lockcheck_detects_inverted_two_lock_acquisition(witness):
+    """Deliberate ABBA: thread 1 takes A->B, thread 2 takes B->A.  The
+    witness must report a cycle with BOTH witness stacks even though the
+    schedule below never actually deadlocks."""
+    A = witness.make_lock("fixture:A")
+    B = witness.make_lock("fixture:B")
+    done = threading.Barrier(2, timeout=5)
+
+    def t1():
+        with A:
+            with B:
+                pass
+        done.wait()
+
+    def t2():
+        done.wait()  # strictly after t1: records B->A without deadlocking
+        with B:
+            with A:
+                pass
+
+    th1 = threading.Thread(target=t1, name="abba-1", daemon=True)
+    th2 = threading.Thread(target=t2, name="abba-2", daemon=True)
+    th1.start(); th2.start(); th1.join(5); th2.join(5)
+    r = witness.report()
+    assert len(r["cycles"]) == 1
+    cyc = r["cycles"][0]
+    assert len(cyc["edges"]) == 2  # both directions, each with its stack
+    for e in cyc["edges"]:
+        assert e["stack"], "witness stack missing"
+    text = witness.format_cycles()
+    assert "fixture:A" in text and "fixture:B" in text
+    with pytest.raises(lockcheck.LockOrderViolation):
+        witness.assert_clean()
+
+
+def test_lockcheck_consistent_order_is_clean(witness):
+    A = witness.make_lock("c:A")
+    B = witness.make_lock("c:B")
+
+    def worker():
+        for _ in range(50):
+            with A:
+                with B:
+                    pass
+
+    ts = [threading.Thread(target=worker, name=f"c{i}", daemon=True)
+          for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(5)
+    witness.assert_clean()
+    assert witness.report()["edges"] == 1  # A->B only, recorded once
+
+
+def test_lockcheck_rlock_reentrancy_no_self_edge(witness):
+    R = witness.make_lock("r:R", reentrant=True)
+    with R:
+        with R:  # re-entry must not create an R->R edge or a cycle
+            pass
+    witness.assert_clean()
+    assert witness.report()["edges"] == 0
+
+
+def test_lockcheck_flags_slow_wait_while_holding_another_lock(witness):
+    A = witness.make_lock("s:A")
+    B = witness.make_lock("s:B")
+    release = threading.Event()
+
+    def holder():
+        with B:
+            release.wait(2)
+
+    th = threading.Thread(target=holder, name="holder", daemon=True)
+    th.start()
+    time.sleep(0.05)  # let holder take B
+    with A:  # waiting for B while holding A -> flagged past slow_wait_s
+        t = threading.Timer(0.4, release.set)
+        t.start()
+        with B:
+            pass
+    th.join(5)
+    waits = witness.report()["slow_waits"]
+    assert len(waits) == 1
+    assert waits[0]["lock"] == "s:B" and waits[0]["held"] == ["s:A"]
+    assert waits[0]["waited_s"] >= 0.2
+    witness.assert_clean()  # a slow wait is a flag, not a cycle
+
+
+def test_lockcheck_tracks_project_locks_and_restores_threading():
+    assert threading.Lock is lockcheck._REAL_LOCK
+    w = lockcheck.install()
+    try:
+        from dragonboat_tpu.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        assert type(reg._lock).__name__ == "_TrackedLock"
+        # stdlib-created locks stay real (zero overhead off the project)
+        import queue
+
+        q = queue.Queue()
+        assert type(q.mutex).__name__ != "_TrackedLock"
+    finally:
+        lockcheck.uninstall()
+    assert threading.Lock is lockcheck._REAL_LOCK
+    # locks created while tracked keep working after uninstall
+    with reg._lock:
+        pass
+
+
+def test_lockcheck_condition_wait_releases_held_stack(witness):
+    """Condition(tracked_lock).wait must fully release the lock in the
+    witness's view — a waiter must NOT appear to hold it (phantom edges
+    would poison the graph with false cycles)."""
+    L = witness.make_lock("cv:L")
+    cv = threading.Condition(L)
+    other = witness.make_lock("cv:other")
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=2)
+            woke.append(True)
+
+    th = threading.Thread(target=waiter, name="cv-waiter", daemon=True)
+    th.start()
+    time.sleep(0.1)
+    # while the waiter sleeps inside wait(), take other->L: if wait had
+    # left L on the waiter's stack this would still be fine (different
+    # thread), but the notify path below re-acquires without edges
+    with other:
+        with cv:
+            cv.notify()
+    th.join(5)
+    assert woke == [True]
+    witness.assert_clean()
+
+
+def test_lockcheck_env_gate_matches_invariants_pattern():
+    assert hasattr(lockcheck, "ENABLED")
+    old = lockcheck.ENABLED
+    try:
+        lockcheck.enable(False)
+        assert lockcheck.ENABLED is False
+        lockcheck.enable(True)
+        assert lockcheck.ENABLED is True
+    finally:
+        lockcheck.enable(old)

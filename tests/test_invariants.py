@@ -1,0 +1,101 @@
+"""invariants.py coverage (analysis PR satellite): enable()/ENABLED
+toggling, raise/no-raise paths, message formatting, and the conftest
+contract that the suite actually runs with invariants ON."""
+import importlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragonboat_tpu import invariants
+from dragonboat_tpu.invariants import InvariantViolation, check, enable
+
+
+@pytest.fixture(autouse=True)
+def _restore_enabled():
+    old = invariants.ENABLED
+    yield
+    enable(old)
+
+
+def test_suite_runs_with_invariants_on():
+    """conftest.py sets DRAGONBOAT_TPU_INVARIANTS=1 before importing
+    anything — the whole tier-1 suite must exercise the checks, like
+    the reference's race/monkeytest CI builds [U]."""
+    assert os.environ.get("DRAGONBOAT_TPU_INVARIANTS") not in (None, "", "0")
+    assert invariants.ENABLED is True
+
+
+def test_check_raises_when_enabled():
+    enable(True)
+    with pytest.raises(InvariantViolation, match="commit moved backwards"):
+        check(False, "commit moved backwards: %d -> %d", 7, 3)
+
+
+def test_check_passes_on_true_condition():
+    enable(True)
+    check(True, "never raised")
+
+
+def test_check_noop_when_disabled():
+    enable(False)
+    check(False, "would raise if enabled %d", 1)  # must not raise
+
+
+def test_enable_toggles_module_flag():
+    enable(False)
+    assert invariants.ENABLED is False
+    enable()  # default True
+    assert invariants.ENABLED is True
+
+
+def test_check_message_without_args():
+    enable(True)
+    with pytest.raises(InvariantViolation, match=r"^plain message$"):
+        check(False, "plain message")
+
+
+def test_violation_is_assertion_error():
+    # harnesses that catch AssertionError (pytest.raises, unittest)
+    # must see invariant failures as test failures, not plumbing errors
+    assert issubclass(InvariantViolation, AssertionError)
+
+
+def _fresh_enabled(env_val):
+    """Execute invariants.py as a THROWAWAY module instance under a
+    patched env.  Never importlib.reload the canonical module: reload
+    re-creates InvariantViolation, and every earlier `from ... import
+    InvariantViolation` (test_lifecycle, pytest.raises matchers) would
+    then fail to catch the new class."""
+    old = os.environ.get("DRAGONBOAT_TPU_INVARIANTS")
+    try:
+        if env_val is None:
+            os.environ.pop("DRAGONBOAT_TPU_INVARIANTS", None)
+        else:
+            os.environ["DRAGONBOAT_TPU_INVARIANTS"] = env_val
+        spec = importlib.util.spec_from_file_location(
+            "_invariants_under_test", invariants.__file__
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.ENABLED
+    finally:
+        if old is None:
+            os.environ.pop("DRAGONBOAT_TPU_INVARIANTS", None)
+        else:
+            os.environ["DRAGONBOAT_TPU_INVARIANTS"] = old
+
+
+def test_env_gate_parsing():
+    """The module-level switch honors the same truthiness as the other
+    env gates: unset/empty/"0" off, anything else on."""
+    assert _fresh_enabled("0") is False
+    assert _fresh_enabled("") is False
+    assert _fresh_enabled(None) is False
+    assert _fresh_enabled("1") is True
+    assert _fresh_enabled("true") is True
+    # the canonical module was never touched
+    assert invariants.ENABLED is True
+    assert isinstance(InvariantViolation, type)

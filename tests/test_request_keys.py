@@ -1,0 +1,141 @@
+"""Pending-table key-scheme regression tests (ROADMAP latent fix, PR 5):
+every table — snapshot and leader-transfer included — starts from its
+own random 61-bit base, cross-replica/cross-incarnation key collisions
+are structurally improbable, and key width survives the wire/ctx-split
+audit (docs/PARITY.md 64-bit policy)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragonboat_tpu.client import Session
+from dragonboat_tpu.pb import SystemCtx
+from dragonboat_tpu.request import (
+    KEY_BASE_BITS,
+    PendingConfigChange,
+    PendingLeaderTransfer,
+    PendingProposal,
+    PendingReadIndex,
+    PendingSnapshot,
+    random_key_base,
+    _PendingBase,
+)
+from dragonboat_tpu.transport.wire import decode_batch, encode_batch
+from dragonboat_tpu.pb import Entry, EntryType, Message, MessageBatch, MessageType
+
+
+def test_every_table_kind_gets_a_random_base():
+    """The regression: PendingSnapshot/PendingLeaderTransfer used to
+    count 1, 2, 3 … from zero (only three of five tables were seeded by
+    Node); a default-constructed table of ANY kind must now start from
+    a random base."""
+    for cls in (PendingProposal, PendingReadIndex, PendingConfigChange,
+                PendingSnapshot, PendingLeaderTransfer):
+        bases = {cls()._next_key for _ in range(8)}
+        assert len(bases) == 8, f"{cls.__name__} bases collide"
+        assert all(b > 0 for b in bases), f"{cls.__name__} base not random"
+
+
+def test_bases_are_distinct_across_many_tables():
+    n = 256
+    bases = {_PendingBase()._next_key for _ in range(n)}
+    assert len(bases) == n
+
+
+def test_key_width_leaves_ctx_split_injective():
+    """Keys stay < 2^62 so PendingReadIndex.read's low/high sub-2^31
+    split (the device inbox's int32 hint lanes) remains injective."""
+    assert KEY_BASE_BITS == 61
+    for _ in range(64):
+        base = random_key_base()
+        assert 0 <= base < (1 << 61)
+    # worst-case base + a generous counter run still splits losslessly
+    pri = PendingReadIndex(key_base=(1 << 61) - 1)
+    for _ in range(3):
+        ctx, rs = pri.read(deadline=10**9)
+        assert 0 <= ctx.low < (1 << 31) and 0 <= ctx.high < (1 << 31)
+        assert (ctx.high << 31) | ctx.low == rs.key
+        # stage-2 lookup keyed by the split ctx still resolves
+        pri.confirmed(SystemCtx(low=ctx.low, high=ctx.high), index=1)
+        pri.applied(applied_index=1)
+        assert rs.completed()
+
+
+def test_cross_replica_proposal_keys_do_not_collide():
+    """Two replicas' in-flight proposals must not share Entry.key — the
+    exact ROADMAP scenario (a follower's short-lived local proposal vs a
+    leader-origin committed entry completing the WRONG future)."""
+    a, b = PendingProposal(), PendingProposal()
+    s = Session.noop(1)
+    keys_a = {a.propose(s, b"x", 100)[0].key for _ in range(1000)}
+    keys_b = {b.propose(s, b"x", 100)[0].key for _ in range(1000)}
+    assert not keys_a & keys_b
+    assert len(keys_a) == 1000 and len(keys_b) == 1000
+
+
+def test_keys_survive_wire_roundtrip_at_full_width():
+    """61-bit-base keys ride Entry.key over the binary codec unchanged
+    (u64 lanes; the tan WAL shares _w_entry/_r_entry)."""
+    key = ((1 << 61) - 1) + 7
+    e = Entry(term=3, index=9, type=EntryType.APPLICATION, key=key,
+              client_id=(1 << 64) - 1, series_id=5, responded_to=1,
+              cmd=b"payload")
+    m = Message(type=MessageType.REPLICATE, to=2, from_=1, shard_id=4,
+                entries=[e])
+    data = encode_batch(MessageBatch(messages=(m,)))
+    out = decode_batch(data)
+    assert out.messages[0].entries[0].key == key
+    assert out.messages[0].entries[0].client_id == (1 << 64) - 1
+
+
+def test_node_salts_all_five_tables(tmp_path):
+    """Node passes a replica-salted base to EVERY table (not just the
+    three the old code poked): replica id occupies the top bits, so two
+    replicas of one shard can never collide regardless of rng luck."""
+    from dragonboat_tpu.config import Config, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+
+    nh = NodeHost(NodeHostConfig(
+        nodehost_dir=str(tmp_path / "nh"),
+        rtt_millisecond=50,
+        raft_address="keytest-1",
+    ))
+    try:
+        from dragonboat_tpu.statemachine import IStateMachine, Result
+
+        class KV(IStateMachine):
+            def update(self, e):
+                return Result(value=1)
+
+            def lookup(self, q):
+                return None
+
+            def save_snapshot(self, w, c, d):
+                pass
+
+            def recover_from_snapshot(self, r, f, d):
+                pass
+
+            def close(self):
+                pass
+
+        nh.start_replica(
+            {1: "keytest-1"}, False, lambda s, r: KV(),
+            Config(shard_id=1, replica_id=1, election_rtt=10,
+                   heartbeat_rtt=1),
+        )
+        node = nh._nodes[1]
+        tables = (
+            node.pending_proposal,
+            node.pending_read_index,
+            node.pending_config_change,
+            node.pending_snapshot,
+            node.pending_leader_transfer,
+        )
+        bases = [t._next_key for t in tables]
+        assert len(set(bases)) == 5
+        for b in bases:
+            assert (b >> 48) & 0xFFF == 1  # replica-id salt in the top bits
+            assert b < (1 << 62)
+    finally:
+        nh.close()
