@@ -831,6 +831,37 @@ def phase_lockcheck(
     }
 
 
+def phase_jaxcheck() -> dict:
+    """Device-plane auditor bench guard (analysis/jaxcheck,
+    docs/ANALYSIS.md "Device-plane audit").
+
+    Times the FULL static audit — tracing and lowering every registered
+    ops/ entry point at the canonical geometry — which is the number
+    scripts/lint.sh's <60s gate budget rides on, and reports the
+    registry surface so a shrinking entry-point count (a silently
+    dropped registration) shows in the bench record, not only in the
+    lint gate.  Pure abstract tracing: no kernels compile, no device
+    memory moves, safe on any backend."""
+    import time as _time
+
+    from dragonboat_tpu.analysis import jaxcheck
+    from dragonboat_tpu.ops import registry
+
+    t0 = _time.perf_counter()
+    findings = jaxcheck.audit()
+    wall = _time.perf_counter() - t0
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "entry_points": len(registry.ENTRY_POINTS),
+        "donating": sum(1 for ep in registry.ENTRY_POINTS if ep.donate),
+        "findings": len(findings),
+        "by_rule": by_rule,
+        "wall_s": round(wall, 2),
+    }
+
+
 def phase_balance(
     shards: int = 16,
     hosts: int = 4,
@@ -978,7 +1009,7 @@ def main() -> None:
     # own.  Whatever the driver's cutoff, the last line standing is a
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
-             balance=None, obs=None, lockcheck=None) -> None:
+             balance=None, obs=None, lockcheck=None, jaxcheck=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -1009,6 +1040,10 @@ def main() -> None:
                     # guard (analysis/lockcheck; what the chaos/fault
                     # test modules pay for running under the sanitizer)
                     "lockcheck": lockcheck,
+                    # r09 schema addition: device-plane auditor guard
+                    # (analysis/jaxcheck; audit wall time + registry
+                    # surface the lint gate's <60s budget rides on)
+                    "jaxcheck": jaxcheck,
                 }
             ),
             flush=True,
@@ -1170,6 +1205,22 @@ def main() -> None:
             lck = {"error": lck_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck)
+
+    # Device-plane auditor guard (abstract tracing only — cheap, no
+    # device risk): full jaxcheck audit wall time + registry surface
+    jck = None
+    if bool(int(os.environ.get("BENCH_JAXCHECK", "1"))) and remaining() > 60:
+        code = (
+            "import json, bench;"
+            "print('BENCHJAX ' + json.dumps(bench.phase_jaxcheck()))"
+        )
+        jck, jck_err = run_sub(
+            code, "BENCHJAX", max(60, min(180, int(remaining() - 30)))
+        )
+        if jck is None:
+            jck = {"error": jck_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck)
 
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is

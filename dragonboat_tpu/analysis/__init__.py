@@ -19,8 +19,21 @@ the ``Span.end`` double-fire race):
   with both witness stacks, and waits past a threshold while another
   lock is held are flagged.  conftest enables it for the chaos/fault
   test modules.
+* :mod:`.jaxcheck` — the device-plane program auditor: traces every
+  jitted entry point in ``ops/`` (``ops/registry.py``) and checks the
+  jaxprs/lowerings against policy (int32 dtype discipline, no host-
+  transfer primitives, real buffer donation, G-last internal layout,
+  registry completeness).  Gate: zero findings not recorded in
+  ``analysis/jax_baseline.txt`` (``python -m dragonboat_tpu.analysis
+  --jax``, wired into scripts/lint.sh).
+* :mod:`.jitcheck` — the dynamic half of the device audit: an
+  env-gated (``DRAGONBOAT_TPU_JITCHECK``) recompile sentry that
+  snapshots each entry point's jit trace-cache size at engine warmup
+  and reports post-warmup retraces (the mid-run-compile pipeline
+  stalls static tracing with fixed shapes cannot see).
 
 See docs/ANALYSIS.md for the rule catalog and workflows.
 """
 from .raftlint import Finding, lint_paths, lint_source, load_baseline  # noqa: F401
+from . import jitcheck  # noqa: F401
 from . import lockcheck  # noqa: F401

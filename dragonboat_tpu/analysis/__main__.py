@@ -1,6 +1,15 @@
-"""CLI: ``python -m dragonboat_tpu.analysis [--baseline F] [paths...]``."""
+"""CLI: ``python -m dragonboat_tpu.analysis [--baseline F] [paths...]``
+(raftlint) or ``python -m dragonboat_tpu.analysis --jax [--baseline F]``
+(the device-plane program auditor, docs/ANALYSIS.md)."""
 import sys
+
+argv = sys.argv[1:]
+if "--jax" in argv:
+    argv.remove("--jax")
+    from .jaxcheck import main as _jax_main
+
+    sys.exit(_jax_main(argv))
 
 from .raftlint import main
 
-sys.exit(main())
+sys.exit(main(argv))

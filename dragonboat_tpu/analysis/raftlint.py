@@ -38,6 +38,19 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     raising ``struct.error`` mid-persist.  Literals and ``len(...)``
     are exempt.
 
+``host-sync``
+    The device-plane modules (``ops/kernel.py``, ``ops/route.py`` —
+    "pure int32 math, no host round-trips") must not force a
+    device->host sync or a trace-time concretization: ``.item()``,
+    ``int(...)``/``float(...)`` and ``np.asarray(...)``/``np.array(...)``
+    applied to values are banned (each sync costs ~100-214 ms on a
+    remote-device link, docs/BENCH_NOTES_r05.md).  Static facts are
+    exempt: literals, ``len(...)`` and anything reading ``.shape`` /
+    ``.ndim`` / ``.size`` / ``.dtype``.  A ``# raftlint:
+    ignore[host-sync] <reason>`` on a ``def`` line exempts that whole
+    function (the documented host-side helpers, e.g. the
+    ``build_route_tables`` numpy precompute).
+
 ``import-hot``
     No function-level imports in the hot modules (``node.py``,
     ``request.py``, ``engine/``): a first call on the step/apply path
@@ -91,6 +104,15 @@ WIDTH_MODULES = (
     "dragonboat_tpu/storage/kvlogdb.py",
     "dragonboat_tpu/storage/snapshotio.py",
 )
+# the pure-device modules: host syncs are banned outright (engine.py /
+# colocated.py legitimately sync — that is where launches read back)
+HOST_SYNC_MODULES = (
+    "dragonboat_tpu/ops/kernel.py",
+    "dragonboat_tpu/ops/route.py",
+)
+# attributes whose read is a static (trace-time, host-free) fact
+_STATIC_FACT_ATTRS = {"shape", "ndim", "size", "dtype"}
+_NUMPY_ALIASES = {"np", "numpy", "_np"}
 
 BLOCKING_SOCKET_METHODS = {
     "connect", "accept", "recv", "send", "sendall", "recvfrom", "sendto",
@@ -184,6 +206,9 @@ class _Linter(ast.NodeVisitor):
             self.relpath, DETERMINISM_MODULES
         )
         self.check_width = _module_matches(self.relpath, WIDTH_MODULES)
+        self.check_host_sync = _module_matches(
+            self.relpath, HOST_SYNC_MODULES
+        )
         # file-wide guarded fields: attr -> (lock attr, defining func node)
         self.guarded: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
         # module-level struct.Struct assignments: name -> Q slot indices
@@ -412,6 +437,8 @@ class _Linter(ast.NodeVisitor):
             self._check_determinism(node)
         if self.check_width:
             self._check_width(node)
+        if self.check_host_sync:
+            self._check_host_sync(node)
         self._check_thread(node)
         self.generic_visit(node)
 
@@ -469,6 +496,74 @@ class _Linter(ast.NodeVisitor):
                     lineno,
                     f"socket .{meth}() under a held lock",
                 )
+
+    @staticmethod
+    def _is_static_fact(node: ast.AST) -> bool:
+        """Expressions that concretize without touching device data:
+        literals, len(...), and anything whose value flows from a
+        .shape/.ndim/.size/.dtype read (e.g. int(x.shape[0]))."""
+        if all(
+            isinstance(
+                n,
+                (ast.Constant, ast.BinOp, ast.UnaryOp, ast.operator,
+                 ast.unaryop),
+            )
+            for n in ast.walk(node)
+        ):
+            return True  # constant arithmetic, e.g. int(2**31 - 1)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        ):
+            return True
+        return any(
+            isinstance(n, ast.Attribute) and n.attr in _STATIC_FACT_ATTRS
+            for n in ast.walk(node)
+        )
+
+    def _host_sync_func_exempt(self) -> bool:
+        """A `# raftlint: ignore[host-sync] <reason>` on an enclosing
+        def line exempts the whole function — the documented host-side
+        helpers living inside a device module."""
+        for func in self._func_stack:
+            m = IGNORE_RE.search(self._line(func.lineno))
+            if m and "host-sync" in {
+                r.strip() for r in m.group(1).split(",")
+            }:
+                return True
+        return False
+
+    def _check_host_sync(self, node: ast.Call) -> None:
+        f = node.func
+        hit = None
+        if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+            hit = ".item() forces a device->host sync"
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in ("int", "float")
+            and len(node.args) == 1
+            and not self._is_static_fact(node.args[0])
+        ):
+            hit = (
+                f"{f.id}(...) concretizes a (potential) device value — "
+                "a host sync on the device plane"
+            )
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("asarray", "array")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _NUMPY_ALIASES
+        ):
+            hit = f"np.{f.attr}(...) materializes a device value on host"
+        if hit is None or self._host_sync_func_exempt():
+            return
+        self._emit(
+            "host-sync",
+            node.lineno,
+            hit + " (~100-214 ms per sync on a remote link; "
+            "docs/BENCH_NOTES_r05.md)",
+        )
 
     def _check_determinism(self, node: ast.Call) -> None:
         f = node.func

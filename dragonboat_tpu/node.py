@@ -1045,7 +1045,16 @@ class Node:
             elif e.key:
                 ts = self._trace_spans
                 if ts:
-                    s = ts.pop(e.key, None)
+                    # NOT popped at apply: a REPLICATE re-sent to a
+                    # lagging/healed follower AFTER the leader applied
+                    # must still find the span so it carries real trace
+                    # context and the follower's append leg stitches
+                    # into the merged timeline (the ROADMAP obs gap —
+                    # safe since PR 5's randomized per-table key bases
+                    # shrank cross-replica key collisions to ~2^-47).
+                    # Ended entries are evicted by the _trace_register
+                    # prune amortizer, which bounds the map.
+                    s = ts.get(e.key)
                     if s is not None:
                         s.annotate(
                             f"rsm:applied index={e.index}"

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import jitcheck
 from ..engine.execengine import IStepEngine
 from ..logger import get_logger
 from ..node import StepInputs
@@ -689,6 +690,12 @@ class ColocatedVectorEngine(VectorStepEngine):
             _scatter_rows(st, pos0, sub)
             _gather_detail(st, out, self._put(jnp.zeros((4, b), jnp.int32)))
             _gather_vals(st, out, idx)
+            # the eviction drain gathers rows of the PENDING INBOX
+            # (_drain_pending_to_host) — a distinct _gather_rows
+            # signature the state-gather warms above don't cover; the
+            # first post-warm eviction paid a fresh compile mid-run
+            # (found by the analysis/jitcheck recompile sentry)
+            _gather_rows(self._pending, idx)
             _scatter_inbox_rows(
                 host3, pos0,
                 self._put(Inbox(*(jnp.zeros((b,) + f.shape[1:], I32)
@@ -698,6 +705,10 @@ class ColocatedVectorEngine(VectorStepEngine):
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
         jax.block_until_ready(self._state)
+        if jitcheck.ENABLED:
+            # recompile sentry baseline (analysis/jitcheck): the warm
+            # set above is the COMPLETE post-warm compile surface
+            jitcheck.mark_warm()
 
     def _evict_rows_to_host(self, gs, cause: str = "other") -> None:
         """Move resident rows to the host path losing nothing.  Order is

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import jitcheck
 from ..engine.execengine import IStepEngine
 from ..logger import get_logger
 from ..pb import Entry, EntryType, Message, MessageType, Snapshot
@@ -610,6 +611,10 @@ class VectorStepEngine(IStepEngine):
         one = self._put(jnp.zeros((1,), jnp.int32))
         _set_remote_snapshot(st, one, one, one)
         jax.block_until_ready(self._state)
+        if jitcheck.ENABLED:
+            # recompile sentry: everything after this point must hit
+            # the warmed caches (analysis/jitcheck, docs/ANALYSIS.md)
+            jitcheck.mark_warm()
 
     # ------------------------------------------------------------------
     # row lifecycle

@@ -83,7 +83,7 @@ class RouteStats(NamedTuple):
         return RouteStats(*(a + b for a, b in zip(self, other)))
 
 
-def build_route_tables(
+def build_route_tables(  # raftlint: ignore[host-sync] host-side numpy precompute of static tables
     shard_ids: np.ndarray,
     replica_ids: np.ndarray,
     peer_ids: np.ndarray,

@@ -1,16 +1,22 @@
 #!/usr/bin/env bash
-# raftlint gate + analyzer self-tests (docs/ANALYSIS.md), wired into
-# tier-1 as a cheap post-step (<60s): fails on any finding not covered
-# by dragonboat_tpu/analysis/baseline.txt, then proves the analyzer
-# itself still catches seeded violations (true-positive fixtures) and
-# that the lock-order witness detects an inverted acquisition.
+# Static-analysis gates + analyzer self-tests (docs/ANALYSIS.md), wired
+# into tier-1 as a cheap post-step: raftlint (AST rules, <60s) and
+# jaxcheck (the device-plane program auditor: dtype/transfer/donation/
+# G-last over every ops/ jit entry point, <60s on CPU) each fail on any
+# finding not covered by their checked-in baselines, then the analyzer
+# self-tests prove both still catch seeded violations (true-positive
+# fixtures) and that the lock-order witness detects an inverted
+# acquisition.
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail
 rc=0
 timeout -k 5 60 env JAX_PLATFORMS=cpu python -m dragonboat_tpu.analysis \
     --baseline dragonboat_tpu/analysis/baseline.txt dragonboat_tpu bench.py \
     || rc=1
-timeout -k 5 120 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_analysis.py tests/test_invariants.py -q \
-    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+timeout -k 5 60 env JAX_PLATFORMS=cpu python -m dragonboat_tpu.analysis \
+    --jax --baseline dragonboat_tpu/analysis/jax_baseline.txt \
+    || rc=1
+timeout -k 5 150 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_analysis.py tests/test_invariants.py tests/test_jaxcheck.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 exit $rc

@@ -59,6 +59,14 @@ _LOCKCHECK_MODULES = frozenset(
     ("test_chaos", "test_chaos_extended", "test_chaos_colocated", "test_faults")
 )
 
+# -- recompile sentry (analysis/jitcheck) for the engine-driven modules ---
+# env-gated via DRAGONBOAT_TPU_JITCHECK: each test starts from a fresh
+# trace-cache snapshot (engine _warm() re-marks at construction) and
+# fails if any ops/ entry point retraced after warmup — the mid-run
+# compile that stalls a remote-device launch pipeline for tens of
+# seconds (docs/ANALYSIS.md "Device-plane audit")
+_JITCHECK_MODULES = frozenset(("test_vector_engine", "test_colocated"))
+
 
 def _lockcheck_wanted(item) -> bool:
     from dragonboat_tpu.analysis import lockcheck
@@ -67,25 +75,52 @@ def _lockcheck_wanted(item) -> bool:
     return lockcheck.ENABLED and getattr(mod, "__name__", "") in _LOCKCHECK_MODULES
 
 
+def _jitcheck_wanted(item) -> bool:
+    from dragonboat_tpu.analysis import jitcheck
+
+    mod = getattr(item, "module", None)
+    return jitcheck.ENABLED and getattr(mod, "__name__", "") in _JITCHECK_MODULES
+
+
 def pytest_runtest_setup(item):
     if _lockcheck_wanted(item):
         from dragonboat_tpu.analysis import lockcheck
 
         item._lockcheck_witness = lockcheck.install()
+    if _jitcheck_wanted(item):
+        from dragonboat_tpu.analysis import jitcheck
+
+        jitcheck.mark_warm()
+        item._jitcheck_armed = True
 
 
 def pytest_runtest_teardown(item, nextitem):
-    w = getattr(item, "_lockcheck_witness", None)
-    if w is None:
-        return
-    del item._lockcheck_witness
-    from dragonboat_tpu.analysis import lockcheck
     import pytest as _pytest
 
-    lockcheck.uninstall()
-    if w.cycles:
-        _pytest.fail(
-            "lock-order witness: cycle(s) recorded during this test\n"
-            + w.format_cycles(),
-            pytrace=False,
-        )
+    # lockcheck cleanup FIRST: a jitcheck failure below must not skip
+    # uninstall() and leak the patched lock constructors into every
+    # later test (latent today — the module sets are disjoint — but a
+    # shared module would make the ordering load-bearing)
+    w = getattr(item, "_lockcheck_witness", None)
+    if w is not None:
+        del item._lockcheck_witness
+        from dragonboat_tpu.analysis import lockcheck
+
+        lockcheck.uninstall()
+        if w.cycles:
+            _pytest.fail(
+                "lock-order witness: cycle(s) recorded during this test\n"
+                + w.format_cycles(),
+                pytrace=False,
+            )
+    if getattr(item, "_jitcheck_armed", False):
+        del item._jitcheck_armed
+        from dragonboat_tpu.analysis import jitcheck
+
+        rows = jitcheck.retraces()
+        if rows:
+            _pytest.fail(
+                "jitcheck: post-warmup retrace(s) during this test\n"
+                + jitcheck.format_retraces(rows),
+                pytrace=False,
+            )

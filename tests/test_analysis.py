@@ -303,6 +303,69 @@ def key(kind, shard, replica):
 
 
 # ---------------------------------------------------------------------------
+# host-sync (the device-plane modules: ops/kernel.py, ops/route.py)
+# ---------------------------------------------------------------------------
+HOST_SYNC_SRC = '''
+import numpy as np
+
+def handler(st, msg):
+    n = int(msg["ent"].shape[0])  # static fact: exempt
+    k = len(msg["ids"])  # plain len: no call to flag at all
+    cap = int(2**31 - 1)  # literal: exempt
+    v = int(st.term)  # device concretization
+    f = float(st.committed)  # device concretization
+    x = st.committed.item()  # forced sync
+    arr = np.asarray(st.ring_term)  # host materialization
+    return v, f, x, arr, n, k, cap
+'''
+
+
+def test_host_sync_catches_device_syncs():
+    fs = lint_source(HOST_SYNC_SRC, "dragonboat_tpu/ops/kernel.py")
+    assert rules_of(fs) == {"host-sync"} and len(fs) == 4
+    flagged = [HOST_SYNC_SRC.splitlines()[f.line - 1] for f in fs]
+    for needle in ("int(st.term)", "float(st.committed)",
+                   ".item()", "np.asarray"):
+        assert any(needle in ln for ln in flagged), (needle, flagged)
+
+
+def test_host_sync_scoped_to_device_modules():
+    # engine.py/colocated.py legitimately sync (launch readback lives
+    # there); the rule only polices the pure-device modules
+    assert lint_source(HOST_SYNC_SRC, "dragonboat_tpu/ops/engine.py") == []
+    assert lint_source(HOST_SYNC_SRC, "dragonboat_tpu/node.py") == []
+
+
+def test_host_sync_def_line_ignore_exempts_function():
+    src = HOST_SYNC_SRC.replace(
+        "def handler(st, msg):",
+        "def handler(st, msg):  # raftlint: ignore[host-sync] host helper",
+    )
+    assert lint_source(src, "dragonboat_tpu/ops/route.py") == []
+
+
+def test_host_sync_point_suppression():
+    src = HOST_SYNC_SRC.replace(
+        'x = st.committed.item()  # forced sync',
+        'x = st.committed.item()  # raftlint: ignore[host-sync] staged',
+    )
+    fs = lint_source(src, "dragonboat_tpu/ops/kernel.py")
+    assert len(fs) == 3 and rules_of(fs) == {"host-sync"}
+
+
+def test_host_sync_real_tree_suppression_is_live():
+    """route.py's build_route_tables rides the def-line exemption; if
+    the annotation is stripped, its numpy precompute must surface — the
+    suppression is real, not vacuous."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/route.py")
+    src = open(path).read()
+    assert lint_source(src, "dragonboat_tpu/ops/route.py") == []
+    stripped = src.replace("# raftlint: ignore[host-sync]", "# stripped")
+    fs = lint_source(stripped, "dragonboat_tpu/ops/route.py")
+    assert len(fs) >= 5 and rules_of(fs) == {"host-sync"}
+
+
+# ---------------------------------------------------------------------------
 # hygiene: import-hot, bare-except, thread-discipline
 # ---------------------------------------------------------------------------
 def test_import_hot_flags_function_level_imports_in_hot_modules():

@@ -1,0 +1,370 @@
+"""Device-plane program auditor tests (analysis/jaxcheck + jitcheck,
+docs/ANALYSIS.md "Device-plane audit").
+
+True-positive fixtures per rule — an auditor that cannot catch a seeded
+violation guards nothing: an injected float32 promotion, a host
+callback inside a jitted fn, a donation broken by aliased operands, a
+G-first layout in an internal-layout program, a forced post-warmup
+retrace — plus the registry-completeness rule and the zero-unbaselined
+tree gate (the real ops/ registry audits clean against
+analysis/jax_baseline.txt).
+
+The 3-replica colocated cluster pass under the recompile sentry is
+env-gated behind DRAGONBOAT_TPU_JITCHECK (heavy; existing env-gate
+practice)."""
+import functools
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dragonboat_tpu.analysis import jaxcheck, jitcheck
+from dragonboat_tpu.analysis.raftlint import gate, load_baseline
+from dragonboat_tpu.ops import registry
+from dragonboat_tpu.ops.registry import CANON, EntryPoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JAX_BASELINE = os.path.join(
+    REPO, "dragonboat_tpu", "analysis", "jax_baseline.txt"
+)
+
+G = CANON["G"]
+
+
+def _ep(name, fn, build, **kw):
+    return EntryPoint(name, fn, build, **kw)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# dtype policy
+# ---------------------------------------------------------------------------
+class TestDtypeRule:
+    def test_injected_float_promotion_caught(self):
+        @jax.jit
+        def bad(x):
+            return (x * 0.5).sum()  # silent int32 -> float32 promotion
+
+        ep = _ep("fix.float", bad, lambda: ((jnp.zeros((G,), jnp.int32),), {}))
+        fs = jaxcheck.audit([ep])
+        assert "dtype" in rules_of(fs)
+        assert any("float32" in f.message for f in fs)
+
+    def test_weak_typed_output_caught(self):
+        @jax.jit
+        def weak_out(x):
+            # both where() arms are python literals -> weak int32 output
+            return jnp.where(x > 0, 1, 0)
+
+        ep = _ep(
+            "fix.weak", weak_out, lambda: ((jnp.zeros((G,), jnp.int32),), {})
+        )
+        fs = jaxcheck.audit([ep])
+        assert any("weak" in f.message for f in fs if f.rule == "dtype")
+
+    def test_sanctioned_program_clean(self):
+        @jax.jit
+        def good(x, m):
+            return jnp.where(m, x + jnp.int32(1), x)
+
+        ep = _ep(
+            "fix.clean",
+            good,
+            lambda: (
+                (jnp.zeros((G,), jnp.int32), jnp.zeros((G,), bool)),
+                {},
+            ),
+        )
+        assert jaxcheck.audit([ep]) == []
+
+    def test_whitelist_exception(self):
+        @jax.jit
+        def uses_f32(x):
+            return x.astype(jnp.float32)
+
+        ep = _ep(
+            "fix.wl", uses_f32, lambda: ((jnp.zeros((G,), jnp.int32),), {})
+        )
+        assert rules_of(jaxcheck.audit([ep])) == {"dtype"}
+        # an explicitly whitelisted dtype is not a finding
+        assert jaxcheck.audit([ep], extra_ok=("float32",)) == []
+
+
+# ---------------------------------------------------------------------------
+# transfer audit
+# ---------------------------------------------------------------------------
+class TestTransferRule:
+    def test_pure_callback_in_jitted_fn_caught(self):
+        import numpy as np
+
+        @jax.jit
+        def bad(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a, np.int32),
+                jax.ShapeDtypeStruct(x.shape, x.dtype),
+                x,
+            )
+            return y + 1
+
+        ep = _ep("fix.cb", bad, lambda: ((jnp.zeros((G,), jnp.int32),), {}))
+        fs = [f for f in jaxcheck.audit([ep]) if f.rule == "transfer"]
+        assert fs and any("callback" in f.message for f in fs)
+
+    def test_debug_callback_caught(self):
+        @jax.jit
+        def bad(x):
+            jax.debug.callback(lambda a: None, x)
+            return x + 1
+
+        ep = _ep("fix.dbg", bad, lambda: ((jnp.zeros((G,), jnp.int32),), {}))
+        assert "transfer" in rules_of(jaxcheck.audit([ep]))
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+class TestDonationRule:
+    def test_donation_broken_by_aliased_operands_caught(self):
+        # the output IS operand 0 (pass-through), so the donated operand
+        # 1 — same shape, could alias — cannot: jax drops the donation
+        # and the call degrades to copy+free (the ops/route.py
+        # "aliased zeros break donate_argnums" class)
+        f = functools.partial(jax.jit, donate_argnums=(1,))(lambda x, y: x)
+        ep = _ep(
+            "fix.donate",
+            f,
+            lambda: (
+                (
+                    jnp.zeros((8, 4), jnp.int32),
+                    jnp.ones((8, 4), jnp.int32),
+                ),
+                {},
+            ),
+            donate=(1,),
+        )
+        fs = jaxcheck.audit([ep])
+        assert rules_of(fs) == {"donation"}
+        assert "0/1" in fs[0].message
+
+    def test_working_donation_clean(self):
+        f = functools.partial(jax.jit, donate_argnums=(0,))(
+            lambda x, y: x + y
+        )
+        ep = _ep(
+            "fix.donate_ok",
+            f,
+            lambda: (
+                (
+                    jnp.zeros((8, 4), jnp.int32),
+                    jnp.ones((8, 4), jnp.int32),
+                ),
+                {},
+            ),
+            donate=(0,),
+        )
+        assert jaxcheck.audit([ep]) == []
+
+    def test_early_free_donation_not_flagged(self):
+        # donated buffer with NO shape-matched output: legitimate
+        # early-free donation (the _assemble_and_step inbox pattern)
+        f = functools.partial(jax.jit, donate_argnums=(0,))(
+            lambda x: x.sum()
+        )
+        ep = _ep(
+            "fix.donate_free",
+            f,
+            lambda: ((jnp.zeros((8, 4), jnp.int32),), {}),
+            donate=(0,),
+        )
+        assert jaxcheck.audit([ep]) == []
+
+
+# ---------------------------------------------------------------------------
+# G-last layout
+# ---------------------------------------------------------------------------
+class TestGLastRule:
+    def test_g_first_compute_caught(self):
+        @jax.jit
+        def bad(x):  # [G, P] math: G on the major axis pads the lanes
+            return x + jnp.int32(1)
+
+        ep = _ep(
+            "fix.gfirst",
+            bad,
+            lambda: ((jnp.zeros((G, CANON["P"]), jnp.int32),), {}),
+            g_last=True,
+        )
+        fs = jaxcheck.audit([ep])
+        assert rules_of(fs) == {"g-last"}
+
+    def test_g_last_compute_clean(self):
+        @jax.jit
+        def good(x):
+            return x + jnp.int32(1)
+
+        ep = _ep(
+            "fix.glast",
+            good,
+            lambda: ((jnp.zeros((CANON["P"], G), jnp.int32),), {}),
+            g_last=True,
+        )
+        assert jaxcheck.audit([ep]) == []
+
+    def test_constant_fills_exempt(self):
+        @jax.jit
+        def ctor(x):
+            # the make_out pattern: G-major constant that folds under
+            # jit, transposed at the boundary — not lane traffic
+            return x + jnp.zeros((G, CANON["P"]), jnp.int32).T
+
+        ep = _ep(
+            "fix.ctor",
+            ctor,
+            lambda: ((jnp.zeros((CANON["P"], G), jnp.int32),), {}),
+            g_last=True,
+        )
+        assert jaxcheck.audit([ep]) == []
+
+
+# ---------------------------------------------------------------------------
+# registry completeness
+# ---------------------------------------------------------------------------
+class TestRegistryCompleteness:
+    def test_jit_defs_sees_decorator_and_assignment_shapes(self, tmp_path):
+        (tmp_path / "fake.py").write_text(
+            "import jax, functools\n"
+            "@jax.jit\n"
+            "def plain(x):\n    return x\n"
+            "@functools.partial(jax.jit, static_argnames=('n',))\n"
+            "def partialed(x, n):\n    return x\n"
+            "def unjitted(x):\n    return x\n"
+            # assignment forms escape decorator-only scans (review
+            # finding): both spellings must register
+            "fast = jax.jit(unjitted)\n"
+            "faster = functools.partial(jax.jit, donate_argnums=(0,))"
+            "(unjitted)\n"
+            "not_a_jit = functools.partial(max, 0)\n"
+        )
+        defs = {(m, f) for m, f, _ in jaxcheck._jit_defs(str(tmp_path))}
+        assert defs == {
+            ("fake", "plain"),
+            ("fake", "partialed"),
+            ("fake", "fast"),
+            ("fake", "faster"),
+        }
+
+    def test_every_ops_jit_is_registered(self):
+        # the live-tree completeness gate, independent of the baseline
+        assert jaxcheck._check_registry_complete(registry.ENTRY_POINTS) == []
+
+    def test_registry_covers_documented_surface(self):
+        names = {ep.name for ep in registry.ENTRY_POINTS}
+        for must in (
+            "kernel.step",
+            "kernel.step_internal",
+            "engine._gather_detail_vals",
+            "colocated._assemble_and_step",
+            "colocated._select_and_blob",
+            "route.routed_round",
+        ):
+            assert must in names
+
+
+# ---------------------------------------------------------------------------
+# the zero-unbaselined-tree gate (the PR 5 pattern: analysis gates itself)
+# ---------------------------------------------------------------------------
+class TestTreeGate:
+    def test_tree_audits_clean_against_baseline(self):
+        findings = jaxcheck.audit()
+        new, _stale = gate(findings, load_baseline(JAX_BASELINE))
+        assert new == [], "unbaselined device-plane findings:\n" + "\n".join(
+            f.render() for f in new
+        )
+
+
+# ---------------------------------------------------------------------------
+# recompile sentry (analysis/jitcheck)
+# ---------------------------------------------------------------------------
+class TestJitcheckSentry:
+    def test_forced_post_warmup_retrace_caught(self):
+        @jax.jit
+        def f(x):
+            return x + 1
+
+        s = jitcheck.Sentry([("fix.retrace", f)])
+        f(jnp.zeros((4,), jnp.int32))  # warmup shape
+        s.mark()
+        assert s.retraces() == []
+        f(jnp.zeros((4,), jnp.int32))  # same shape: cache hit, no growth
+        assert s.retraces() == []
+        f(jnp.zeros((5,), jnp.int32))  # drifted shape: retrace
+        rows = s.retraces()
+        assert rows and rows[0][0] == "fix.retrace"
+        assert rows[0][2] > rows[0][1]
+        assert "post-warmup retrace" in jitcheck.format_retraces(rows)
+
+    def test_unmarked_sentry_reports_nothing(self):
+        s = jitcheck.Sentry([])
+        assert s.retraces() == []
+
+    def test_runtime_registry_excludes_audit_wrappers(self):
+        names = {n for n, _ in registry.runtime_entry_points()}
+        assert "route.routed_round" not in names
+        assert "kernel.step" in names
+
+
+# ---------------------------------------------------------------------------
+# the 3-replica cluster pass: zero post-warmup retraces end to end
+# (env-gated: heavy sentry runs sit behind DRAGONBOAT_TPU_JITCHECK)
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(
+    os.environ.get("DRAGONBOAT_TPU_JITCHECK", "0") in ("", "0"),
+    reason="recompile-sentry cluster pass runs under DRAGONBOAT_TPU_JITCHECK=1",
+)
+class TestClusterSentryPass:
+    def test_colocated_3replica_zero_postwarm_retraces(self):
+        from test_colocated import colo_shard_config, make_colocated_cluster
+        from test_nodehost import (
+            ADDRS,
+            KVStore,
+            propose_r,
+            set_cmd,
+            wait_for_leader,
+        )
+
+        jitcheck.enable(True)
+        group, nhs = make_colocated_cluster()
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(ADDRS, False, KVStore, colo_shard_config(rid))
+            wait_for_leader(nhs)
+            lid, ok = nhs[1].get_leader_id(1)
+            assert ok
+            s = nhs[lid].get_noop_session(1)
+            for i in range(10):  # warmup traffic: all launch shapes hit
+                propose_r(nhs[lid], s, set_cmd(f"warm{i}", b"v"))
+            jitcheck.mark_warm()
+            for i in range(30):
+                propose_r(nhs[lid], s, set_cmd(f"load{i}", b"v"))
+            nhs[lid].request_leader_transfer(1, (lid % 3) + 1)
+            for i in range(10):
+                lid2, ok = nhs[1].get_leader_id(1)
+                if ok:
+                    s2 = nhs[lid2].get_noop_session(1)
+                    propose_r(nhs[lid2], s2, set_cmd(f"post{i}", b"v"))
+            rows = jitcheck.retraces()
+            assert rows == [], (
+                "post-warmup retraces in the cluster pass:\n"
+                + jitcheck.format_retraces(rows)
+            )
+        finally:
+            for nh in nhs.values():
+                nh.close()

@@ -512,6 +512,75 @@ class TestTraceStitchTCP:
 
 
 # ---------------------------------------------------------------------------
+# retransmitted REPLICATEs keep their trace context past apply (the
+# ROADMAP obs gap, fixed once PR 5's randomized key bases landed): the
+# leader's span-map entry survives node._complete_applied, so a
+# REPLICATE re-sent to a healed follower AFTER the entry applied still
+# carries the real trace_id and the follower's append leg stitches in
+# ---------------------------------------------------------------------------
+class TestRetransmitTraceContext:
+    ADDRS = {1: "obs-rt-1", 2: "obs-rt-2", 3: "obs-rt-3"}
+
+    def test_post_apply_retransmit_stitches_follower_append(self):
+        nhs = _start_cluster(self.ADDRS)
+        ctl = FaultController(seed=5)
+        try:
+            wait_for_leader(nhs)
+            lid, ok = nhs[1].get_leader_id(1)
+            assert ok
+            fid = next(r for r in self.ADDRS if r != lid)
+            healed_addr = self.ADDRS[fid]
+            for rid, addr in self.ADDRS.items():
+                ctl.install_nodehost(addr, nhs[rid])
+            cut = Fault("partition", targets=(healed_addr,))
+            ctl.activate(cut)
+            s = nhs[lid].get_noop_session(1)
+            for i in range(3):
+                propose_r(nhs[lid], s, set_cmd(f"rt{i}", b"v"))
+            # the proposals COMPLETED (committed + applied on the
+            # quorum pair) while the partitioned follower missed every
+            # REPLICATE — any append it performs after the heal is by
+            # construction a post-apply retransmit
+            ctl.deactivate(cut)
+            deadline = time.time() + 20.0
+            hit = None
+            while time.time() < deadline and hit is None:
+                for tid, spans in stitched_traces(
+                    nh.tracer for nh in nhs.values()
+                ).items():
+                    roots = [x for x in spans if x.name == "propose"]
+                    if not roots:
+                        continue
+                    for fa in spans:
+                        if (
+                            fa.name == "follower:append"
+                            and fa.host == healed_addr
+                            and any(
+                                r.span_id == fa.parent_id for r in roots
+                            )
+                        ):
+                            hit = (tid, spans)
+                            break
+                if hit is None:
+                    time.sleep(0.1)
+            assert hit, (
+                "no follower:append span from the healed follower "
+                "stitched into a proposal trace — the retransmitted "
+                "REPLICATE went out with trace_id=0"
+            )
+            _tid, spans = hit
+            root = next(x for x in spans if x.name == "propose")
+            # the root finished BEFORE the heal could deliver anything:
+            # the stitched leg is genuinely post-apply
+            assert root.status == "COMPLETED"
+            labels = [a for _, a in root.annotations]
+            assert any("rsm:applied" in a for a in labels), labels
+        finally:
+            ctl.stop()
+            _close_all(nhs)
+
+
+# ---------------------------------------------------------------------------
 # flight-recorder auto-dump on a forced SLA violation (nemesis run)
 # ---------------------------------------------------------------------------
 class TestAutoDump:
