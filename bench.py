@@ -969,6 +969,257 @@ def phase_balance(
                 pass
 
 
+def phase_gateway(
+    *,
+    shards: int = 4,
+    handles_per_shard: int = 16,
+    levels=(200, 800, 3200),
+    level_secs: float = 3.0,
+    overload_secs: float = 4.0,
+    rtt_ms: int = 2,
+    readers: int = 4,
+) -> dict:
+    """Serving-front-plane saturation curve (gateway tentpole,
+    docs/GATEWAY.md): mixed read/write OPEN-LOOP load at high fan-in —
+    ``shards * handles_per_shard`` exactly-once-shaped client handles
+    submit writes at each offered rate regardless of completions while
+    ``readers`` threads hammer lease reads — emitting per-level
+    offered vs committed vs shed with write p50/p99, then an OVERLOAD
+    scenario (tiny per-shard queues, offered >> capacity) where p99 of
+    COMPLETED requests must stay bounded while ``gateway_shed_total``
+    climbs: shedding at the door is what keeps the tail flat.  Also
+    records the lease-read vs ReadIndex p50 split (the acceptance
+    proxy when no hardware throughput run is possible).  Pure host
+    path — no device, no jax.
+    """
+    import queue as _queue
+    import shutil
+    import threading
+
+    from dragonboat_tpu import (
+        Config,
+        EngineConfig,
+        ExpertConfig,
+        Gateway,
+        GatewayBusy,
+        GatewayConfig,
+        NodeHost,
+        NodeHostConfig,
+    )
+    from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+    reset_inproc_network()
+    sm_cls = _bench_sm_cls()
+    keys = [f"bench-gw-{i}" for i in range(3)]
+    nhs = {}
+    for i, key in enumerate(keys):
+        d = f"/tmp/nh-bench-gw-{i}"
+        shutil.rmtree(d, ignore_errors=True)
+        nhs[key] = NodeHost(NodeHostConfig(
+            nodehost_dir=d,
+            rtt_millisecond=rtt_ms,
+            raft_address=key,
+            expert=ExpertConfig(
+                engine=EngineConfig(exec_shards=2, apply_shards=2),
+            ),
+        ))
+    gw = None
+    try:
+        for sid in range(1, shards + 1):
+            for rid, key in enumerate(keys, start=1):
+                nhs[key].start_replica(
+                    {r: k for r, k in enumerate(keys, start=1)}, False,
+                    sm_cls,
+                    Config(shard_id=sid, replica_id=rid, election_rtt=10,
+                           heartbeat_rtt=1, check_quorum=True),
+                )
+        deadline = time.monotonic() + 30.0
+        for sid in range(1, shards + 1):
+            while time.monotonic() < deadline:
+                if any(nh.is_leader_of(sid) for nh in nhs.values()):
+                    break
+                time.sleep(0.02)
+            else:
+                return {"error": f"no leader for shard {sid} within 30s"}
+
+        def run_level(gw, offered_rate: float, secs: float) -> dict:
+            """One open-loop level: submit writes at offered_rate,
+            measure commit latency client-side via a waiter pool."""
+            hs = [
+                gw.noop_handle(1 + i % shards)
+                for i in range(shards * handles_per_shard)
+            ]
+            lat: list = []
+            lat_lock = threading.Lock()
+            inbox: "_queue.Queue" = _queue.Queue()
+
+            def waiter():
+                while True:
+                    item = inbox.get()
+                    if item is None:
+                        return
+                    t0, fut = item
+                    try:
+                        fut.result(20.0)
+                        with lat_lock:
+                            lat.append(time.monotonic() - t0)
+                    except Exception:  # noqa: BLE001 — sheds/timeouts
+                        # are counted by the gateway, not the sampler
+                        pass
+
+            ws = [threading.Thread(target=waiter, daemon=True,
+                                   name=f"gwbench-wait-{i}")
+                  for i in range(8)]
+            for w in ws:
+                w.start()
+            st0 = gw.stats()
+            stop_readers = threading.Event()
+            read_lat: list = []
+
+            def read_loop():
+                while not stop_readers.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        gw.read(1, None, timeout=5.0)
+                        read_lat.append(time.monotonic() - t0)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            rs = [threading.Thread(target=read_loop, daemon=True,
+                                   name=f"gwbench-read-{i}")
+                  for i in range(readers)]
+            for r in rs:
+                r.start()
+            period = 1.0 / offered_rate
+            t_end = time.monotonic() + secs
+            offered = sheds = 0
+            i = 0
+            next_send = time.monotonic()
+            while time.monotonic() < t_end:
+                now = time.monotonic()
+                if now < next_send:
+                    time.sleep(min(next_send - now, 0.001))
+                    continue
+                next_send += period
+                h = hs[i % len(hs)]
+                i += 1
+                offered += 1
+                try:
+                    inbox.put((now, h.propose(b"x" * 24, timeout=5.0)))
+                except GatewayBusy:
+                    sheds += 1
+            # committed-rate snapshot at WINDOW END, before the drain:
+            # up to queue-depth admitted requests commit during the
+            # drain and counting them against `secs` inflated
+            # committed_per_sec past the true service rate (review
+            # finding); latency samples still collect through the
+            # drain — an admitted request's latency is real wherever
+            # it completes
+            st_end = gw.stats()
+            # drain: waiters consume the backlog, then stop
+            t_drain = time.monotonic() + 10.0
+            while not inbox.empty() and time.monotonic() < t_drain:
+                time.sleep(0.02)
+            for _ in ws:
+                inbox.put(None)
+            for w in ws:
+                w.join(timeout=5.0)
+            stop_readers.set()
+            for r in rs:
+                r.join(timeout=5.0)
+            st1 = gw.stats()
+            # SNAPSHOT into fresh names before sorting: a waiter/reader
+            # stuck past its join timeout can still append to the
+            # original lists, and an in-place .sort() racing an append
+            # raises (review finding)
+            lat_done = sorted(list(lat))
+            read_done = sorted(list(read_lat))
+            wall = secs
+
+            def pct(xs, q):
+                return round(xs[min(len(xs) - 1, int(q * len(xs)))] * 1000,
+                             3) if xs else -1.0
+
+            return {
+                "offered_per_sec": round(offered / wall, 1),
+                "committed_per_sec": round(
+                    (st_end["committed"] - st0["committed"]) / wall, 1
+                ),
+                "shed_per_sec": round(sheds / wall, 1),
+                "shed_total": sheds,
+                "write_p50_ms": pct(lat_done, 0.50),
+                "write_p99_ms": pct(lat_done, 0.99),
+                "read_p50_ms": pct(read_done, 0.50),
+                "lease_reads": st1["lease_reads"] - st0["lease_reads"],
+                "read_fallbacks": (
+                    st1["read_fallbacks"] - st0["read_fallbacks"]
+                ),
+            }
+
+        gw = Gateway(nhs, GatewayConfig(workers=2,
+                                        max_queue_per_shard=512))
+        curve = []
+        for rate in levels:
+            curve.append(run_level(gw, float(rate), level_secs))
+        # lease vs ReadIndex p50: the same read served both ways
+        leader = next(k for k in keys if nhs[k].is_leader_of(1))
+
+        def p50_of(fn, n=200):
+            xs = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn()
+                xs.append(time.perf_counter() - t0)
+            xs.sort()
+            return round(xs[n // 2] * 1000, 4)
+
+        lease_p50 = p50_of(lambda: gw.read(1, None, timeout=5.0))
+        ri_p50 = p50_of(
+            lambda: nhs[leader].sync_read(1, None, timeout=5.0)
+        )
+        gw.close()
+
+        # OVERLOAD: tiny queues, offered far past the measured knee —
+        # p99 of completed must stay bounded while shedding climbs
+        sat = max(
+            (lv["committed_per_sec"] for lv in curve), default=500.0
+        )
+        gw = Gateway(nhs, GatewayConfig(
+            workers=2, max_queue_per_shard=32,
+            shed_dump_threshold=200, shed_dump_cooldown=1.0,
+        ))
+        over = run_level(gw, max(sat * 5.0, 1000.0), overload_secs)
+        base_p99 = max(
+            (lv["write_p99_ms"] for lv in curve
+             if lv["write_p99_ms"] > 0), default=100.0
+        )
+        over["p99_bounded"] = bool(
+            0 < over["write_p99_ms"] <= max(4 * base_p99, 500.0)
+        )
+        over["shed_dumps"] = gw.stats()["shed_dumps"]
+        return {
+            "shards": shards,
+            "handles": shards * handles_per_shard,
+            "rtt_ms": rtt_ms,
+            "curve": curve,
+            "overload": over,
+            "lease_read_p50_ms": lease_p50,
+            "read_index_p50_ms": ri_p50,
+            "lease_skips_quorum_rt": bool(lease_p50 * 2 < ri_p50),
+        }
+    finally:
+        if gw is not None:
+            try:
+                gw.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for nh in nhs.values():
+            try:
+                nh.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
 def main() -> None:
     import jax
 
@@ -1009,7 +1260,8 @@ def main() -> None:
     # own.  Whatever the driver's cutoff, the last line standing is a
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
-             balance=None, obs=None, lockcheck=None, jaxcheck=None) -> None:
+             balance=None, obs=None, lockcheck=None, jaxcheck=None,
+             gateway=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -1044,6 +1296,10 @@ def main() -> None:
                     # (analysis/jaxcheck; audit wall time + registry
                     # surface the lint gate's <60s budget rides on)
                     "jaxcheck": jaxcheck,
+                    # r10 schema addition: serving-front-plane guard
+                    # (gateway/; open-loop saturation curve + overload
+                    # p99-bounded-while-shedding + lease-read split)
+                    "gateway": gateway,
                 }
             ),
             flush=True,
@@ -1221,6 +1477,22 @@ def main() -> None:
             jck = {"error": jck_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck)
+
+    # Serving-front-plane guard (host path only — cheap, no device
+    # risk): gateway saturation curve + overload p99 + lease-read split
+    gwb = None
+    if bool(int(os.environ.get("BENCH_GATEWAY", "1"))) and remaining() > 60:
+        code = (
+            "import json, bench;"
+            "print('BENCHGW ' + json.dumps(bench.phase_gateway()))"
+        )
+        gwb, gw_err = run_sub(
+            code, "BENCHGW", max(60, min(240, int(remaining() - 30)))
+        )
+        if gwb is None:
+            gwb = {"error": gw_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb)
 
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is

@@ -18,6 +18,13 @@ from .balance import (
 )
 from .client import LatencyBudget, Session, call_with_retry, propose_with_retry
 from .config import Config, EngineConfig, ExpertConfig, GossipConfig, NodeHostConfig
+from .gateway import (
+    ClientHandle,
+    Gateway,
+    GatewayBusy,
+    GatewayClosed,
+    GatewayConfig,
+)
 from .faults import (
     Fault,
     FaultController,
@@ -76,6 +83,11 @@ __all__ = [
     "ExpertConfig",
     "GossipConfig",
     "NodeHostConfig",
+    "ClientHandle",
+    "Gateway",
+    "GatewayBusy",
+    "GatewayClosed",
+    "GatewayConfig",
     "NodeHost",
     "NodeHostClosed",
     "RequestDropped",

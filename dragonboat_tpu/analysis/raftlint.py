@@ -51,6 +51,17 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     function (the documented host-side helpers, e.g. the
     ``build_route_tables`` numpy precompute).
 
+``gateway-hot``
+    In ``gateway/`` modules, a function whose ``def`` line carries a
+    ``# gateway-hot`` comment is a declared per-request READ path
+    (RoutingCache.lookup and friends): it must not acquire anything —
+    no ``with <lock>:`` and no ``.acquire()``.  The sanctioned shape is
+    the snapshot read (grab a copy-on-write dict/tuple in one attribute
+    load; writers swap a fresh object under their own lock), the same
+    discipline as ``metrics.export_text`` — a per-request lock on the
+    routing table would serialize every client of every shard through
+    one mutex.
+
 ``import-hot``
     No function-level imports in the hot modules (``node.py``,
     ``request.py``, ``engine/``): a first call on the step/apply path
@@ -110,6 +121,11 @@ HOST_SYNC_MODULES = (
     "dragonboat_tpu/ops/kernel.py",
     "dragonboat_tpu/ops/route.py",
 )
+# the serving front plane: `# gateway-hot` functions are lock-free
+# snapshot-read paths (docs/GATEWAY.md "Routing")
+GATEWAY_MODULES = ("dragonboat_tpu/gateway/",)
+GATEWAY_HOT_RE = re.compile(r"#\s*gateway-hot\b")
+
 # attributes whose read is a static (trace-time, host-free) fact
 _STATIC_FACT_ATTRS = {"shape", "ndim", "size", "dtype"}
 _NUMPY_ALIASES = {"np", "numpy", "_np"}
@@ -209,6 +225,10 @@ class _Linter(ast.NodeVisitor):
         self.check_host_sync = _module_matches(
             self.relpath, HOST_SYNC_MODULES
         )
+        self.check_gateway = _module_matches(self.relpath, GATEWAY_MODULES)
+        # count of enclosing `# gateway-hot` functions (nested defs
+        # inside a hot function inherit the discipline)
+        self._hot_depth = 0
         # file-wide guarded fields: attr -> (lock attr, defining func node)
         self.guarded: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
         # module-level struct.Struct assignments: name -> Q slot indices
@@ -345,6 +365,11 @@ class _Linter(ast.NodeVisitor):
         if m:
             self._held.append(m.group(1))
             self._held_self.append(m.group(1))
+        hot = self.check_gateway and bool(
+            GATEWAY_HOT_RE.search(self._line(node.lineno))
+        )
+        if hot:
+            self._hot_depth += 1
         self._func_stack.append(node)
         try:
             self.generic_visit(node)
@@ -352,6 +377,8 @@ class _Linter(ast.NodeVisitor):
             self._func_stack.pop()
             self._held = held
             self._held_self = held_self
+            if hot:
+                self._hot_depth -= 1
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_func(node)
@@ -391,6 +418,14 @@ class _Linter(ast.NodeVisitor):
             expr = item.context_expr
             ln = self._lock_name(expr)
             if ln is not None:
+                if self._hot_depth:
+                    self._emit(
+                        "gateway-hot",
+                        node.lineno,
+                        f"`with {ln}:` inside a # gateway-hot read path "
+                        "(snapshot-read the copy-on-write table instead; "
+                        "docs/GATEWAY.md)",
+                    )
                 entered.append(ln)
                 if (
                     isinstance(expr, ast.Attribute)
@@ -431,6 +466,15 @@ class _Linter(ast.NodeVisitor):
     # ---- block-under-lock + determinism + width (all calls) ------------
 
     def visit_Call(self, node: ast.Call) -> None:
+        if self._hot_depth and isinstance(node.func, ast.Attribute) and (
+            node.func.attr == "acquire"
+        ):
+            self._emit(
+                "gateway-hot",
+                node.lineno,
+                ".acquire() inside a # gateway-hot read path "
+                "(snapshot-read discipline; docs/GATEWAY.md)",
+            )
         if self._held:
             self._check_blocking(node)
         if self.check_determinism:

@@ -122,6 +122,16 @@ class ClusterView:
     def replicas_on(self, host: str) -> int:
         return sum(1 for s in self.shards for _, h in s.members if h == host)
 
+    def leader_map(self) -> Dict[int, str]:
+        """shard_id -> leader host, for shards with a known leader — the
+        gateway routing cache's bulk-refresh input
+        (gateway.RoutingCache.refresh_from_view)."""
+        return {
+            s.shard_id: s.leader_host
+            for s in self.shards
+            if s.leader_host
+        }
+
     def describe(self) -> str:
         return (
             f"hosts={list(self.hosts)!r} draining={list(self.draining)!r}\n"

@@ -132,17 +132,34 @@ class LatencyBudget:
         self.attempts = attempts
         self._lat = deque(maxlen=window)  # guarded-by: _lock
         self._lock = threading.Lock()
+        # p99 cache, refreshed every _P99_REFRESH observations: the
+        # gateway admission gate reads p99 per PROPOSAL while holding
+        # its own lock — a full 512-sample sort per admit would
+        # serialize every submitting thread on the hottest path
+        # (review finding).  Staleness is bounded at 16 samples of a
+        # 512-sample window; deadline feasibility is an estimate
+        # either way.
+        self._p99_cache = None  # guarded-by: _lock
+        self._since_refresh = 0  # guarded-by: _lock
+
+    _P99_REFRESH = 16
 
     def observe(self, secs: float) -> None:
         with self._lock:
             self._lat.append(secs)
+            self._since_refresh += 1
+            if self._since_refresh >= self._P99_REFRESH:
+                self._p99_cache = None
 
     def p99(self) -> float:
         with self._lock:
             if not self._lat:
                 return self.bootstrap
-            s = sorted(self._lat)
-            return s[min(len(s) - 1, int(0.99 * len(s)))]
+            if self._p99_cache is None:
+                s = sorted(self._lat)
+                self._p99_cache = s[min(len(s) - 1, int(0.99 * len(s)))]
+                self._since_refresh = 0
+            return self._p99_cache
 
     def per_try_timeout(self) -> float:
         v = self.try_factor * self.p99() + self.election_window
@@ -152,6 +169,20 @@ class LatencyBudget:
         """Whole-op budget: ``attempts`` worst-case tries (already
         bounded by the per-try clamp, so no clamp of its own)."""
         return self.attempts * self.per_try_timeout()
+
+    def can_meet(self, remaining: float, *, queued_ahead: int = 0,
+                 batch_hint: int = 64) -> bool:
+        """Deadline feasibility: can a request admitted NOW still meet
+        a deadline ``remaining`` seconds away?  The gateway's
+        reject-early gate (docs/GATEWAY.md "Shedding policy"): expected
+        completion is one observed-p99 commit plus one more p99 per
+        ``batch_hint`` requests already queued ahead on the same shard
+        (each batch ahead of ours must commit first).  Conservative by
+        design — shedding a request that WOULD have made it costs one
+        retry somewhere less loaded; admitting one that can't poisons
+        p99 for everyone behind it."""
+        eta = self.p99() * (1.0 + queued_ahead / max(1, batch_hint))
+        return remaining >= eta
 
 
 def call_with_retry(

@@ -9,12 +9,19 @@ and the warning names the callback that lost its event.
 ``tap`` is the flight recorder's synchronous hook (obs/recorder.py): it
 sees every SYSTEM event at post time, including ones the bounded queue
 would drop — a recorder that misses state transitions under pressure
-would be useless exactly when it matters.
+would be useless exactly when it matters.  ``add_tap``/``remove_tap``
+attach further synchronous taps at runtime (the gateway's routing-cache
+invalidation rides one); unlike the recorder tap these ALSO see
+``leader_updated``, because leader identity is exactly what a routing
+cache keys on.
 
 Thread-safety is by construction, not by lock: ``_q``/``_stop`` are
-inherently thread-safe, and the listener/tap fields are written once in
-``__init__`` and only read afterwards — so there is nothing here for a
-``# guarded-by:`` annotation to guard.  The discipline that DOES bind
+inherently thread-safe, the listener/tap fields are written once in
+``__init__`` and only read afterwards, and ``_taps`` is a copy-on-write
+tuple (readers grab the whole tuple in one attribute load; writers swap
+a fresh tuple under ``_taps_lock``) — so there is nothing here for a
+``# guarded-by:`` annotation to guard on the read side.  The discipline
+that DOES bind
 this module is raftlint's ``block-under-lock`` rule: the PR 4 close()
 deadlock (a blocking ``put`` wedged against a full queue) is its seeded
 true-positive fixture (tests/test_analysis.py), and the non-blocking
@@ -51,6 +58,11 @@ class EventFanout:
         self.raft_listener = raft_listener
         self.system_listener = system_listener
         self.tap = tap
+        # runtime-attached synchronous taps (copy-on-write tuple; see
+        # module docstring): called as fn(name, args) for every system
+        # event AND leader_updated
+        self._taps: tuple = ()
+        self._taps_lock = threading.Lock()
         self._dropped = (
             metrics.counter("event_fanout_dropped_total")
             if metrics is not None
@@ -62,6 +74,26 @@ class EventFanout:
             target=self._main, daemon=True, name="tpu-raft-events"
         )
         self._thread.start()
+
+    def add_tap(self, fn: Callable) -> None:
+        """Attach a synchronous tap ``fn(name, args)``.  Taps run on the
+        POSTING thread (the step worker for most events), so they must
+        be cheap and non-blocking — a dict swap, a counter, never a
+        lock that request paths contend on."""
+        with self._taps_lock:
+            self._taps = (*self._taps, fn)
+
+    def remove_tap(self, fn: Callable) -> None:
+        with self._taps_lock:
+            self._taps = tuple(t for t in self._taps if t is not fn)
+
+    def _run_taps(self, name: str, args) -> None:
+        for t in self._taps:  # one attribute load; tuple is immutable
+            try:
+                t(name, args)
+            except Exception:  # noqa: BLE001 — observability/routing
+                # taps must never break the event path
+                _log.exception("event tap raised")
 
     def close(self) -> None:
         self._stop.set()
@@ -106,6 +138,8 @@ class EventFanout:
 
     # -- raft events ------------------------------------------------------
     def leader_updated(self, info: LeaderInfo) -> None:
+        if self._taps:
+            self._run_taps("leader_updated", (info,))
         if self.raft_listener is not None:
             self._post(self.raft_listener.leader_updated, info)
 
@@ -126,6 +160,8 @@ class EventFanout:
                 except Exception:  # noqa: BLE001 — observability must
                     # never break the event path
                     _log.exception("event tap raised")
+            if self._taps:
+                self._run_taps(name, args)
             if self.system_listener is not None:
                 target = getattr(self.system_listener, name, None)
                 if target is not None:

@@ -1,0 +1,696 @@
+"""The serving front plane: session multiplexing, batched submission,
+leader routing, overload shedding, lease reads.
+
+reference: dragonboat serves client traffic straight off NodeHost; the
+missing production layer this module adds is the INGRESS story the
+ROADMAP's item 4 describes — many lightweight client handles multiplexed
+onto few raft-path submissions:
+
+* :class:`ClientHandle` — a cheap per-client object wrapping one
+  exactly-once ``client.Session`` (keyed into the replicated
+  ``rsm/session.py`` SessionManager for dedupe).  Per-session ordering
+  is STRUCTURAL: a handle has at most one proposal in flight; later
+  proposals queue on the handle and are released by the completion of
+  the previous one — exactly the series-id discipline the session
+  registry requires.
+* :class:`Gateway` — accepts handles' proposals, sheds at the door
+  (``gateway/admission.py``), coalesces admitted ones into per-shard
+  batches drained by a small worker pool, and submits each batch
+  through the routed leader host's ``NodeHost.propose`` (one
+  ``engine.notify`` wake per request, but the node-level proposal
+  queue drains the whole batch into ONE raft append).  Reads take the
+  CheckQuorum lease fast path (``NodeHost.try_lease_read``) and fall
+  back to ReadIndex.
+
+Retry discipline inside the worker: DROPPED (definitely not committed)
+attempts are retried for every handle; timed-out (maybe committed)
+attempts are retried ONLY on exactly-once handles, where the unchanged
+series id makes the retry dedupe-safe (reference client semantics [U])
+— noop handles surface the timeout instead, preserving at-most-once.
+Once any attempt is maybe-committed, every terminal failure path burns
+the series (``proposal_completed``) so the handle's NEXT op can never
+be mistaken for a retry of the dead one.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..client import LatencyBudget, Session
+from ..logger import get_logger
+from ..metrics import MetricsRegistry
+from ..request import RequestResultCode, ShardNotFound, SystemBusy
+from .admission import AdmissionController
+from .routing import RoutingCache
+
+_log = get_logger("gateway")
+
+
+class GatewayBusy(SystemBusy):
+    """Shed at the gateway door (queue full / deadline infeasible).
+    Subclasses SystemBusy so ``client.call_with_retry`` treats it as
+    the transient it is."""
+
+
+class GatewayClosed(RuntimeError):
+    pass
+
+
+class GatewayConfig:
+    """Knobs for one Gateway (defaults suit the in-proc test fleets;
+    see docs/GATEWAY.md for sizing guidance)."""
+
+    def __init__(
+        self,
+        *,
+        workers: int = 2,
+        max_batch: int = 64,
+        max_queue_per_shard: int = 256,
+        default_timeout: float = 5.0,
+        lease_margin_ticks: int = 2,
+        shed_dump_threshold: int = 50,
+        shed_dump_window: float = 5.0,
+        shed_dump_cooldown: float = 30.0,
+        budget: Optional[LatencyBudget] = None,
+    ):
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.workers = workers
+        self.max_batch = max_batch
+        self.max_queue_per_shard = max_queue_per_shard
+        self.default_timeout = default_timeout
+        self.lease_margin_ticks = lease_margin_ticks
+        self.shed_dump_threshold = shed_dump_threshold
+        self.shed_dump_window = shed_dump_window
+        self.shed_dump_cooldown = shed_dump_cooldown
+        self.budget = budget
+
+
+class GatewayFuture:
+    """Completion future for one gateway proposal."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def _complete(self, result=None, exc: Optional[BaseException] = None):
+        self._result = result
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            from ..nodehost import TimeoutError_
+
+            raise TimeoutError_("gateway future wait timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _GwReq:
+    __slots__ = ("handle", "cmd", "deadline", "future", "t_admit",
+                 "ambiguous")
+
+    def __init__(self, handle, cmd: bytes, deadline: float):
+        self.handle = handle
+        self.cmd = cmd
+        self.deadline = deadline
+        self.future = GatewayFuture()
+        self.t_admit = time.monotonic()
+        # True once ANY attempt of this op may have committed (a node-
+        # side timeout, or termination with the outcome unobserved):
+        # the series must then be burned on EVERY terminal path, not
+        # just the final-code-TIMEOUT one — a later DROPPED attempt
+        # does not un-commit the earlier ambiguous one (review
+        # finding: reusing the series for the next op would let the
+        # dedupe registry swallow it as a retry of this one)
+        self.ambiguous = False
+
+
+class ClientHandle:
+    """One logical client: a Session plus its not-yet-released op FIFO.
+
+    Cheap by design (a Session dataclass, a deque, one bool) — the
+    multiplexing economics come from handles sharing the gateway's
+    worker pool and per-shard lanes instead of each owning threads."""
+
+    __slots__ = ("gateway", "session", "shard_id", "_lock", "_queue",
+                 "_inflight", "closed")
+
+    def __init__(self, gateway: "Gateway", session: Session):
+        self.gateway = gateway
+        self.session = session
+        self.shard_id = session.shard_id
+        self._lock = threading.Lock()
+        self._queue: deque = deque()  # guarded-by: _lock
+        self._inflight = False  # guarded-by: _lock
+        self.closed = False
+
+    def is_exactly_once(self) -> bool:
+        return not self.session.is_noop()
+
+    def propose(self, cmd: bytes, timeout: Optional[float] = None):
+        """Queue one proposal; returns a :class:`GatewayFuture`.
+        Sheds (GatewayBusy) at the door, never after queueing."""
+        return self.gateway._submit(self, cmd, timeout)
+
+    def sync_propose(self, cmd: bytes, timeout: Optional[float] = None):
+        t = timeout if timeout is not None else self.gateway.config.default_timeout
+        return self.propose(cmd, timeout=t).result(t + 1.0)
+
+    def close(self, timeout: float = 2.0) -> None:
+        self.gateway.close_handle(self, timeout=timeout)
+
+
+class Gateway:
+    """See module docstring.  ``hosts`` maps host key -> NodeHost (the
+    same shape the balance Collector consumes); in-proc fleets pass the
+    test harness's dict, a real deployment registers its single local
+    host plus any co-located ones."""
+
+    def __init__(
+        self,
+        hosts: Dict[str, object],
+        config: Optional[GatewayConfig] = None,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self.config = config or GatewayConfig()
+        # copy-on-write (same discipline as RoutingCache._table and
+        # EventFanout._taps): NEVER mutated in place — add/remove_host
+        # build a fresh dict under _hosts_lock and swap the reference,
+        # so the per-request paths (reads, proposal routing, shed
+        # recording) read it in ONE attribute load with no lock and no
+        # copy (review finding: a per-request locked dict copy
+        # reintroduced exactly the per-request-mutex shape the
+        # gateway-hot lint rule bans)
+        self._hosts: Dict[str, object] = dict(hosts)
+        self._hosts_lock = threading.Lock()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.budget = self.config.budget or LatencyBudget(
+            bootstrap=0.25, floor=0.05
+        )
+        self.routes = RoutingCache(self._live_hosts, metrics=self.metrics)
+        self.admission = AdmissionController(
+            self.budget,
+            max_queue_per_shard=self.config.max_queue_per_shard,
+            batch_hint=self.config.max_batch,
+            dump_threshold=self.config.shed_dump_threshold,
+            dump_window=self.config.shed_dump_window,
+            dump_cooldown=self.config.shed_dump_cooldown,
+            dump_cb=self._shed_dump,
+            metrics=self.metrics,
+        )
+        # completion counters mutate under _done_lock: tests and the
+        # bench read them as exact deltas, and Counter.add is a GIL-
+        # racy read-modify-write when several workers complete
+        # concurrently (review finding).  The read-path counters
+        # (lease/fallback/route) keep the project-wide lock-free-ish
+        # metrics convention — nothing depends on them exactly.
+        self._done_lock = threading.Lock()
+        self._committed = self.metrics.counter("gateway_committed_total")  # guarded-by: _done_lock
+        self._failed = self.metrics.counter("gateway_failed_total")  # guarded-by: _done_lock
+        self._lease_reads = self.metrics.counter("gateway_lease_read_total")
+        self._fallback_reads = self.metrics.counter(
+            "gateway_read_fallback_total"
+        )
+        self._latency = self.metrics.histogram("gateway_request_seconds")
+        # per-shard submission lanes: shard -> deque of _GwReq released
+        # by their handles; lanes are partitioned over workers by
+        # shard_id so one shard's batch is always built by one worker
+        self._lanes: Dict[int, deque] = {}
+        self._lanes_lock = threading.Lock()
+        self._closed = False
+        self.last_shed_dump = ""
+        # resolved once per host-set change, NOT per shed: the shed
+        # path runs on client threads exactly when the gateway is
+        # overloaded, so it must be one attribute load + one ring
+        # append (review finding: a per-shed host-dict copy under
+        # _hosts_lock concentrated contention on the overload path)
+        self._shed_recorder = None
+        self._taps = []  # (host, fn) pairs for detach on close
+        for key, nh in self._hosts.items():
+            self._attach_host(key, nh)
+        self._refresh_shed_recorder()
+        self._wake_events = [
+            threading.Event() for _ in range(self.config.workers)
+        ]
+        self._workers = [
+            threading.Thread(
+                target=self._worker_main,
+                args=(i,),
+                daemon=True,
+                name=f"tpu-gw-worker-{i}",
+            )
+            for i in range(self.config.workers)
+        ]
+        for t in self._workers:
+            t.start()
+
+    # -- host membership ---------------------------------------------------
+    def _live_hosts(self) -> Dict[str, object]:
+        """Current host-map snapshot: one attribute load, lock-free
+        (copy-on-write — treat as immutable, never mutate)."""
+        return self._hosts
+
+    def _attach_host(self, key: str, nh) -> None:
+        tap = self.routes.host_tap(key)
+        try:
+            nh.add_event_tap(tap)
+            self._taps.append((nh, tap))
+        except Exception:  # noqa: BLE001 — a host without a fanout
+            # (test double) still routes via discovery
+            _log.exception("gateway: could not tap host %s", key)
+
+    def _refresh_shed_recorder(self) -> None:
+        rec = None
+        for _, nh in sorted(self._live_hosts().items()):
+            r = getattr(nh, "recorder", None)
+            if r is not None:
+                rec = r
+                break
+        self._shed_recorder = rec
+
+    def add_host(self, key: str, nh) -> None:
+        with self._hosts_lock:
+            t = dict(self._hosts)
+            t[key] = nh
+            self._hosts = t
+        self._attach_host(key, nh)
+        self._refresh_shed_recorder()
+
+    def remove_host(self, key: str) -> None:
+        with self._hosts_lock:
+            t = dict(self._hosts)
+            nh = t.pop(key, None)
+            self._hosts = t
+        if nh is None:
+            return
+        for pair in list(self._taps):
+            if pair[0] is nh:
+                try:
+                    nh.remove_event_tap(pair[1])
+                except Exception:  # noqa: BLE001 — host already closed
+                    pass
+                self._taps.remove(pair)
+        self.routes.invalidate_all()
+        self._refresh_shed_recorder()
+
+    # -- session lifecycle -------------------------------------------------
+    def connect(self, shard_id: int, timeout: float = 5.0) -> ClientHandle:
+        """Register an exactly-once session through the routed leader
+        host and wrap it in a handle (reference: SyncGetSession [U]).
+        Retries the transient failures a still-electing shard emits
+        until ``timeout`` (client.call_with_retry discipline)."""
+        if self._closed:
+            raise GatewayClosed("gateway closed")
+        from ..client import call_with_retry
+
+        deadline = time.monotonic() + timeout
+
+        def register():
+            nh = self._host_for(shard_id, any_ok=True)
+            if nh is None:
+                raise ShardNotFound(f"no live host for shard {shard_id}")
+            per_try = max(0.2, min(2.0, deadline - time.monotonic()))
+            return nh.sync_get_session(shard_id, timeout=per_try)
+
+        session = call_with_retry(register, deadline=deadline)
+        return ClientHandle(self, session)
+
+    def noop_handle(self, shard_id: int) -> ClientHandle:
+        """At-most-once handle (no dedupe; reference: NoOPSession [U])."""
+        return ClientHandle(self, Session.noop(shard_id))
+
+    def close_handle(self, handle: ClientHandle, timeout: float = 2.0) -> None:
+        handle.closed = True
+        if not handle.is_exactly_once():
+            return
+        nh = self._host_for(handle.shard_id, any_ok=True)
+        if nh is None:
+            return
+        try:
+            nh.sync_close_session(handle.session, timeout=timeout)
+        except Exception:  # noqa: BLE001 — registry LRU will evict it
+            pass
+
+    # -- submission path -----------------------------------------------------
+    def _submit(self, handle: ClientHandle, cmd: bytes,
+                timeout: Optional[float]):
+        if self._closed:
+            raise GatewayClosed("gateway closed")
+        if handle.closed:
+            raise GatewayClosed("handle closed")
+        t = timeout if timeout is not None else self.config.default_timeout
+        deadline = time.monotonic() + t
+        reason = self.admission.admit(handle.shard_id, deadline)
+        if reason is not None:
+            self._record_shed(handle.shard_id, reason)
+            raise GatewayBusy(f"shed: {reason} (shard {handle.shard_id})")
+        req = _GwReq(handle, cmd, deadline)
+        with handle._lock:
+            if handle._inflight:
+                handle._queue.append(req)
+                return req.future
+            handle._inflight = True
+        self._enqueue(req)
+        return req.future
+
+    def _enqueue(self, req: _GwReq) -> None:
+        sid = req.handle.shard_id
+        with self._lanes_lock:
+            # re-check closed UNDER the lanes lock: close() swaps the
+            # lanes dict out under this lock and seals what it swapped —
+            # a request landing in the fresh dict after the swap would
+            # have no worker left to drain it and its caller would hang
+            # (review finding)
+            if not self._closed:
+                lane = self._lanes.get(sid)
+                if lane is None:
+                    lane = self._lanes[sid] = deque()
+                lane.append(req)
+                sealed = False
+            else:
+                sealed = True
+        if sealed:
+            self._fail(req, GatewayClosed("gateway closed"))
+            return
+        self._wake_events[sid % self.config.workers].set()
+
+    def _release_next(self, handle: ClientHandle) -> None:
+        """Completion of a handle's in-flight op releases its next one
+        (per-session ordering: the series id advanced only now).  After
+        close, queued ops are sealed here in a loop — no worker will
+        drain them and their callers must not hang."""
+        while True:
+            with handle._lock:
+                if handle._queue:
+                    nxt = handle._queue.popleft()
+                else:
+                    handle._inflight = False
+                    return
+            if not self._closed:
+                self._enqueue(nxt)
+                return
+            with self._done_lock:
+                self._failed.add()
+            self.admission.complete(nxt.handle.shard_id)
+            nxt.future._complete(exc=GatewayClosed("gateway closed"))
+
+    # -- worker pool ---------------------------------------------------------
+    def _my_lanes(self, idx: int):
+        with self._lanes_lock:
+            return [
+                sid for sid in self._lanes
+                if sid % self.config.workers == idx
+            ]
+
+    def _drain(self, sid: int, limit: int):
+        out = []
+        with self._lanes_lock:
+            lane = self._lanes.get(sid)
+            while lane and len(out) < limit:
+                out.append(lane.popleft())
+        return out
+
+    def _worker_main(self, idx: int) -> None:
+        """Drain-submit-poll loop.  Completions are POLLED, never
+        blocked on: a shard that lost quorum must not head-of-line
+        block the other shards mapped to this worker for its requests'
+        whole deadlines (review finding) — its pending pairs just ride
+        the ``pending`` list while every other lane keeps draining.
+        The poll cadence (5ms with work in flight) bounds the added
+        completion latency."""
+        ev = self._wake_events[idx]
+        pending = []  # (req, rs) submitted, awaiting completion
+        while not self._closed:
+            ev.wait(timeout=0.005 if pending else 0.05)
+            ev.clear()
+            for sid in self._my_lanes(idx):
+                for req in self._drain(sid, self.config.max_batch):
+                    rs = self._propose_once(req)
+                    if rs is not None:
+                        pending.append((req, rs))
+            if pending:
+                still = []
+                for req, rs in pending:
+                    nrs = self._poll_finish(req, rs)
+                    if nrs is not None:
+                        still.append((req, nrs))
+                pending = still
+        for req, _rs in pending:
+            # submitted but unresolved at close: may still commit
+            req.ambiguous = True
+            self._fail(req, GatewayClosed("gateway closed"))
+
+    def _host_for(self, shard_id: int, any_ok: bool = False):
+        key = self.routes.resolve(shard_id)
+        hosts = self._live_hosts()
+        nh = hosts.get(key) if key is not None else None
+        if nh is not None and not getattr(nh, "_closed", False):
+            return nh
+        if key is not None:
+            self.routes.invalidate(shard_id)
+        if not any_ok:
+            return None
+        # no known leader: any live host carrying the shard will do —
+        # followers forward proposals, session ops and read_index alike
+        for _, nh in sorted(hosts.items()):
+            if getattr(nh, "_closed", False):
+                continue
+            try:
+                nh._get_node(shard_id)
+                return nh
+            except Exception:  # noqa: BLE001 — shard not on this host
+                continue
+        return None
+
+    def _propose_once(self, req: _GwReq):
+        """One submission attempt; completes the future on terminal
+        errors, returns the RequestState otherwise."""
+        remaining = req.deadline - time.monotonic()
+        if remaining <= 0:
+            # expired while queued (e.g. behind a retrying predecessor
+            # on its handle): fail BEFORE submission — a doomed submit
+            # wastes a raft append and its inevitable timeout marks
+            # the op ambiguous, burning a series for nothing (review
+            # finding).  Nothing was proposed, so nothing is ambiguous.
+            from ..nodehost import TimeoutError_
+
+            self._fail(req, TimeoutError_("gateway deadline (pre-submit)"))
+            return None
+        nh = self._host_for(req.handle.shard_id, any_ok=True)
+        if nh is None:
+            self._fail(req, ShardNotFound(
+                f"no live host for shard {req.handle.shard_id}"))
+            return None
+        try:
+            return nh.propose(req.handle.session, req.cmd, remaining)
+        except Exception as e:  # noqa: BLE001 — classified below
+            self.routes.invalidate(req.handle.shard_id)
+            self._fail(req, e)
+            return None
+
+    def _poll_finish(self, req: _GwReq, rs):
+        """Non-blocking completion check for one submitted request.
+        Returns None when the gateway future was completed (done,
+        failed, or timed out), else the RequestState — possibly a NEW
+        one after a dedupe-safe resubmission — to keep polling."""
+        from ..nodehost import _CODE_ERRORS, TimeoutError_
+
+        if not rs._event.is_set():
+            # still pending node-side (the event is set LAST in
+            # notify, after code/result — a set event is a complete,
+            # readable outcome)
+            if time.monotonic() < req.deadline:
+                return rs
+            # gateway deadline exhausted on an op that may still
+            # commit: ambiguous (the _fail path burns the series —
+            # audit-client discipline)
+            req.ambiguous = True
+            self._fail(req, TimeoutError_("gateway deadline"))
+            return None
+        code = rs.code
+        if code == RequestResultCode.COMPLETED:
+            lat = time.monotonic() - req.t_admit
+            if req.handle.is_exactly_once():
+                req.handle.session.proposal_completed()
+            self.budget.observe(lat)
+            with self._done_lock:
+                self._latency.observe(lat)
+                self._committed.add()
+            self._done(req, result=rs.result)
+            return None
+        if code in (
+            RequestResultCode.TIMEOUT,
+            RequestResultCode.TERMINATED,
+            RequestResultCode.ABORTED,
+        ):
+            # maybe-committed outcomes (the audit client's
+            # _MAYBE_COMMITTED_ERRORS set): a timed-out entry may
+            # commit later, and a TERMINATED one may already be
+            # PERSISTED in the raft log — a shard restart replays and
+            # applies it (review finding).  Ambiguity is forever for
+            # this op — even if a LATER attempt ends DROPPED, an
+            # earlier copy may still commit, so the terminal path must
+            # burn the series.  DROPPED and REJECTED are definitive
+            # no-effect outcomes and stay unambiguous.
+            req.ambiguous = True
+        # DROPPED (definitely not committed) retries for everyone.
+        # TIMEOUT (maybe committed) retries ONLY for exactly-once
+        # handles, whose unchanged series id lets the session registry
+        # dedupe a double apply; resubmitting a maybe-committed noop
+        # proposal would break noop_handle's at-most-once contract
+        # (review finding).
+        retryable = code == RequestResultCode.DROPPED or (
+            code == RequestResultCode.TIMEOUT
+            and req.handle.is_exactly_once()
+        )
+        if retryable and req.deadline - time.monotonic() > 0.01:
+            # pacing comes from the node round trip + the poll cadence
+            self.routes.invalidate(req.handle.shard_id)
+            return self._propose_once(req)  # None => future completed
+        err = _CODE_ERRORS.get(code, TimeoutError_)
+        self._fail(req, err(code.name if code is not None else "unknown"))
+        return None
+
+    def _done(self, req: _GwReq, result) -> None:
+        self.admission.complete(req.handle.shard_id)
+        req.future._complete(result=result)
+        self._release_next(req.handle)
+
+    def _fail(self, req: _GwReq, exc: BaseException) -> None:
+        if req.ambiguous and req.handle.is_exactly_once():
+            # some attempt of this op may still commit: burn the
+            # series exactly once so the handle's NEXT op can never be
+            # taken for a retry of this one (review finding — a
+            # terminal DROPPED after an ambiguous TIMEOUT previously
+            # skipped the burn)
+            req.ambiguous = False
+            req.handle.session.proposal_completed()
+        with self._done_lock:
+            self._failed.add()
+        self.admission.complete(req.handle.shard_id)
+        req.future._complete(exc=exc)
+        self._release_next(req.handle)
+
+    # -- reads ---------------------------------------------------------------
+    def read(self, shard_id: int, query, timeout: Optional[float] = None):
+        """Linearizable read.  Fast path: the routed leader host serves
+        it under its CheckQuorum lease, skipping the per-read ReadIndex
+        quorum round trip; fallback: plain ``sync_read`` (ReadIndex)
+        through any live host.  Safety: docs/GATEWAY.md."""
+        if self._closed:
+            raise GatewayClosed("gateway closed")
+        t = timeout if timeout is not None else self.config.default_timeout
+        deadline = time.monotonic() + t
+        key = self.routes.resolve(shard_id)
+        if key is not None:
+            nh = self._live_hosts().get(key)
+            if nh is not None and not getattr(nh, "_closed", False):
+                try:
+                    ok, val = nh.try_lease_read(
+                        shard_id, query,
+                        margin_ticks=self.config.lease_margin_ticks,
+                    )
+                    if ok:
+                        self._lease_reads.add()
+                        return val
+                except Exception:  # noqa: BLE001 — host/shard stopping:
+                    # fall through to the quorum path
+                    self.routes.invalidate(shard_id)
+        # ReadIndex fallback, retried across hosts until the deadline
+        self._fallback_reads.add()
+        last_exc: Optional[BaseException] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                from ..nodehost import TimeoutError_
+
+                raise last_exc or TimeoutError_("gateway read deadline")
+            nh = self._host_for(shard_id, any_ok=True)
+            if nh is None:
+                time.sleep(0.02)
+                continue
+            try:
+                return nh.sync_read(shard_id, query, timeout=remaining)
+            except Exception as e:  # noqa: BLE001 — reads are
+                # idempotent; retry through another route
+                last_exc = e
+                self.routes.invalidate(shard_id)
+                time.sleep(0.02)
+
+    # -- overload evidence -----------------------------------------------------
+    def _record_shed(self, shard_id: int, reason: str) -> None:
+        rec = self._shed_recorder  # one attribute load on the hot path
+        if rec is not None:
+            rec.record(shard_id, "gateway_shed", reason)
+
+    def _shed_dump(self, why: str) -> None:
+        """Sustained shedding: capture the merged cross-host timeline
+        (the flight recorder's whole point — evidence at the moment the
+        front door starts refusing work)."""
+        from ..obs import format_timeline, merged_timeline
+
+        hosts = list(self._live_hosts().values())
+        recs = [h for h in (getattr(n, "recorder", None) for n in hosts)
+                if h is not None]
+        tracers = [t for t in (getattr(n, "tracer", None) for n in hosts)
+                   if t is not None]
+        dump = why
+        if recs or tracers:
+            try:
+                dump = why + "\n" + format_timeline(
+                    merged_timeline(recorders=recs, tracers=tracers)
+                )
+            except Exception:  # noqa: BLE001 — evidence best-effort
+                pass
+        self.last_shed_dump = dump
+        _log.warning("gateway overload: %s", dump[:4000])
+
+    # -- observability ----------------------------------------------------------
+    def stats(self) -> dict:
+        with self._done_lock:
+            committed = self._committed.value
+            failed = self._failed.value
+        return {
+            "committed": committed,
+            "failed": failed,
+            "shed": self.admission.shed_total,
+            "shed_dumps": self.admission.dumps,
+            "lease_reads": self._lease_reads.value,
+            "read_fallbacks": self._fallback_reads.value,
+            "route_table": self.routes.table(),
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for ev in self._wake_events:
+            ev.set()
+        for t in self._workers:
+            t.join(timeout=2.0)
+        for nh, tap in self._taps:
+            try:
+                nh.remove_event_tap(tap)
+            except Exception:  # noqa: BLE001 — host already closed
+                pass
+        self._taps.clear()
+        # seal everything still queued: no worker will drain it now
+        with self._lanes_lock:
+            lanes, self._lanes = self._lanes, {}
+        for lane in lanes.values():
+            for req in lane:
+                self._fail(req, GatewayClosed("gateway closed"))

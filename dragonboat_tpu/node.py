@@ -1272,6 +1272,60 @@ class Node:
         del self._retired_snapshots[:-1]
 
     # ------------------------------------------------------------------
+    # leader-lease reads (gateway/ front plane; docs/GATEWAY.md)
+    # ------------------------------------------------------------------
+    def lease_remaining_ticks(self) -> int:
+        """Ticks of CheckQuorum leader lease left, or 0 when no lease.
+
+        The lease argument (docs/GATEWAY.md "Lease-read safety"): with
+        ``check_quorum`` on, every follower refuses to grant votes while
+        it heard from a live leader within its own election window
+        (``Raft._in_lease``), so no challenger can be elected until one
+        full election window after a majority last heard from us; the
+        leader renews the lease on every quorum of replicate/heartbeat
+        responses (``Raft.lease_remaining_ticks`` over the remotes'
+        ``last_resp_tick``), so a healthy leader holds it continuously
+        instead of saw-toothing with the check-quorum boundary.
+        Serving a local read additionally requires (same as ReadIndex
+        serving):
+
+        * a committed entry in the CURRENT term (a fresh leader's
+          commit index is not yet proven current);
+        * ``last_applied`` caught up to the local commit index, so the
+          lookup observes every entry this leader committed.
+
+        Callers keep a safety margin (ticks are per-host logical
+        clocks; the hosts' tickers drift) — see
+        ``NodeHost.try_lease_read``.  Lock-free probe off producer
+        threads: every field read is one GIL-atomic load, and a lease
+        lost immediately after a True answer is exactly the race the
+        margin exists for."""
+        if self.stopped or self.stopping:
+            return 0
+        r = self.peer.raft
+        if not r.check_quorum or not self.peer.is_leader():
+            return 0
+        try:
+            if not r.committed_entry_in_current_term():
+                return 0
+            if self.sm.last_applied < r.log.committed:
+                return 0
+            # inside the guard too: it copies the membership dicts,
+            # which a concurrently-applying config change mutates
+            # (review finding — "dictionary changed size" would crash
+            # a metrics scrape)
+            return r.lease_remaining_ticks()
+        except Exception:  # noqa: BLE001 — racing a concurrent step's
+            # log/membership mutation (compaction/append/config
+            # change): no lease this probe
+            return 0
+
+    def lease_held(self, margin_ticks: int = 2) -> bool:
+        """True when the CheckQuorum lease has more than ``margin_ticks``
+        left — the gateway's fast-read gate."""
+        return self.lease_remaining_ticks() > margin_ticks
+
+    # ------------------------------------------------------------------
     def get_membership(self) -> Membership:
         return self.sm.get_membership()
 

@@ -680,6 +680,33 @@ class NodeHost:
     def stale_read(self, shard_id: int, query):
         return self._get_node(shard_id).stale_read(query)
 
+    def try_lease_read(
+        self, shard_id: int, query, margin_ticks: int = 2
+    ) -> tuple:
+        """Serve a linearizable read from the local replica WITHOUT the
+        per-read ReadIndex quorum round trip, iff this replica holds a
+        CheckQuorum leader lease with more than ``margin_ticks`` to
+        spare (gateway/ fast-read path; safety argument in
+        ``Node.lease_remaining_ticks`` and docs/GATEWAY.md).  Returns
+        ``(True, value)`` on a lease-served read, ``(False, None)``
+        when the caller must fall back to :meth:`read_index`/
+        :meth:`sync_read`.  The margin absorbs tick drift between
+        hosts and the probe-to-lookup race; requires the shard's
+        ``Config.check_quorum`` or the lease is never held."""
+        node = self._get_node(shard_id)
+        if not node.lease_held(margin_ticks):
+            return False, None
+        return True, node.lookup(query)
+
+    def lease_status(self, shard_id: int) -> dict:
+        """Lease observability probe (tests, metrics scrapes)."""
+        node = self._get_node(shard_id)
+        return {
+            "is_leader": node.peer.is_leader(),
+            "check_quorum": node.peer.raft.check_quorum,
+            "remaining_ticks": node.lease_remaining_ticks(),
+        }
+
     # -- membership -------------------------------------------------------
     def _sync_config_change(
         self,
@@ -785,6 +812,17 @@ class NodeHost:
         lid = node.peer.leader_id()
         return lid, lid != 0
 
+    def is_leader_of(self, shard_id: int) -> bool:
+        """True iff this host's replica of ``shard_id`` currently leads
+        it (routing-cache discovery probe; False for absent shards —
+        discovery sweeps hosts that may not carry the shard at all)."""
+        with self._nodes_lock:
+            node = self._nodes.get(shard_id)
+        if node is None or node.stopped or node.stopping:
+            return False
+        lid = node.leader_id
+        return bool(lid) and lid == node.replica_id
+
     # -- info -------------------------------------------------------------
     def pending_request_counts(self, shard_id: int) -> Dict[str, int]:
         """Outstanding request futures per table for one LIVE shard
@@ -807,6 +845,16 @@ class NodeHost:
         NodeHost.WriteHealthMetrics [U]); enable via
         NodeHostConfig.enable_metrics."""
         writer.write(self.metrics.export_text())
+
+    # -- event taps (gateway/ routing-cache invalidation) --------------
+    def add_event_tap(self, fn) -> None:
+        """Attach a synchronous ``fn(name, args)`` tap to this host's
+        event fanout; sees every system event plus ``leader_updated``
+        (events.EventFanout.add_tap)."""
+        self.events.add_tap(fn)
+
+    def remove_event_tap(self, fn) -> None:
+        self.events.remove_tap(fn)
 
     # -- observability (obs/, docs/OBSERVABILITY.md) -------------------
     def _recorder_tap(self, name: str, args) -> None:

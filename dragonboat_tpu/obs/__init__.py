@@ -6,7 +6,10 @@ recorder (docs/OBSERVABILITY.md).
   Chrome/Perfetto ``trace_event`` exporter;
 * :mod:`.recorder` — the per-shard flight recorder ring buffers,
   dumped on demand (``NodeHost.dump_timeline``) and automatically when
-  ``assert_recovery_sla`` trips or an audit gate fails.
+  ``assert_recovery_sla`` trips, an audit gate fails, or the gateway
+  sheds sustainedly (``gateway/admission.py``: overload is a state
+  transition too — the moment the front door starts refusing work
+  there must be a cross-host record of why).
 
 Both are off by default (``NodeHostConfig.enable_tracing`` /
 ``enable_flight_recorder``); the disabled hot paths cost one attribute

@@ -89,7 +89,8 @@ class Raft:
         "_timeout_seq", "leader_transfer_target", "pending_config_change",
         "is_leader_transfer_target", "snapshotting", "tick_count",
         "applied", "launched_non_voting", "launched_witness",
-        "_cq_grace_at", "_term_lim_warned",
+        "_cq_grace_at", "_term_lim_warned", "_campaign_sent_tick",
+        "_boot_lease_grace",
     )
 
     def __init__(
@@ -178,6 +179,24 @@ class Raft:
             self.term = state.term
             self.vote = state.vote
             self.log.committed = state.commit
+
+        # tick at which the current (real) campaign's vote requests were
+        # sent: the become_leader lease seed — granters reset their
+        # election clocks no earlier than this (-1 = never campaigned)
+        self._campaign_sent_tick = -1
+        # restart hole in the vote-refusal lease (review finding):
+        # leader_id is volatile, so a crash-restarted voter would grant
+        # votes IMMEDIATELY even though, pre-crash, it refused them
+        # inside a live leader's lease window — a challenger elected
+        # through such votes breaks the leader's lease-read safety
+        # argument.  A restored voter therefore refuses non-transfer
+        # votes for its first election window (it cannot know how
+        # recently it heard from a leader; one window over-covers).
+        self._boot_lease_grace = (
+            self.election_timeout
+            if check_quorum and state is not None and not state.is_empty()
+            else 0
+        )
 
         self._reset_randomized_timeout()
 
@@ -394,8 +413,20 @@ class Raft:
         # the fused-tick engine a whole election window can elapse in
         # two launches, exactly one ack round-trip, and a hair-trigger
         # first check deposed every new leader forever
+        # lease seed: anchor at the CAMPAIGN SEND tick, not the current
+        # tick — the vote grants that elected us reset the granters'
+        # election clocks at grant time, which is no earlier than the
+        # vote-request send (anchoring at become_leader time would
+        # overclaim by the whole vote round trip; review finding)
+        seed = (
+            self._campaign_sent_tick
+            if self._campaign_sent_tick >= 0
+            else self.tick_count if self.is_single_voter() else -1
+        )
         for rm in self.all_remotes().values():
             rm.set_active()
+            rm.last_resp_tick = max(rm.last_resp_tick, seed)
+            rm.probe_queue.clear()  # fresh leadership, fresh probes
         self._compute_pending_config_change()
         # commit barrier: append an empty entry at the new term
         self._append_entries([Entry(type=EntryType.APPLICATION, cmd=b"")])
@@ -478,12 +509,40 @@ class Raft:
         )
         self.msgs.append(m)
 
+    # probe_queue bound: past this many unanswered probes, arms are
+    # skipped (pops then anchor even older — the safe direction)
+    _LEASE_PROBE_QUEUE_CAP = 128
+
+    def _arm_lease_probe(self, rm) -> None:
+        """A heartbeat/replicate to this peer is a lease probe: queue
+        its send tick (FIFO; see Remote.last_resp_tick for the full
+        anchoring contract and why the queue is never cleared)."""
+        if len(rm.probe_queue) < self._LEASE_PROBE_QUEUE_CAP:
+            rm.probe_queue.append(self.tick_count)
+
+    def _anchor_lease_resp(self, rm) -> None:
+        """A response proves contact no later than the answered probe's
+        send (the follower's election clock reset at its receipt, which
+        is >= that send under bounded skew).  Pop the FIFO head: the
+        answered probe's send tick, or older when earlier probes or
+        responses were lost — conservative either way.  Empty queue =>
+        no anchor update — NEVER anchor at response receipt (review
+        findings: receipt can lag the probe by unbounded queueing, and
+        a cleared-then-re-armed slot mis-anchored a delayed response at
+        a probe sent after it)."""
+        if not rm.probe_queue:
+            return
+        probe = rm.probe_queue.pop(0)
+        if probe > rm.last_resp_tick:
+            rm.last_resp_tick = probe
+
     def broadcast_heartbeat(self, ctx: Optional[SystemCtx] = None) -> None:
         if ctx is None:
             ctx = self.read_index.peek_ctx()
         for pid, rm in sorted(self.all_remotes().items()):
             if pid == self.replica_id:
                 continue
+            self._arm_lease_probe(rm)
             self._send(
                 Message(
                     type=MessageType.HEARTBEAT,
@@ -516,6 +575,7 @@ class Raft:
         except (LogCompactedError, LogUnavailableError):
             self._send_snapshot(to, rm)
             return
+        self._arm_lease_probe(rm)
         self._send(
             Message(
                 type=MessageType.REPLICATE,
@@ -540,7 +600,12 @@ class Raft:
     def _send_snapshot(self, to: int, rm: Remote) -> None:
         ss = self.log.logdb.snapshot()
         if ss.is_empty():
-            # nothing to send yet (snapshot still being produced); retry later
+            # nothing to send yet (snapshot still being produced); retry
+            # later.  NO lease probe armed on this branch: nothing was
+            # sent, so nothing will respond, and a phantom probe_queue
+            # entry would shift every later anchor one probe older for
+            # the rest of the leadership (review finding — the lease
+            # would decay spuriously on shards with lagging followers)
             rm.become_wait()
             return
         if to in self.witnesses:
@@ -552,6 +617,11 @@ class Raft:
                 witness=True,
                 shard_id=self.shard_id,
             )
+        # a snapshot send is a lease probe too: the follower answers it
+        # with REPLICATE_RESP, and an un-armed send would let that
+        # response pop a LATER probe's tick off the FIFO — shifting
+        # subsequent anchors one probe too NEW (review finding)
+        self._arm_lease_probe(rm)
         self._send(Message(type=MessageType.INSTALL_SNAPSHOT, to=to, snapshot=ss))
         rm.become_snapshot(ss.index)
 
@@ -569,6 +639,9 @@ class Raft:
                 return
             mt = MessageType.REQUEST_PREVOTE
         else:
+            # lease seed anchor: vote requests go out at THIS tick, so
+            # any granter's election clock resets no earlier than it
+            self._campaign_sent_tick = self.tick_count
             self.become_candidate()
             term = self.term
             if self._vote_quorum():
@@ -606,12 +679,68 @@ class Raft:
 
     def _in_lease(self) -> bool:
         """CheckQuorum leader lease: reject votes while a live leader is
-        known and the election timeout has not elapsed."""
+        known and the election timeout has not elapsed — and for the
+        first election window after a restart from persisted state
+        (``_boot_lease_grace``): leader_id does not survive restarts,
+        so a rebooted voter must assume it was inside some leader's
+        lease when it crashed."""
+        if not self.check_quorum:
+            return False
+        if self.tick_count < self._boot_lease_grace:
+            return True
         return (
-            self.check_quorum
-            and self.leader_id != NO_LEADER
+            self.leader_id != NO_LEADER
             and self.election_tick < self.election_timeout
         )
+
+    def quorum_responded_tick(self) -> int:
+        """LEADER side of the lease (gateway lease reads): the most
+        recent tick by which a QUORUM of voters (self included) had
+        responded — the quorum-th freshest ``last_resp_tick``.  Every
+        responder's own election clock was reset by the leader traffic
+        it was responding to, so no challenger can win its vote for one
+        election window past (roughly) that tick; the margin callers
+        keep absorbs the cross-host tick skew (docs/GATEWAY.md
+        "Lease-read safety").  -1 = no quorum evidence yet."""
+        if self.role != RaftRole.LEADER:
+            return -1
+        vm = self.voting_members()
+        if self.replica_id not in vm:
+            # removed from the voter set but not yet stepped down: self
+            # no longer counts toward the quorum, and the REMAINING
+            # voters form a full quorum that can elect a challenger at
+            # any time — no lease (review finding)
+            return -1
+        need = self.quorum() - 1  # self responds implicitly
+        if need <= 0:
+            return self.tick_count  # single-voter shard
+        ticks = sorted(
+            (
+                rm.last_resp_tick
+                for pid, rm in vm.items()
+                if pid != self.replica_id
+            ),
+            reverse=True,
+        )
+        if len(ticks) < need:
+            return -1
+        return ticks[need - 1]
+
+    def lease_remaining_ticks(self) -> int:
+        """Ticks of leader lease left (0 when not leader / no
+        CheckQuorum / no quorum evidence): one election window past the
+        last quorum-responded tick.  A leader TRANSFER in flight also
+        zeroes the lease: transfer votes (hint != 0) bypass the vote-
+        refusal lease by design, so the target can be elected well
+        inside the claimed window (review finding)."""
+        if not self.check_quorum or self.role != RaftRole.LEADER:
+            return 0
+        if self.leader_transfer_target != NO_NODE:
+            return 0
+        base = self.quorum_responded_tick()
+        if base < 0:
+            return 0
+        return max(0, base + self.election_timeout - self.tick_count)
 
     # ------------------------------------------------------------------
     # Step: the single entry point
@@ -849,6 +978,7 @@ class Raft:
         if rm is None:
             return
         rm.set_active()
+        self._anchor_lease_resp(rm)
         if m.reject:
             # m.log_index = rejected prev index, m.hint = follower last index
             if rm.decrease(m.log_index, m.hint):
@@ -880,6 +1010,7 @@ class Raft:
         if rm is None:
             return
         rm.set_active()
+        self._anchor_lease_resp(rm)
         rm.respond_to()
         if rm.match < self.log.last_index():
             self.send_replicate(m.from_)

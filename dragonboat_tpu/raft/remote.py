@@ -13,7 +13,8 @@ The integer values are part of the device SoA encoding (ops/state.py).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import List
 
 
 class RemoteState(enum.IntEnum):
@@ -30,6 +31,27 @@ class Remote:
     state: RemoteState = RemoteState.RETRY
     snapshot_index: int = 0
     active: bool = False  # contacted since last CheckQuorum sweep
+    # CheckQuorum-lease contact evidence (raft.lease_remaining_ticks):
+    # ``last_resp_tick`` is the leader tick a response PROVED contact at
+    # — anchored at a probe's SEND tick, never at response receipt (a
+    # response can sit in flight or queue in the leader's inbox
+    # arbitrarily long; anchoring at receipt would extend the claimed
+    # lease past the follower's actual vote-refusal window by that
+    # delay — review finding).  ``probe_queue`` is a FIFO of
+    # outstanding probe send ticks: each response pops the head.  Both
+    # transports deliver per peer pair in order and the follower
+    # responds in processing order, so the popped tick is the send tick
+    # of the answered probe — or OLDER whenever any earlier probe or
+    # response was dropped (the unanswered entry stays queued and
+    # shifts every later pop one probe older), which only ever makes
+    # the anchor conservative.  The queue is never cleared mid-
+    # leadership (clearing let a delayed response anchor at a probe
+    # armed AFTER it — review finding); accumulated message loss thus
+    # decays lease availability (reads fall back to ReadIndex), never
+    # safety, and the queue resets with fresh leadership.  Bounded:
+    # arms are skipped when full (skipping keeps pops older = safe).
+    last_resp_tick: int = -1
+    probe_queue: List[int] = field(default_factory=list)
 
     def reset(self, next_index: int, match: int = 0) -> None:
         self.match = match

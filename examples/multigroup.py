@@ -6,6 +6,12 @@ in-memory KV, shard 2 an on-disk KV that persists itself and reports its
 applied index at open (only the log tail replays).  Run:
 
     python examples/multigroup.py
+
+NOTE on the client pattern: this example drives NodeHost RAW (resolve
+the leader by hand, ``sync_propose``/``sync_read`` per call) to keep
+the SM-tier mechanics in focus.  For the production client path —
+session handles, leader routing, admission control, lease reads — see
+examples/kv_gateway.py and docs/GATEWAY.md.
 """
 from __future__ import annotations
 

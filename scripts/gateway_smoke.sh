@@ -1,0 +1,107 @@
+#!/usr/bin/env bash
+# Serving-front-plane smoke (gateway tentpole, docs/GATEWAY.md): boot a
+# 3-host in-proc cluster with check_quorum on, front it with a Gateway,
+# then assert
+#   1. exactly-once handles commit a small write workload through the
+#      batched per-shard submission path,
+#   2. reads are served off the CheckQuorum leader LEASE (lease_reads
+#      > 0 — the per-read ReadIndex quorum round trip was skipped),
+#   3. the routing cache converged to the leader host via the
+#      leader_updated event tap,
+#   4. a flooded tiny-queue gateway SHEDS (gateway_shed_total > 0)
+#      while everything it admitted still completes.
+# Cheap (~10s, host path only, no device) — wired into tier1.sh as a
+# post-step.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import shutil
+import sys
+import time
+
+sys.path.insert(0, "tests")
+
+from dragonboat_tpu import (
+    Config,
+    EngineConfig,
+    ExpertConfig,
+    Gateway,
+    GatewayBusy,
+    GatewayConfig,
+    NodeHost,
+    NodeHostConfig,
+)
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+from test_nodehost import KVStore, set_cmd
+
+ADDRS = {1: "gw-smoke-1", 2: "gw-smoke-2", 3: "gw-smoke-3"}
+reset_inproc_network()
+nhs = {}
+for rid, addr in ADDRS.items():
+    d = f"/tmp/nh-gw-smoke-{rid}"
+    shutil.rmtree(d, ignore_errors=True)
+    nhs[addr] = NodeHost(NodeHostConfig(
+        nodehost_dir=d,
+        rtt_millisecond=2,
+        raft_address=addr,
+        expert=ExpertConfig(engine=EngineConfig(exec_shards=2, apply_shards=2)),
+    ))
+gw = None
+try:
+    for rid, addr in ADDRS.items():
+        nhs[addr].start_replica(
+            ADDRS, False, KVStore,
+            Config(replica_id=rid, shard_id=1, election_rtt=10,
+                   heartbeat_rtt=1, check_quorum=True),
+        )
+    deadline = time.time() + 20.0
+    leader = None
+    while time.time() < deadline and leader is None:
+        leader = next((a for a, nh in nhs.items() if nh.is_leader_of(1)), None)
+        time.sleep(0.02)
+    assert leader, "no leader within 20s"
+
+    gw = Gateway(nhs, GatewayConfig(workers=2))
+    h = gw.connect(1, timeout=10.0)
+    for i in range(30):
+        h.sync_propose(set_cmd(f"k{i}", i), timeout=10.0)  # (1)
+    for i in (0, 29):
+        assert gw.read(1, f"k{i}", timeout=10.0) == i
+    st = gw.stats()
+    assert st["committed"] == 30, st
+    assert st["lease_reads"] >= 1, st                       # (2)
+    assert st["route_table"].get(1) == leader, (st, leader)  # (3)
+    h.close()
+    gw.close()
+
+    # (4) overload: tiny queue, flood of async proposals -> sheds, and
+    # every admitted future completes
+    gw = Gateway(nhs, GatewayConfig(workers=1, max_queue_per_shard=4,
+                                    default_timeout=10.0))
+    handles = [gw.noop_handle(1) for _ in range(8)]
+    futs, sheds = [], 0
+    for r in range(12):
+        for i, hh in enumerate(handles):
+            try:
+                futs.append(hh.propose(set_cmd(f"o{r}-{i}", i)))
+            except GatewayBusy:
+                sheds += 1
+    for f in futs:
+        f.result(20.0)
+    st = gw.stats()
+    assert sheds > 0 and st["shed"] == sheds, st
+    print(
+        f"GATEWAY_SMOKE_OK committed={30 + len(futs)} shed={sheds} "
+        f"lease_reads>=1 route={leader}"
+    )
+finally:
+    if gw is not None:
+        try:
+            gw.close()
+        except Exception:
+            pass
+    for nh in nhs.values():
+        try:
+            nh.close()
+        except Exception:
+            pass
+EOF

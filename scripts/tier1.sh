@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Tier-1 verify: the ROADMAP.md command (plus --durations=15 so the
-# budget hogs are named in every run), runnable from any cwd, with two
-# cheap post-steps: the observability smoke (scripts/obs_smoke.sh, ~5s)
-# and the static-analysis gates + analyzer self-tests (scripts/lint.sh:
-# raftlint + jaxcheck + fixtures, <3m).  Prints DOTS_PASSED=<n> and a
-# TIER1_BUDGET runtime line against the 870s ROADMAP budget, and exits
-# non-zero if any step fails.
+# budget hogs are named in every run), runnable from any cwd, with three
+# cheap post-steps: the observability smoke (scripts/obs_smoke.sh, ~5s),
+# the serving-front-plane smoke (scripts/gateway_smoke.sh, ~10s: batched
+# session proposals, lease reads, routing convergence, overload
+# shedding) and the static-analysis gates + analyzer self-tests
+# (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).  Prints
+# DOTS_PASSED=<n> and a TIER1_BUDGET runtime line against the 870s
+# ROADMAP budget, and exits non-zero if any step fails.
 cd "$(dirname "$0")/.." || exit 1
 t0=$(date +%s)
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --durations=15 --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -18,5 +20,6 @@ if [ "$headroom" -lt 60 ]; then
 fi
 echo "TIER1_BUDGET: pytest ${total}s of 870s (headroom ${headroom}s)${warn}"
 timeout -k 10 120 bash scripts/obs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/gateway_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

@@ -303,6 +303,86 @@ def key(kind, shard, replica):
 
 
 # ---------------------------------------------------------------------------
+# gateway-hot (the serving front plane's lock-free read-path rule)
+# ---------------------------------------------------------------------------
+GATEWAY_HOT_SRC = '''
+import threading
+
+class RoutingCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def lookup(self, shard_id):  # gateway-hot
+        with self._lock:
+            return self._table.get(shard_id)
+
+    def probe(self, shard_id):  # gateway-hot
+        self._lock.acquire()
+        try:
+            return self._table.get(shard_id)
+        finally:
+            self._lock.release()
+
+    def snapshot_ok(self, shard_id):  # gateway-hot
+        return self._table.get(shard_id)
+
+    def learn(self, shard_id, host):
+        with self._lock:
+            t = dict(self._table)
+            t[shard_id] = host
+            self._table = t
+'''
+
+
+def test_gateway_hot_catches_locked_read_path():
+    fs = lint_source(GATEWAY_HOT_SRC, "dragonboat_tpu/gateway/routing.py")
+    assert rules_of(fs) == {"gateway-hot"} and len(fs) == 2
+    flagged = [GATEWAY_HOT_SRC.splitlines()[f.line - 1] for f in fs]
+    assert any("with self._lock" in ln for ln in flagged), flagged
+    assert any(".acquire()" in ln for ln in flagged), flagged
+
+
+def test_gateway_hot_scoped_to_gateway_modules_and_marked_funcs():
+    # write paths (no marker) may lock; other modules are out of scope
+    assert lint_source(
+        GATEWAY_HOT_SRC, "dragonboat_tpu/balance/view.py"
+    ) == []
+    unmarked = GATEWAY_HOT_SRC.replace("  # gateway-hot", "")
+    assert lint_source(
+        unmarked, "dragonboat_tpu/gateway/routing.py"
+    ) == []
+
+
+def test_gateway_hot_point_suppression():
+    src = GATEWAY_HOT_SRC.replace(
+        "        with self._lock:\n            return self._table.get(shard_id)",
+        "        # raftlint: ignore[gateway-hot] cold diagnostic path\n"
+        "        with self._lock:\n            return self._table.get(shard_id)",
+        1,
+    )
+    fs = lint_source(src, "dragonboat_tpu/gateway/routing.py")
+    assert len(fs) == 1 and rules_of(fs) == {"gateway-hot"}
+
+
+def test_gateway_hot_real_tree_annotation_is_live():
+    """RoutingCache.lookup carries the # gateway-hot marker; a with-lock
+    seeded into its body must surface — the real tree's annotation is
+    live, not decorative."""
+    path = os.path.join(REPO, "dragonboat_tpu/gateway/routing.py")
+    with open(path) as f:
+        src = f.read()
+    assert "# gateway-hot" in src
+    needle = '"""Current route, or None.  NO locking: one dict load, one get."""'
+    assert needle in src
+    seeded = src.replace(
+        needle, needle + "\n        with self._lock:\n            pass"
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/gateway/routing.py")
+    assert any(f.rule == "gateway-hot" for f in fs)
+
+
+# ---------------------------------------------------------------------------
 # host-sync (the device-plane modules: ops/kernel.py, ops/route.py)
 # ---------------------------------------------------------------------------
 HOST_SYNC_SRC = '''

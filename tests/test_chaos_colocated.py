@@ -156,6 +156,7 @@ class TestColocatedChaos:
             stop.set()
             cluster.close()
 
+    @pytest.mark.flaky_isolated
     def test_forced_kernel_escalations_under_load(self):
         """Nemesis-forced device-kernel escalations: rows are randomly
         bounced through the escalation recovery machinery (discard

@@ -409,7 +409,13 @@ class TestColocatedQuiesce:
     path (plan_ok short-circuit), must still idle out, park, and wake
     on activity (reference: quiesceManager + workReady [U])."""
 
+    @pytest.mark.flaky_isolated
     def test_quiesce_enters_and_wakes_through_fast_lane(self):
+        # flaky_isolated: park requires EVERY member idle for a full
+        # quiesce threshold; residual CPU load from earlier modules can
+        # stretch the 2ms-rtt tick cadence past the poll deadline
+        # (passes in isolation — ROADMAP rotating flake; the conftest
+        # hook retries once after the process settles)
         group, nhs = make_colocated_cluster(rtt_ms=2)
         try:
             for rid, nh in nhs.items():

@@ -1,0 +1,638 @@
+"""Serving front plane (dragonboat_tpu.gateway, docs/GATEWAY.md).
+
+Covers, per the gateway tentpole:
+
+* RoutingCache units: copy-on-write snapshot reads, event-tap
+  learn/invalidate from ``leader_updated``/``balance_move_*``, bulk
+  refresh from a balance ClusterView, discovery fallback;
+* AdmissionController units: bounded per-shard queue, deadline-aware
+  shed via ``LatencyBudget.can_meet``, depth accounting, the
+  sustained-shed dump trigger;
+* Gateway end-to-end on a 3-host in-proc cluster: session handles with
+  per-session ordering, batched submission, exactly-once results;
+* leader-lease reads: the fast path under CheckQuorum, fallback when
+  ``check_quorum`` is off, and the SAFETY cases — leader transfer and
+  leader kill mid-lease force fallback to ReadIndex (no stale read
+  past lease expiry), with an ``audit/`` stale-read containment pass
+  over a gateway read/write history under leader-kill churn;
+* overload: a flooded tiny-queue gateway sheds (``gateway_shed_total``
+  > 0), completes everything it admits, and auto-dumps the flight
+  recorder on sustained shedding.
+"""
+import json
+import shutil
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import (
+    Config,
+    EngineConfig,
+    ExpertConfig,
+    Gateway,
+    GatewayBusy,
+    GatewayClosed,
+    GatewayConfig,
+    LatencyBudget,
+    NodeHost,
+    NodeHostConfig,
+)
+from dragonboat_tpu.audit.checker import check_stale_reads
+from dragonboat_tpu.audit.history import HistoryRecorder
+from dragonboat_tpu.audit.model import AuditKV, audit_set_cmd
+from dragonboat_tpu.balance.view import ClusterView, ReplicaView, ShardView
+from dragonboat_tpu.events import EventFanout
+from dragonboat_tpu.gateway import AdmissionController, RoutingCache
+from dragonboat_tpu.metrics import MetricsRegistry
+from dragonboat_tpu.raftio import LeaderInfo
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+from test_nodehost import KVStore, set_cmd
+
+
+# ---------------------------------------------------------------------------
+# routing cache units
+# ---------------------------------------------------------------------------
+class TestRoutingCache:
+    def test_learn_lookup_invalidate_snapshot_discipline(self):
+        rc = RoutingCache(lambda: {})
+        assert rc.lookup(1) is None
+        rc.learn(1, "h-a")
+        t0 = rc._table
+        assert rc.lookup(1) == "h-a"
+        rc.learn(2, "h-b")
+        # copy-on-write: the old snapshot object is untouched
+        assert t0 == {1: "h-a"} and rc.lookup(2) == "h-b"
+        rc.invalidate(1)
+        assert rc.lookup(1) is None and rc.lookup(2) == "h-b"
+        rc.invalidate(99)  # absent: no-op, no error
+        rc.invalidate_all()
+        assert rc.table() == {}
+
+    def test_leader_updated_tap_learns_and_invalidates(self):
+        rc = RoutingCache(lambda: {})
+        tap_a = rc.host_tap("h-a")
+        # the leader's own observation learns the route
+        tap_a("leader_updated", (LeaderInfo(1, replica_id=3, term=2,
+                                            leader_id=3),))
+        assert rc.lookup(1) == "h-a"
+        # a follower learning some other leader cannot map it: ignored
+        tap_b = rc.host_tap("h-b")
+        tap_b("leader_updated", (LeaderInfo(1, replica_id=2, term=2,
+                                            leader_id=3),))
+        assert rc.lookup(1) == "h-a"
+        # leaderless observation invalidates
+        tap_b("leader_updated", (LeaderInfo(1, replica_id=2, term=3,
+                                            leader_id=0),))
+        assert rc.lookup(1) is None
+
+    def test_balance_move_events_invalidate(self):
+        rc = RoutingCache(lambda: {})
+        rc.learn(7, "h-a")
+        tap = rc.host_tap("h-a")
+
+        class Info:
+            shard_id = 7
+
+        tap("balance_move_started", (Info(),))
+        assert rc.lookup(7) is None
+
+    def test_refresh_from_view_bulk_updates(self):
+        rc = RoutingCache(lambda: {})
+        rc.learn(1, "stale-host")
+        view = ClusterView(
+            hosts=("h-a", "h-b"),
+            draining=(),
+            shards=(
+                ShardView(
+                    shard_id=1,
+                    members=((1, "h-a"), (2, "h-b")),
+                    replicas=(ReplicaView(1, "h-a", 5, True),),
+                    leader_replica_id=1,
+                    leader_host="h-a",
+                ),
+                ShardView(
+                    shard_id=2,
+                    members=((1, "h-b"),),
+                    replicas=(),
+                    leader_replica_id=0,
+                    leader_host="",  # unknown leader: not in leader_map
+                ),
+            ),
+        )
+        assert view.leader_map() == {1: "h-a"}
+        rc.refresh_from_view(view)
+        assert rc.lookup(1) == "h-a" and rc.lookup(2) is None
+
+    def test_event_fanout_add_tap_sees_leader_updated(self):
+        seen = []
+        fan = EventFanout()
+        try:
+            fan.add_tap(lambda name, args: seen.append((name, args)))
+            info = LeaderInfo(4, replica_id=1, term=1, leader_id=1)
+            fan.leader_updated(info)
+            assert seen == [("leader_updated", (info,))]
+        finally:
+            fan.close()
+
+
+# ---------------------------------------------------------------------------
+# raft-level lease semantics (quorum-responded renewal, decay, loss)
+# ---------------------------------------------------------------------------
+class TestRaftLease:
+    def _leader(self, check_quorum=True):
+        from dragonboat_tpu.pb import Message, MessageType
+        from raft_harness import Network
+
+        net = Network.of(3, check_quorum=check_quorum)
+        net.elect(1)
+        return net, net.peers[1], Message, MessageType
+
+    def test_lease_seeded_at_election_and_renewed_by_responses(self):
+        net, l, Message, MessageType = self._leader()
+        assert l.lease_remaining_ticks() > 0  # vote grants seed it
+        # drive ticks WITH heartbeat exchange: lease never decays below
+        # a full window minus the heartbeat cadence
+        for _ in range(3 * l.election_timeout):
+            net.submit(1, Message(type=MessageType.LOCAL_TICK))
+        assert l.lease_remaining_ticks() >= l.election_timeout - 2
+
+    def test_lease_decays_without_quorum_responses(self):
+        net, l, Message, MessageType = self._leader()
+        net.isolate(2)
+        net.isolate(3)
+        # responses stop arriving; the lease decays tick by tick (the
+        # CHECK_QUORUM sweep will also depose the leader at the window
+        # boundary, which forces remaining to 0 via the role gate)
+        start = l.lease_remaining_ticks()
+        for _ in range(l.election_timeout + 1):
+            l.handle(Message(type=MessageType.LOCAL_TICK))
+        assert l.lease_remaining_ticks() < max(start, 1), (
+            start, l.lease_remaining_ticks(), l.role
+        )
+        assert l.lease_remaining_ticks() == 0
+
+    def test_no_lease_without_check_quorum(self):
+        net, l, Message, MessageType = self._leader(check_quorum=False)
+        assert l.lease_remaining_ticks() == 0
+
+    def test_follower_has_no_lease(self):
+        net, l, Message, MessageType = self._leader()
+        assert net.peers[2].lease_remaining_ticks() == 0
+
+    def test_transfer_in_flight_zeroes_lease(self):
+        # transfer votes bypass the vote-refusal lease (hint != 0), so
+        # the target can be elected well inside the old window — the
+        # lease must go to zero the moment a transfer is requested
+        net, l, Message, MessageType = self._leader()
+        assert l.lease_remaining_ticks() > 0
+        l.handle(Message(type=MessageType.LEADER_TRANSFER, hint=2))
+        assert l.leader_transfer_target == 2
+        assert l.lease_remaining_ticks() == 0
+
+    def test_boot_grace_refuses_votes_after_restart(self):
+        from raft_harness import new_raft
+        from dragonboat_tpu.pb import Message, MessageType, State
+
+        # a voter restored from persisted state can't know how recently
+        # it heard from a leader: it must refuse non-transfer votes for
+        # one election window (leader_id is volatile — restart hole)
+        r = new_raft(1, [1, 2, 3], check_quorum=True,
+                     state=State(term=3, vote=2, commit=0))
+        r.handle(Message(type=MessageType.REQUEST_VOTE, from_=2,
+                         term=4, log_index=0, log_term=0))
+        assert r.term == 3 and not r.msgs  # ignored inside boot grace
+        for _ in range(r.election_timeout):
+            r.tick_count += 1
+        r.handle(Message(type=MessageType.REQUEST_VOTE, from_=2,
+                         term=4, log_index=0, log_term=0))
+        assert r.term == 4  # grace over: the vote request is processed
+        # a fresh node (no persisted state) has no grace
+        r2 = new_raft(1, [1, 2, 3], check_quorum=True)
+        r2.handle(Message(type=MessageType.REQUEST_VOTE, from_=2,
+                          term=4, log_index=0, log_term=0))
+        assert r2.term == 4
+
+    def test_single_voter_lease_always_held(self):
+        from raft_harness import new_raft
+        from dragonboat_tpu.pb import Message, MessageType
+
+        r = new_raft(1, [1], check_quorum=True)
+        r.handle(Message(type=MessageType.ELECTION))
+        for _ in range(25):
+            r.handle(Message(type=MessageType.LOCAL_TICK))
+        assert r.lease_remaining_ticks() == r.election_timeout
+
+
+# ---------------------------------------------------------------------------
+# admission units
+# ---------------------------------------------------------------------------
+class TestAdmission:
+    def _budget(self, p99=0.05):
+        b = LatencyBudget(bootstrap=p99, floor=0.001)
+        for _ in range(16):
+            b.observe(p99)
+        return b
+
+    def test_queue_full_sheds_and_depth_accounting(self):
+        m = MetricsRegistry()
+        ac = AdmissionController(
+            self._budget(), max_queue_per_shard=2, metrics=m
+        )
+        dl = time.monotonic() + 10.0
+        assert ac.admit(1, dl) is None
+        assert ac.admit(1, dl) is None
+        assert ac.depth(1) == 2
+        assert ac.admit(1, dl) == "queue_full"
+        # another shard is unaffected (per-shard bound)
+        assert ac.admit(2, dl) is None
+        ac.complete(1)
+        assert ac.admit(1, dl) is None
+        assert ac.depth(1) == 2 and ac.depth(2) == 1
+        assert ac.shed_total == 1
+        assert m.counter("gateway_shed_total",
+                         {"reason": "queue_full"}).value == 1
+
+    def test_deadline_shed_when_p99_says_unreachable(self):
+        ac = AdmissionController(self._budget(p99=0.5),
+                                 max_queue_per_shard=8)
+        # 50ms of headroom against a 500ms p99: cannot meet
+        assert ac.admit(1, time.monotonic() + 0.05) == "deadline"
+        # past deadline: shed without charging depth
+        assert ac.admit(1, time.monotonic() - 1.0) == "deadline"
+        assert ac.depth(1) == 0
+        # ample headroom admits
+        assert ac.admit(1, time.monotonic() + 5.0) is None
+
+    def test_sustained_shed_fires_dump_once_per_cooldown(self):
+        dumps = []
+        ac = AdmissionController(
+            self._budget(), max_queue_per_shard=1,
+            dump_threshold=5, dump_window=5.0, dump_cooldown=60.0,
+            dump_cb=dumps.append,
+        )
+        dl = time.monotonic() + 10.0
+        assert ac.admit(1, dl) is None
+        for _ in range(12):
+            assert ac.admit(1, dl) == "queue_full"
+        assert ac.dumps == 1 and len(dumps) == 1
+        assert "sustained shedding" in dumps[0]
+
+
+# ---------------------------------------------------------------------------
+# cluster harness
+# ---------------------------------------------------------------------------
+GW_ADDRS = {1: "gwt-1", 2: "gwt-2", 3: "gwt-3"}
+
+
+def make_gw_cluster(sm_factory=KVStore, *, check_quorum=True, shards=(1,),
+                    rtt_ms=2, recorder=False, tag="gwt"):
+    reset_inproc_network()
+    addrs = {r: f"{tag}-{r}" for r in (1, 2, 3)}
+    nhs = {}
+    for r, a in addrs.items():
+        d = f"/tmp/nh-{tag}-{r}"
+        shutil.rmtree(d, ignore_errors=True)
+        nhs[a] = NodeHost(NodeHostConfig(
+            nodehost_dir=d,
+            rtt_millisecond=rtt_ms,
+            raft_address=a,
+            enable_flight_recorder=recorder,
+            expert=ExpertConfig(
+                engine=EngineConfig(exec_shards=2, apply_shards=2)
+            ),
+        ))
+    for sid in shards:
+        for r, a in addrs.items():
+            nhs[a].start_replica(
+                addrs, False, sm_factory,
+                Config(replica_id=r, shard_id=sid, election_rtt=10,
+                       heartbeat_rtt=1, check_quorum=check_quorum),
+            )
+    return addrs, nhs
+
+
+def wait_leader(nhs, shard_id=1, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for a, nh in nhs.items():
+            try:
+                if nh.is_leader_of(shard_id):
+                    return a
+            except Exception:
+                pass
+        time.sleep(0.02)
+    raise AssertionError(f"no leader for shard {shard_id} within {timeout}s")
+
+
+def close_all(nhs, gw=None):
+    if gw is not None:
+        gw.close()
+    for nh in nhs.values():
+        try:
+            nh.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# gateway end-to-end
+# ---------------------------------------------------------------------------
+class TestGatewayEndToEnd:
+    def test_propose_read_and_routing_via_events(self):
+        addrs, nhs = make_gw_cluster(tag="gwt-e2e")
+        gw = Gateway(nhs, GatewayConfig(workers=2))
+        try:
+            leader = wait_leader(nhs)
+            h = gw.connect(1)
+            for i in range(10):
+                r = h.sync_propose(set_cmd(f"k{i}", i))
+            assert r.value == 10
+            # reads see the writes; the route learned from events or
+            # discovery points at the leader host
+            assert gw.read(1, "k9") == 9
+            assert gw.routes.lookup(1) == leader
+            st = gw.stats()
+            assert st["committed"] == 10 and st["failed"] == 0
+            assert st["lease_reads"] + st["read_fallbacks"] >= 1
+            h.close()
+        finally:
+            close_all(nhs, gw)
+
+    def test_per_session_ordering_under_async_submission(self):
+        addrs, nhs = make_gw_cluster(AuditKV, tag="gwt-ord")
+        gw = Gateway(nhs, GatewayConfig(workers=2))
+        try:
+            wait_leader(nhs)
+            h = gw.connect(1)
+            futs = [
+                h.propose(audit_set_cmd("seq", f"v{i}")) for i in range(24)
+            ]
+            for f in futs:
+                f.result(20.0)
+            # every replica applied the handle's writes in submission
+            # order (the per-session in-flight gate + series discipline)
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                vals = [
+                    [v for _, k, v in nh._get_node(1).sm.managed.sm.journal
+                     if k == "seq"]
+                    for nh in nhs.values()
+                ]
+                if all(len(v) == 24 for v in vals):
+                    break
+                time.sleep(0.05)
+            for v in vals:
+                assert v == [f"v{i}" for i in range(24)], v
+            h.close()
+        finally:
+            close_all(nhs, gw)
+
+    def test_noop_handle_and_closed_gateway_rejects(self):
+        addrs, nhs = make_gw_cluster(tag="gwt-noop")
+        gw = Gateway(nhs)
+        try:
+            wait_leader(nhs)
+            h = gw.noop_handle(1)
+            h.sync_propose(set_cmd("x", 1))
+            assert gw.read(1, "x") == 1
+            gw.close()
+            with pytest.raises(GatewayClosed):
+                h.propose(set_cmd("y", 2))
+            with pytest.raises(GatewayClosed):
+                gw.read(1, "x")
+        finally:
+            close_all(nhs, gw)
+
+
+# ---------------------------------------------------------------------------
+# lease reads
+# ---------------------------------------------------------------------------
+class TestLeaseReads:
+    def test_lease_fast_path_skips_read_index(self):
+        addrs, nhs = make_gw_cluster(tag="gwt-lease")
+        gw = Gateway(nhs)
+        try:
+            leader = wait_leader(nhs)
+            h = gw.connect(1)
+            h.sync_propose(set_cmd("a", 1))
+            # the leader host holds a CheckQuorum lease
+            st = nhs[leader].lease_status(1)
+            assert st["is_leader"] and st["check_quorum"]
+            assert st["remaining_ticks"] > 0
+            before = gw.stats()["lease_reads"]
+            for _ in range(5):
+                assert gw.read(1, "a") == 1
+            assert gw.stats()["lease_reads"] >= before + 4
+            # and the raw probe agrees
+            ok, v = nhs[leader].try_lease_read(1, "a")
+            assert ok and v == 1
+            h.close()
+        finally:
+            close_all(nhs, gw)
+
+    def test_no_lease_without_check_quorum_falls_back(self):
+        addrs, nhs = make_gw_cluster(check_quorum=False, tag="gwt-nolease")
+        gw = Gateway(nhs)
+        try:
+            leader = wait_leader(nhs)
+            h = gw.noop_handle(1)
+            h.sync_propose(set_cmd("a", 1))
+            ok, _ = nhs[leader].try_lease_read(1, "a")
+            assert not ok
+            assert gw.read(1, "a") == 1  # ReadIndex fallback still serves
+            assert gw.stats()["read_fallbacks"] >= 1
+            assert gw.stats()["lease_reads"] == 0
+        finally:
+            close_all(nhs, gw)
+
+    def test_leader_transfer_mid_lease_forces_fallback(self):
+        addrs, nhs = make_gw_cluster(tag="gwt-xfer")
+        gw = Gateway(nhs)
+        try:
+            leader = wait_leader(nhs)
+            h = gw.noop_handle(1)
+            h.sync_propose(set_cmd("a", 1))
+            assert gw.read(1, "a") == 1
+            old = nhs[leader]
+            old_node = old._get_node(1)
+            target = next(
+                r for r, a in addrs.items() if a != leader
+            )
+            old.request_leader_transfer(1, target)
+            # the OLD leader must lose the lease the moment it steps
+            # down — no stale read past lease expiry
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if not old.is_leader_of(1):
+                    break
+                time.sleep(0.01)
+            assert not old.is_leader_of(1), "transfer did not complete"
+            assert old_node.lease_remaining_ticks() == 0
+            assert old.try_lease_read(1, "a") == (False, None)
+            # gateway reads keep serving (rerouted / fallback)
+            assert gw.read(1, "a") == 1
+            new_leader = wait_leader(nhs)
+            assert new_leader != leader
+            # route converges to the new leader via leader_updated taps
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if gw.routes.lookup(1) == new_leader:
+                    break
+                time.sleep(0.02)
+            assert gw.routes.lookup(1) == new_leader
+        finally:
+            close_all(nhs, gw)
+
+    def test_leader_kill_mid_lease_forces_fallback(self):
+        addrs, nhs = make_gw_cluster(tag="gwt-kill")
+        gw = Gateway(nhs)
+        try:
+            leader = wait_leader(nhs)
+            h = gw.noop_handle(1)
+            h.sync_propose(set_cmd("a", 1))
+            assert gw.read(1, "a") == 1
+            victim = nhs[leader]
+            victim_node = victim._get_node(1)
+            assert victim_node.lease_held(0)
+            # kill the leader host mid-lease: its replica stops, the
+            # lease probe must refuse instantly (stopped gate), and the
+            # survivors elect a new leader the gateway reroutes to
+            gw.remove_host(leader)
+            victim.close()
+            assert victim_node.lease_remaining_ticks() == 0
+            survivors = {a: nh for a, nh in nhs.items() if a != leader}
+            new_leader = wait_leader(survivors, timeout=30.0)
+            assert gw.read(1, "a", timeout=10.0) == 1
+            assert new_leader in survivors
+        finally:
+            close_all(nhs, gw)
+
+    def test_stale_read_containment_under_leader_kill_churn(self):
+        """The audit/ containment pass over a gateway read/write
+        history: writes via exactly-once handles, reads via the lease
+        fast path (recorded as 'stale'-kind ops, so the checker holds
+        them to the containment contract: never a never-written,
+        aborted, or future value), leader killed mid-run."""
+        addrs, nhs = make_gw_cluster(AuditKV, tag="gwt-audit")
+        gw = Gateway(nhs, GatewayConfig(default_timeout=8.0))
+        rec = HistoryRecorder()
+        try:
+            leader = wait_leader(nhs)
+            wc = rec.new_client()
+            rc_ = rec.new_client()
+            stop = threading.Event()
+            seq = [0]
+
+            def writer():
+                h = gw.connect(1, timeout=10.0)
+                while not stop.is_set():
+                    seq[0] += 1
+                    val = f"w-{seq[0]}"
+                    op = rec.invoke(wc, "w", "k", val)
+                    try:
+                        h.sync_propose(audit_set_cmd("k", val))
+                        rec.ok(op)
+                    except Exception:
+                        rec.ambiguous(op)  # may have committed
+                    time.sleep(0.005)
+
+            def reader():
+                while not stop.is_set():
+                    op = rec.invoke(rc_, "stale", "k")
+                    try:
+                        rec.ok(op, gw.read(1, "k", timeout=5.0))
+                    except Exception:
+                        rec.fail(op)
+                    time.sleep(0.003)
+
+            tw = threading.Thread(target=writer, daemon=True, name="gw-aud-w")
+            tr = threading.Thread(target=reader, daemon=True, name="gw-aud-r")
+            tw.start()
+            tr.start()
+            time.sleep(1.5)
+            # leader kill mid-lease, mid-traffic
+            gw.remove_host(leader)
+            nhs[leader].close()
+            survivors = {a: nh for a, nh in nhs.items() if a != leader}
+            wait_leader(survivors, timeout=30.0)
+            time.sleep(2.0)
+            stop.set()
+            tw.join(timeout=15)
+            tr.join(timeout=15)
+            ops = rec.ops()
+            reads_ok = [o for o in ops if o.kind == "stale"
+                        and o.status == "ok"]
+            assert len(reads_ok) > 20, rec.counts()
+            violations = check_stale_reads(ops)
+            assert violations == [], "\n".join(
+                v.describe() for v in violations
+            )
+            # the lease fast path actually carried reads in this run
+            assert gw.stats()["lease_reads"] > 0
+        finally:
+            close_all(nhs, gw)
+
+
+# ---------------------------------------------------------------------------
+# overload shedding
+# ---------------------------------------------------------------------------
+class TestOverload:
+    def test_flood_sheds_bounded_queue_and_dumps_recorder(self):
+        addrs, nhs = make_gw_cluster(recorder=True, tag="gwt-shed")
+        gw = Gateway(nhs, GatewayConfig(
+            workers=1,
+            max_queue_per_shard=8,
+            shed_dump_threshold=10,
+            shed_dump_window=5.0,
+            shed_dump_cooldown=0.0,
+            default_timeout=10.0,
+        ))
+        try:
+            wait_leader(nhs)
+            handles = [gw.noop_handle(1) for _ in range(16)]
+            futs, sheds = [], 0
+            for round_ in range(8):
+                for i, h in enumerate(handles):
+                    try:
+                        futs.append(
+                            h.propose(set_cmd(f"f{round_}-{i}", i))
+                        )
+                    except GatewayBusy:
+                        sheds += 1
+            # everything ADMITTED completes; everything else shed
+            done = 0
+            for f in futs:
+                f.result(20.0)
+                done += 1
+            st = gw.stats()
+            assert sheds > 0 and st["shed"] == sheds
+            assert done == len(futs) and st["committed"] >= done
+            # sustained shedding auto-dumped the flight recorder
+            assert st["shed_dumps"] >= 1
+            assert "sustained shedding" in gw.last_shed_dump
+            # the shed landed in the flight recorder lane too
+            ev = []
+            for nh in nhs.values():
+                if nh.recorder is not None:
+                    ev.extend(nh.recorder.events(1))
+            assert any(k == "gateway_shed" for _, _, _, k, _ in ev)
+        finally:
+            close_all(nhs, gw)
+
+    def test_deadline_shed_rejects_before_queueing(self):
+        addrs, nhs = make_gw_cluster(tag="gwt-dl")
+        budget = LatencyBudget(bootstrap=2.0, floor=0.001)
+        for _ in range(16):
+            budget.observe(2.0)  # observed p99: 2s commits
+        gw = Gateway(nhs, GatewayConfig(budget=budget))
+        try:
+            wait_leader(nhs)
+            h = gw.noop_handle(1)
+            with pytest.raises(GatewayBusy, match="deadline"):
+                h.propose(set_cmd("x", 1), timeout=0.05)
+            assert gw.stats()["shed"] == 1
+            assert gw.admission.depth(1) == 0  # nothing charged
+        finally:
+            close_all(nhs, gw)
