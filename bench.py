@@ -969,6 +969,156 @@ def phase_balance(
                 pass
 
 
+def phase_bigstate(
+    *,
+    state_mb: int = 16,
+    caps_mb: tuple = (0, 16, 4),
+    rtt_ms: int = 2,
+) -> dict:
+    """Big-state plane guard (bigstate/, docs/BIGSTATE.md): laggard
+    catch-up MB/s at three bandwidth-cap levels (0 = uncapped) and the
+    CONCURRENT commit-throughput delta — the number behind the "catch-up
+    provably cannot starve the commit path" claim.  Host path + disk
+    only, no device."""
+    import os as _os
+    import shutil
+    import threading
+    import time as _time
+
+    from dragonboat_tpu import (
+        Config,
+        EngineConfig,
+        ExpertConfig,
+        NodeHost,
+        NodeHostConfig,
+        settings,
+    )
+    from dragonboat_tpu.bigstate.ondisk import ondisk_kv_factory, put_cmd
+    from dragonboat_tpu.storage.logdb import in_mem_logdb_factory
+    from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+    ADDRS = {1: "bb-1", 2: "bb-2", 3: "bb-3"}
+    saved_chunk = settings.Soft.snapshot_chunk_size
+    settings.Soft.snapshot_chunk_size = 256 * 1024
+    report = {"state_mb": state_mb, "levels": []}
+
+    def one_level(cap_mb: int) -> dict:
+        reset_inproc_network()
+        for rid in ADDRS:
+            shutil.rmtree(f"/tmp/nh-bb-{rid}", ignore_errors=True)
+        shutil.rmtree("/tmp/bb-sm", ignore_errors=True)
+        fac = {
+            rid: ondisk_kv_factory(f"/tmp/bb-sm/h{rid}") for rid in ADDRS
+        }
+        nhs = {
+            rid: NodeHost(NodeHostConfig(
+                nodehost_dir=f"/tmp/nh-bb-{rid}",
+                rtt_millisecond=rtt_ms,
+                raft_address=ADDRS[rid],
+                expert=ExpertConfig(
+                    engine=EngineConfig(exec_shards=2, apply_shards=2),
+                    logdb_factory=in_mem_logdb_factory,
+                ),
+            ))
+            for rid in ADDRS
+        }
+
+        def cfg(rid):
+            return Config(replica_id=rid, shard_id=1,
+                          election_rtt=20, heartbeat_rtt=2)
+
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(ADDRS, False, fac[rid], cfg(rid))
+            # leader + healthy-baseline probe
+            deadline = _time.time() + 15
+            lid = 0
+            while _time.time() < deadline and not lid:
+                for rid, nh in nhs.items():
+                    l, ok = nh.get_leader_id(1)
+                    if ok and l:
+                        lid = l
+                        break
+                _time.sleep(0.05)
+            nh = nhs[lid]
+            s = nh.get_noop_session(1)
+
+            def propose(cmd, deadline_s=10.0):
+                end = _time.time() + deadline_s
+                while True:
+                    try:
+                        return nh.sync_propose(s, cmd, timeout=1.0)
+                    except Exception:  # noqa: BLE001 — retry to deadline
+                        if _time.time() >= end:
+                            raise
+
+            def probe_rate(secs):
+                n = 0
+                end = _time.time() + secs
+                while _time.time() < end:
+                    propose(put_cmd(b"p", b"x"))
+                    n += 1
+                return n / secs
+
+            probe_rate(0.5)
+            base = probe_rate(1.5)
+            fid = next(r for r in ADDRS if r != lid)
+            nhs[fid].close()
+            val = _os.urandom(1024 * 1024)
+            for i in range(state_mb):
+                propose(put_cmd(b"big-%d" % i, val))
+            live = {r: h for r, h in nhs.items() if r != fid}
+            for h in live.values():
+                h.sync_request_snapshot(1, compaction_overhead=1)
+                if cap_mb:
+                    h.set_snapshot_send_rate(cap_mb * 1024 * 1024)
+            nhf = NodeHost(NodeHostConfig(
+                nodehost_dir=f"/tmp/nh-bb-{fid}",
+                rtt_millisecond=rtt_ms,
+                raft_address=ADDRS[fid],
+                expert=ExpertConfig(
+                    engine=EngineConfig(exec_shards=2, apply_shards=2),
+                    logdb_factory=in_mem_logdb_factory,
+                ),
+            ))
+            nhs[fid] = nhf
+            nhf.start_replica(ADDRS, False, fac[fid], cfg(fid))
+            t0 = _time.time()
+            n = 0
+            last = b"big-%d" % (state_mb - 1)
+            caught = None
+            while _time.time() - t0 < 300:
+                propose(put_cmd(b"p", b"x"))
+                n += 1
+                if n % 20 == 0 and nhf.stale_read(1, last) == val:
+                    caught = _time.time()
+                    break
+            catchup_s = (caught or _time.time()) - t0
+            during = n / catchup_s if catchup_s > 0 else -1.0
+            return {
+                "cap_mb_s": cap_mb,
+                "caught_up": caught is not None,
+                "catchup_secs": round(catchup_s, 2),
+                "catchup_mb_s": round(state_mb / catchup_s, 1),
+                "commit_base_per_sec": round(base, 1),
+                "commit_during_per_sec": round(during, 1),
+                "commit_delta_frac": round(during / base, 3) if base else -1,
+            }
+        finally:
+            for h in nhs.values():
+                try:
+                    h.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+
+    try:
+        for cap in caps_mb:
+            report["levels"].append(one_level(int(cap)))
+    finally:
+        settings.Soft.snapshot_chunk_size = saved_chunk
+    return report
+
+
 def phase_gateway(
     *,
     shards: int = 4,
@@ -1261,7 +1411,7 @@ def main() -> None:
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
-             gateway=None) -> None:
+             gateway=None, bigstate=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -1300,6 +1450,10 @@ def main() -> None:
                     # (gateway/; open-loop saturation curve + overload
                     # p99-bounded-while-shedding + lease-read split)
                     "gateway": gateway,
+                    # r11 schema addition: big-state plane guard
+                    # (bigstate/; laggard catch-up MB/s at 3 cap levels
+                    # + concurrent commit-throughput delta)
+                    "bigstate": bigstate,
                 }
             ),
             flush=True,
@@ -1493,6 +1647,22 @@ def main() -> None:
             gwb = {"error": gw_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb)
+
+    # Big-state plane guard (host+disk path only — no device risk):
+    # laggard catch-up MB/s at 3 cap levels + commit-throughput delta
+    bsb = None
+    if bool(int(os.environ.get("BENCH_BIGSTATE", "1"))) and remaining() > 90:
+        code = (
+            "import json, bench;"
+            "print('BENCHBS ' + json.dumps(bench.phase_bigstate()))"
+        )
+        bsb, bs_err = run_sub(
+            code, "BENCHBS", max(90, min(300, int(remaining() - 30)))
+        )
+        if bsb is None:
+            bsb = {"error": bs_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb)
 
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is

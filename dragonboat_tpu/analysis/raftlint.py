@@ -62,6 +62,16 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     routing table would serialize every client of every shard through
     one mutex.
 
+``stream-read``
+    The snapshot streaming path (``transport/chunk.py``,
+    ``storage/snapshotter.py``, ``storage/snapshotio.py``,
+    ``bigstate/``, ``tools.py``) exists so GB-scale state never
+    materializes in memory: a zero-argument ``.read()`` buffers a whole
+    stream and silently re-introduces the old whole-blob transfer.
+    Every read must pass a size (bounded slice).  Deliberate whole-blob
+    reads of small metadata carry a ``# raftlint: ignore[stream-read]
+    <reason>``.
+
 ``import-hot``
     No function-level imports in the hot modules (``node.py``,
     ``request.py``, ``engine/``): a first call on the step/apply path
@@ -120,6 +130,14 @@ WIDTH_MODULES = (
 HOST_SYNC_MODULES = (
     "dragonboat_tpu/ops/kernel.py",
     "dragonboat_tpu/ops/route.py",
+)
+# the snapshot streaming path: bounded reads only (docs/BIGSTATE.md)
+STREAM_READ_MODULES = (
+    "dragonboat_tpu/transport/chunk.py",
+    "dragonboat_tpu/storage/snapshotter.py",
+    "dragonboat_tpu/storage/snapshotio.py",
+    "dragonboat_tpu/bigstate/",
+    "dragonboat_tpu/tools.py",
 )
 # the serving front plane: `# gateway-hot` functions are lock-free
 # snapshot-read paths (docs/GATEWAY.md "Routing")
@@ -224,6 +242,9 @@ class _Linter(ast.NodeVisitor):
         self.check_width = _module_matches(self.relpath, WIDTH_MODULES)
         self.check_host_sync = _module_matches(
             self.relpath, HOST_SYNC_MODULES
+        )
+        self.check_stream_read = _module_matches(
+            self.relpath, STREAM_READ_MODULES
         )
         self.check_gateway = _module_matches(self.relpath, GATEWAY_MODULES)
         # count of enclosing `# gateway-hot` functions (nested defs
@@ -483,6 +504,8 @@ class _Linter(ast.NodeVisitor):
             self._check_width(node)
         if self.check_host_sync:
             self._check_host_sync(node)
+        if self.check_stream_read:
+            self._check_stream_read(node)
         self._check_thread(node)
         self.generic_visit(node)
 
@@ -608,6 +631,22 @@ class _Linter(ast.NodeVisitor):
             hit + " (~100-214 ms per sync on a remote link; "
             "docs/BENCH_NOTES_r05.md)",
         )
+
+    def _check_stream_read(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "read"
+            and not node.args
+            and not node.keywords
+        ):
+            self._emit(
+                "stream-read",
+                node.lineno,
+                "zero-argument .read() buffers a whole stream in memory "
+                "(pass a bounded size; the streaming path must handle "
+                "state larger than RAM — docs/BIGSTATE.md)",
+            )
 
     def _check_determinism(self, node: ast.Call) -> None:
         f = node.func

@@ -27,6 +27,12 @@ every layer consumes:
   ``balance_abort`` kills the move mid-sequence (forcing the rollback
   path) and ``balance_stall`` stretches a step so other planes can
   strike while the move is in flight.
+* **stream** — the snapshot stream jobs (``transport/transport.py``)
+  consult :meth:`on_snapshot_stream` per outbound chunk;
+  ``snapshot_stream_kill`` raises mid-transfer (the streamer dies and
+  the retry must RESUME from the receiver's cursor — docs/BIGSTATE.md)
+  and ``snapshot_stream_stall`` stretches the transfer so other planes
+  can strike while a laggard is mid-catch-up.
 * **churn** — drummer-style scheduled churn (:meth:`install_churn`):
   ``leader_kill`` samples and kills the CURRENT leader of a shard,
   ``leader_transfer`` forces leadership to another voter,
@@ -104,9 +110,18 @@ CHURN_KINDS = (
     "member_cycle",
     "balance_move",
 )
+# snapshot-stream plane (the big-state nemesis; docs/BIGSTATE.md):
+# ``snapshot_stream_kill`` raises inside the sender's stream job
+# mid-transfer (the streamer thread dies exactly as a torn connection
+# would) — the transport's bounded-retry path must RESUME from the
+# receiver's cursor, not restart from zero; ``snapshot_stream_stall``
+# sleeps ``delay`` per chunk, stretching the transfer so leader churn /
+# wire faults can land while a laggard is mid-catch-up.  Targets are
+# SENDER transport addresses (wire-kind convention; empty = any sender).
+STREAM_KINDS = ("snapshot_stream_kill", "snapshot_stream_stall")
 ALL_KINDS = (
     WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS + BALANCE_KINDS
-    + CHURN_KINDS
+    + CHURN_KINDS + STREAM_KINDS
 )
 
 
@@ -174,6 +189,7 @@ class FaultPlan:
         crash_keys: Sequence = (),
         shards: Sequence[int] = (),
         churn_shards: Sequence[int] = (),
+        stream_addrs: Sequence[str] = (),
         rounds: int = 8,
         mean_gap: float = 0.8,
         mean_duration: float = 0.8,
@@ -182,7 +198,10 @@ class FaultPlan:
         and seed produce the identical plan (the soak entry point's
         replay contract).  ``churn_shards`` adds the churn plane's
         leader kills / transfers / membership cycles to the kind pool
-        (the consumer must have called ``install_churn``)."""
+        (the consumer must have called ``install_churn``);
+        ``stream_addrs`` adds the snapshot-stream plane (kill/stall the
+        streamer of the named sender addresses) — opt-in so existing
+        seeded schedules stay byte-identical."""
         rng = Random(seed)
         addrs = list(addrs)
         kinds = ["partition", "drop", "delay", "duplicate", "reorder"]
@@ -194,6 +213,8 @@ class FaultPlan:
             kinds.append("escalate")
         if churn_shards:
             kinds += ["leader_kill", "leader_transfer", "member_cycle"]
+        if stream_addrs:
+            kinds += ["snapshot_stream_kill", "snapshot_stream_stall"]
         t = 0.0
         faults: List[Fault] = []
         for _ in range(rounds):
@@ -243,6 +264,17 @@ class FaultPlan:
                         at=t,
                         duration=max(0.4, dur) if kind != "leader_transfer" else 0.0,
                         targets=(rng.choice(list(churn_shards)),),
+                    )
+                )
+            elif kind in STREAM_KINDS:
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=dur,
+                        targets=(rng.choice(list(stream_addrs)),),
+                        p=round(rng.uniform(0.05, 0.3), 3),
+                        delay=round(rng.uniform(0.01, 0.1), 3),
                     )
                 )
             else:  # escalate
@@ -851,6 +883,32 @@ class FaultController:
                 if self._draw("write_err", key, op) < f.p:
                     self._count("fs_write_errors")
                     raise OSError(f"nemesis: injected write error ({op} {path})")
+
+    def on_snapshot_stream(self, source: str, target: str, chunk) -> None:
+        """Stream-job hook, consulted per outbound snapshot chunk
+        (transport.Transport._stream_once).  ``snapshot_stream_kill``
+        raises — the streamer dies mid-transfer and the sender's
+        bounded-retry path must resume from the receiver's cursor;
+        ``snapshot_stream_stall`` sleeps ``delay`` seconds.  Kills only
+        strike past chunk 0 so every killed transfer IS mid-transfer
+        (a pre-first-chunk kill would test plain retry, not resume)."""
+        with self._lock:
+            active = list(self._active)
+        for f in active:
+            if f.kind not in STREAM_KINDS:
+                continue
+            if f.targets and source not in f.targets:
+                continue
+            if f.kind == "snapshot_stream_stall":
+                if self._draw("snapshot_stream_stall", source, target) < f.p:
+                    self._count("stream_stalled")
+                    time.sleep(f.delay)
+            elif chunk.chunk_id > 0:
+                if self._draw("snapshot_stream_kill", source, target) < f.p:
+                    self._count("stream_kills")
+                    raise ConnectionError(
+                        "nemesis: snapshot streamer killed mid-transfer"
+                    )
 
     def on_balance_step(self, shard_id: int, step: str) -> bool:
         """Balance hook, consulted by the move executor before each step

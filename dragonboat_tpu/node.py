@@ -949,8 +949,17 @@ class Node:
         Returns True if apply work was scheduled."""
         if self._trace_spans:
             self._trace_update(u)
+        scheduled = False
         if not u.snapshot.is_empty():
             self._install_snapshot(u.snapshot)
+            # the queued SNAPSHOT_RECOVER task needs the apply worker
+            # NOW: an install with no trailing committed entries (a
+            # fully-compacted leader log and a quiet shard — the normal
+            # big-state catch-up shape) otherwise sits unrecovered until
+            # unrelated traffic schedules an apply, and a quiet follower
+            # stays at applied=0 forever while the leader believes it
+            # caught up (found by the bigstate TCP verify drive)
+            scheduled = True
         if u.entries_to_save:
             ents = u.entries_to_save
             check(
@@ -976,7 +985,6 @@ class Node:
                 self.pending_read_index.confirmed(rtr.system_ctx, rtr.index)
             # the read index may already be applied (idle shard): complete now
             self.pending_read_index.applied(self.sm.last_applied)
-        scheduled = False
         if u.committed_entries:
             self.sm.task_queue.add(
                 Task(type=TaskType.ENTRIES, entries=u.committed_entries)

@@ -192,6 +192,13 @@ class NodeHost:
                     self._chunk_sink.add,
                 )
             )
+            # resumable streams: reconnecting senders query this host's
+            # receive cursor before re-streaming (docs/BIGSTATE.md);
+            # getattr-guarded set so bespoke transport factories without
+            # the attribute keep working (they degrade to restart+
+            # idempotent re-delivery)
+            if hasattr(raw_transport, "resume_handler"):
+                raw_transport.resume_handler = self._chunk_sink.resume_cursor
             self.transport = Transport(
                 raw_transport,
                 self.registry.resolve,
@@ -204,6 +211,7 @@ class NodeHost:
                     config.max_snapshot_send_bytes_per_second
                 ),
                 metrics_registry=self.metrics,
+                stream_event_cb=self._stream_event,
             )
             self.transport.start()
 
@@ -224,6 +232,27 @@ class NodeHost:
             self.metrics.gauge(
                 "raft_transport_snapshots_sent_total",
                 lambda: self.transport.metrics["snapshots_sent"],
+            )
+            # the snapshot_stream_* surface (docs/BIGSTATE.md): stream
+            # egress, resume events, cap-induced sleep and live jobs
+            self.metrics.gauge(
+                "snapshot_stream_chunks_total",
+                lambda: self.transport.metrics["stream_chunks"],
+            )
+            self.metrics.gauge(
+                "snapshot_stream_bytes_total",
+                lambda: self.transport.metrics["stream_bytes"],
+            )
+            self.metrics.gauge(
+                "snapshot_stream_resumes_total",
+                lambda: self.transport.metrics["stream_resumes"],
+            )
+            self.metrics.gauge(
+                "snapshot_stream_throttle_seconds_total",
+                lambda: self.transport.stream_throttled_seconds(),
+            )
+            self.metrics.gauge(
+                "snapshot_stream_active", lambda: self.transport._stream_jobs
             )
             def _proposals_total():
                 with self._nodes_lock:
@@ -497,6 +526,23 @@ class NodeHost:
         from .storage.snapshotter import SnapshotSource
 
         return SnapshotSource(self.snapshot_storage, ss)
+
+    def _stream_event(self, shard_id: int, kind: str, detail: str) -> None:
+        """Stream-job lifecycle (start/resume/complete/fail) lands in
+        the shard's flight-recorder lane: the post-incident timeline of
+        a laggard catch-up shows exactly when the streamer died and from
+        which chunk it resumed (docs/BIGSTATE.md)."""
+        rec = self.recorder
+        if rec is not None:
+            rec.record(shard_id, kind, detail)
+
+    def set_snapshot_send_rate(self, bytes_per_second: int) -> None:
+        """Retune the host-wide snapshot-stream bandwidth cap at
+        runtime (0 removes it).  The cap is one token bucket shared by
+        every stream job of this host; the ``bigstate.pacing.
+        CapFeedback`` loop drives this knob to keep follower catch-up
+        from starving the commit path."""
+        self.transport.set_snapshot_send_rate(bytes_per_second)
 
     def _deliver_received_snapshot(self, m: Message) -> None:
         """A fully-reassembled snapshot enters the raft path like any other
@@ -800,6 +846,50 @@ class NodeHost:
         )
         self.engine.notify(shard_id)
         return _check(rs.wait(timeout), rs).value
+
+    # -- disaster recovery (bigstate/dr.py; docs/BIGSTATE.md) -----------
+    def export_snapshot(
+        self, shard_id: int, export_dir: str, timeout: float = 10.0
+    ):
+        """DR export: snapshot the shard's current applied state and
+        write a self-describing portable archive to ``export_dir``
+        (container + external files + ``MANIFEST.json`` with
+        shard/replica/index/term/membership and per-chunk checksums).
+        Streamed end to end — a GB-scale state machine never
+        materializes in memory.  Returns the ``pb.SnapshotManifest``.
+        """
+        from .bigstate.dr import write_archive
+
+        node = self._get_node(shard_id)
+        try:
+            self.sync_request_snapshot(shard_id, timeout=timeout)
+        except RequestRejected:
+            pass  # applied index unchanged since the last snapshot: use it
+        ss = self.logdb.get_snapshot(shard_id, node.replica_id)
+        if ss.is_empty():
+            raise RequestError(
+                f"shard {shard_id} has no snapshot to export (no applied "
+                "entries yet?)"
+            )
+        return write_archive(self.snapshot_storage, ss, export_dir)
+
+    def import_snapshot(
+        self,
+        export_dir: str,
+        shard_id: int,
+        replica_id: int,
+        members: Dict[int, str],
+    ):
+        """DR import: seed this host with an exported archive under a
+        REWRITTEN membership, before ``start_replica`` for the shard.
+        Every member listed must import the same archive with the same
+        membership on its own host (reference: tools.ImportSnapshot
+        preconditions [U]).  Verifies the manifest's per-chunk checksums
+        and the container's own block CRCs before touching the logdb.
+        Returns the seeded ``pb.Snapshot``."""
+        from .bigstate.dr import import_archive
+
+        return import_archive(self, export_dir, shard_id, replica_id, members)
 
     # -- leadership -------------------------------------------------------
     def request_leader_transfer(self, shard_id: int, target_id: int) -> None:

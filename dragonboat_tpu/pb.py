@@ -245,6 +245,39 @@ EMPTY_SNAPSHOT = Snapshot()
 
 
 @dataclass(frozen=True)
+class ManifestFile:
+    """One file of a portable snapshot archive (bigstate/dr.py): name
+    relative to the archive dir, size, whole-file sha256 (hex) and the
+    crc32 of each ``chunk_size`` slice — the import side verifies
+    slices with bounded memory and localizes corruption to a chunk."""
+
+    name: str = ""
+    size: int = 0
+    sha256: str = ""
+    chunk_crcs: Tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class SnapshotManifest:
+    """Self-describing metadata of a portable snapshot archive — the
+    disaster-recovery interchange format (NodeHost.export_snapshot /
+    import_snapshot; docs/BIGSTATE.md).  Serialized as MANIFEST.json by
+    bigstate/dr.py so an archive is inspectable with nothing but a JSON
+    reader; ``format_version`` gates future layout changes."""
+
+    format_version: int = 1
+    shard_id: int = 0
+    replica_id: int = 0
+    index: int = 0
+    term: int = 0
+    on_disk: bool = False
+    chunk_size: int = 0
+    compression: CompressionType = CompressionType.NO_COMPRESSION
+    membership: Membership = field(default_factory=Membership)
+    files: Tuple[ManifestFile, ...] = ()
+
+
+@dataclass(frozen=True)
 class Message:
     """A raft protocol message (reference: raftpb.Message [U]).
 

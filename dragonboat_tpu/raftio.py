@@ -113,6 +113,15 @@ class ISnapshotConnection(abc.ABC):
     @abc.abstractmethod
     def send_chunk(self, chunk: Chunk) -> None: ...
 
+    def query_resume(self, probe: Chunk) -> int:
+        """Ask the receiver for its receive cursor on the stream whose
+        identity ``probe`` carries (transport.chunk.resume_probe): the
+        next chunk offset it needs, 0 for restart-from-scratch.
+        Transports without a resume channel keep the default — a
+        reconnected sender then restarts at chunk 0 and the receiver's
+        idempotent re-delivery path discards what it already wrote."""
+        return 0
+
 
 MessageHandler = Callable[[MessageBatch], None]
 ChunkHandler = Callable[[Chunk], bool]

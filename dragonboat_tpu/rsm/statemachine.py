@@ -316,6 +316,12 @@ class StateMachine:
             index, term = self.last_applied, self.applied_term
             membership = self.members.membership.copy()
             sessions_blob = self.sessions.serialize()
+            # on-disk SMs: make everything applied so far durable in the
+            # SM's OWN storage before the snapshot point is fixed
+            # (reference: IOnDiskStateMachine.Sync before snapshotting
+            # [U]) — the log may be compacted past `index` right after,
+            # and the SM must never depend on replaying below it
+            self.managed.sync()
             ctx = self.managed.prepare_snapshot()
             w = SnapshotWriter(
                 fileobj,

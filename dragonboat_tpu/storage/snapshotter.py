@@ -276,7 +276,10 @@ class FileSnapshotStorage(_LeaseMixin):
         return os.path.join(final, "snapshot.bin"), result
 
     def load(self, filepath: str) -> bytes:
+        """Whole-blob convenience load (tests, small in-mem flows);
+        streaming consumers use ``open_read`` + bounded reads."""
         with open(filepath, "rb") as f:
+            # raftlint: ignore[stream-read] bytes-level convenience API
             return f.read()
 
     def open_read(self, filepath: str):

@@ -50,6 +50,12 @@ class IVFS:
     def read_file(self, path: str) -> bytes:
         raise NotImplementedError
 
+    def open_read(self, path: str):
+        """Seekable read handle for INCREMENTAL consumption (the
+        big-state plane reads checkpoints/WALs in bounded slices;
+        ``read_file`` stays for small whole-blob reads)."""
+        raise NotImplementedError
+
     def write_file_chunks(self, path: str, chunks) -> None:
         """Create/overwrite ``path`` from an iterable of byte chunks,
         fsync the file (NOT the directory — callers own namespace
@@ -113,6 +119,9 @@ class OSVFS(IVFS):
     def read_file(self, path: str) -> bytes:
         with open(path, "rb") as f:
             return f.read()
+
+    def open_read(self, path: str):
+        return open(path, "rb")
 
     def write_file_chunks(self, path: str, chunks) -> None:
         with open(path, "wb") as f:
@@ -266,6 +275,12 @@ class StrictMemFS(IVFS):
     def read_file(self, path: str) -> bytes:
         with self._lock:
             return self._node(path).data
+
+    def open_read(self, path: str):
+        import io
+
+        with self._lock:
+            return io.BytesIO(self._node(path).data)
 
     def write_file_chunks(self, path: str, chunks) -> None:
         with self._lock:

@@ -6,54 +6,43 @@ quorum permanently.  An exported snapshot from a surviving replica is
 imported on fresh hosts with a REWRITTEN membership, and the shard
 restarts from the snapshot with the new member set.
 
-Export dir layout:
-    <dir>/snapshot.bin   checksummed payload (FileSnapshotStorage format)
-    <dir>/META           wire-encoded Snapshot metadata
+These are thin compatibility wrappers over :mod:`.bigstate.dr`, which
+owns the archive format (MANIFEST.json with per-chunk checksums + the
+legacy META, everything streamed with bounded memory — the old
+whole-blob ``storage.load``/``f.read()`` path could not export a state
+machine larger than RAM).  New code should prefer the NodeHost methods
+``export_snapshot``/``import_snapshot``.
+
+Export dir layout: see bigstate/dr.py (MANIFEST.json, META,
+snapshot.bin, external-* siblings).
 """
 from __future__ import annotations
 
-import os
-import shutil
 from typing import Dict
 
-from .pb import Membership, Snapshot
-from .transport.wire import decode_snapshot_meta, encode_snapshot_meta
-
-META_FILENAME = "META"
-PAYLOAD_FILENAME = "snapshot.bin"
+from .bigstate.dr import (  # noqa: F401 — re-exported for callers
+    MANIFEST_FILENAME,
+    META_FILENAME,
+    PAYLOAD_FILENAME,
+    ArchiveError,
+    import_archive,
+    write_archive,
+)
+from .pb import Snapshot
 
 
 def export_snapshot(nodehost, shard_id: int, export_dir: str) -> Snapshot:
     """Write the shard's most recent snapshot to ``export_dir``.
 
     Call ``nodehost.sync_request_snapshot(shard_id)`` first if the shard
-    has never snapshotted.
+    has never snapshotted (or use ``NodeHost.export_snapshot``, which
+    snapshots the CURRENT applied state for you).
     """
-    import io as _io
-
-    from .storage.snapshotio import SnapshotReader
-
     replica_id = nodehost._get_node(shard_id).replica_id
     ss = nodehost.logdb.get_snapshot(shard_id, replica_id)
     if ss.is_empty():
         raise ValueError(f"shard {shard_id} has no snapshot to export")
-    os.makedirs(export_dir, exist_ok=True)
-    storage = nodehost.snapshot_storage
-    # lease: snapshot GC must not delete the dir mid-copy; external files
-    # (ISnapshotFileCollection) are part of the snapshot and must travel
-    with storage.lease(ss.filepath):
-        payload = storage.load(ss.filepath)
-        with open(os.path.join(export_dir, PAYLOAD_FILENAME), "wb") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        for sf in SnapshotReader(_io.BytesIO(payload)).external_files:
-            src = storage.external_path(ss.filepath, sf.filepath)
-            shutil.copyfile(src, os.path.join(export_dir, sf.filepath))
-    with open(os.path.join(export_dir, META_FILENAME), "wb") as f:
-        f.write(encode_snapshot_meta(ss))
-        f.flush()
-        os.fsync(f.fileno())
+    write_archive(nodehost.snapshot_storage, ss, export_dir)
     return ss
 
 
@@ -72,58 +61,4 @@ def import_snapshot(
     import the same snapshot with the same membership (reference:
     tools.ImportSnapshot preconditions [U]).
     """
-    if replica_id not in members:
-        raise ValueError(f"replica {replica_id} not in new membership")
-    with open(os.path.join(export_dir, META_FILENAME), "rb") as f:
-        meta = decode_snapshot_meta(f.read())
-    if meta.shard_id != shard_id:
-        raise ValueError(
-            f"export is for shard {meta.shard_id}, not {shard_id}"
-        )
-    with open(os.path.join(export_dir, PAYLOAD_FILENAME), "rb") as f:
-        raw = f.read()
-    payload = raw
-    # the v2 container self-validates per section; walk every block so
-    # a corrupt export fails HERE, not at replica recovery
-    import io as _io
-
-    from .storage.snapshotio import SnapshotCorruptError, SnapshotReader
-
-    try:
-        reader = SnapshotReader(_io.BytesIO(payload))
-        reader.validate()
-    except SnapshotCorruptError as e:
-        raise IOError(f"corrupt snapshot export in {export_dir}: {e}")
-    # external files must be present in the export — importing without
-    # them would fail-stop the replica at recovery
-    for sf in reader.external_files:
-        if not os.path.exists(os.path.join(export_dir, sf.filepath)):
-            raise IOError(
-                f"export in {export_dir} is missing external file "
-                f"{sf.filepath}"
-            )
-    path = nodehost.snapshot_storage.save(
-        shard_id, replica_id, meta.index, payload, suffix="imported"
-    )
-    for sf in reader.external_files:
-        shutil.copyfile(
-            os.path.join(export_dir, sf.filepath),
-            nodehost.snapshot_storage.external_path(path, sf.filepath),
-        )
-    new_membership = Membership(
-        config_change_id=meta.membership.config_change_id + 1,
-        addresses=dict(members),
-    )
-    ss = Snapshot(
-        filepath=path,
-        file_size=len(payload),
-        index=meta.index,
-        term=meta.term,
-        membership=new_membership,
-        shard_id=shard_id,
-        replica_id=replica_id,
-        imported=True,
-        compression=meta.compression,
-    )
-    nodehost.logdb.import_snapshot(ss, replica_id)
-    return ss
+    return import_archive(nodehost, export_dir, shard_id, replica_id, members)

@@ -23,8 +23,55 @@ from ..pb import Chunk, Message, MessageType, Snapshot, SnapshotFile
 _log = get_logger("transport")
 
 
+def _stream_geometry(m: Message, source, size: int):
+    """(total_chunks, main_size, files) for one stream — shared by the
+    chunk iterator and the sender's resume probe so both agree on the
+    stream identity fields byte-for-byte."""
+    ss = m.snapshot
+    if ss.dummy:
+        return 1, 0, []
+
+    def n_chunks(nbytes: int) -> int:
+        return max(1, -(-nbytes // size))
+
+    files: List[Tuple[SnapshotFile, str]] = source.externals
+    main_size = source.main_size
+    total = n_chunks(main_size) + sum(
+        n_chunks(sf.file_size) for sf, _ in files
+    )
+    return total, main_size, files
+
+
+def resume_probe(m: Message, source, chunk_size: Optional[int] = None) -> Chunk:
+    """A data-less chunk carrying one stream's identity, for
+    ``ISnapshotConnection.query_resume``: the receiver matches it
+    against its in-flight record's ``_chunk_ident`` and answers with
+    its receive cursor (the next chunk offset it needs).  The cursor is
+    keyed by snapshot index + chunk geometry, so a resumed sender can
+    only continue the SAME immutable payload it was sending."""
+    ss = m.snapshot
+    size = chunk_size or settings.Soft.snapshot_chunk_size
+    total, main_size, _files = _stream_geometry(m, source, size)
+    return Chunk(
+        shard_id=m.shard_id,
+        replica_id=m.to,
+        from_=m.from_,
+        chunk_count=total,
+        index=ss.index,
+        term=ss.term,
+        message_term=m.term,
+        membership=ss.membership,
+        filepath=ss.filepath,
+        file_size=main_size,
+        witness=ss.witness,
+        dummy=ss.dummy,
+        on_disk_index=ss.on_disk_index,
+    )
+
+
 def iter_snapshot_chunks(
-    m: Message, source, chunk_size: Optional[int] = None
+    m: Message, source, chunk_size: Optional[int] = None,
+    start_chunk: int = 0,
 ) -> Iterator[Chunk]:
     """Lazily yield the wire chunks for an InstallSnapshot message.
 
@@ -32,6 +79,12 @@ def iter_snapshot_chunks(
     container + external files, read incrementally so only one chunk is
     ever materialized (reference: splitSnapshotMessage + job.go
     incremental reads [U]).  ``source`` must stay open for the duration.
+
+    ``start_chunk`` resumes a partially-delivered stream: chunks below
+    it are neither read nor sent (the main container is seeked past;
+    fully-delivered external files are never opened).  Chunk ``k`` of a
+    given (index, term, geometry) is a fixed byte range of immutable
+    snapshot files, so a resumed iteration yields byte-identical chunks.
     """
     ss = m.snapshot
     size = chunk_size or settings.Soft.snapshot_chunk_size
@@ -39,16 +92,7 @@ def iter_snapshot_chunks(
     def n_chunks(nbytes: int) -> int:
         return max(1, -(-nbytes // size))
 
-    if ss.dummy:
-        files: List[Tuple[SnapshotFile, str]] = []
-        total = 1
-        main_size = 0
-    else:
-        files = source.externals
-        main_size = source.main_size
-        total = n_chunks(main_size) + sum(
-            n_chunks(sf.file_size) for sf, _ in files
-        )
+    total, main_size, files = _stream_geometry(m, source, size)
 
     def base(i: int, piece: bytes, **kw) -> Chunk:
         return Chunk(
@@ -72,25 +116,41 @@ def iter_snapshot_chunks(
         )
 
     if ss.dummy:
-        yield base(0, b"")
+        if start_chunk == 0:
+            yield base(0, b"")
         return
 
+    mcount = n_chunks(main_size)
     cid = 0
-    with source.open_main() as f:
-        sent = 0
-        while True:
-            piece = f.read(size)
-            if not piece and sent > 0:
-                break
-            yield base(cid, piece)
-            cid += 1
-            sent += len(piece)
-            if not piece:
-                break
+    if start_chunk < mcount:
+        with source.open_main() as f:
+            sent = 0
+            if start_chunk:
+                f.seek(start_chunk * size)
+                cid = start_chunk
+                sent = start_chunk * size
+            while True:
+                piece = f.read(size)
+                if not piece and sent > 0:
+                    break
+                yield base(cid, piece)
+                cid += 1
+                sent += len(piece)
+                if not piece:
+                    break
+    else:
+        cid = mcount
     for sf, path in files:
+        fcount = n_chunks(sf.file_size)
+        if start_chunk >= cid + fcount:
+            cid += fcount  # file fully delivered before the resume point
+            continue
         with source.open_external(path) as f:
-            fcount = n_chunks(sf.file_size)
             fcid = 0
+            if start_chunk > cid:
+                fcid = start_chunk - cid
+                f.seek(fcid * size)
+                cid = start_chunk
             while True:
                 piece = f.read(size)
                 if not piece and fcid > 0:
@@ -181,6 +241,20 @@ class ChunkSink:
         self._lock = threading.Lock()
         self._inflight: Dict[Tuple[int, int], _InFlight] = {}
 
+    def resume_cursor(self, probe: Chunk) -> int:
+        """The receive cursor for a stream matching ``probe``'s identity
+        (``transport.chunk.resume_probe``): the next chunk offset this
+        receiver needs, or 0 when no matching in-flight stream exists
+        (restart from scratch).  Chunks below the cursor are already on
+        local disk; a reconnected sender skips them entirely — the
+        resume half of the resumable-stream protocol (docs/BIGSTATE.md).
+        """
+        with self._lock:
+            fl = self._inflight.get((probe.shard_id, probe.from_))
+            if fl is not None and fl.ident == _chunk_ident(probe):
+                return fl.next_chunk
+        return 0
+
     def add(self, c: Chunk) -> bool:
         """Accept one chunk; returns False to make the sender abort the
         stream (out-of-order / mismatched chunk).
@@ -197,6 +271,19 @@ class ChunkSink:
         stale = None
         with self._lock:
             fl = self._inflight.get(key)
+            if (
+                fl is not None
+                and _chunk_ident(c) == fl.ident
+                and c.chunk_id < fl.next_chunk
+            ):
+                # idempotent re-delivery of an already-written offset: a
+                # reconnected sender restarting below the receive cursor
+                # (no resume support, or an overlapping resume) re-sends
+                # bytes that are already on local disk — chunk k of one
+                # identity is a fixed range of an immutable snapshot, so
+                # accept-and-discard is safe, and rejecting would burn
+                # the whole transfer back to zero (the pre-fix behavior)
+                return True
             if c.chunk_id == 0:
                 stale = fl
                 fl = _InFlight(c.chunk_count, _chunk_ident(c), None)

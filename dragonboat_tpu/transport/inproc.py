@@ -61,6 +61,15 @@ class _InProcSnapshotConnection(ISnapshotConnection):
     def close(self) -> None:
         pass
 
+    def query_resume(self, probe: Chunk) -> int:
+        """Resume-cursor query (ChunkSink.resume_cursor on the peer):
+        direct call — the in-proc analogue of the TCP resume frames."""
+        with _network_lock:
+            peer = _network.get(self.target)
+        if peer is None or peer._closed or peer.resume_handler is None:
+            return 0
+        return peer.resume_handler(probe)
+
     def send_chunk(self, chunk: Chunk) -> None:
         with _network_lock:
             peer = _network.get(self.target)
@@ -97,6 +106,9 @@ class InProcTransport(ITransport):
         self._closed = False
         # the unified fault plane (faults.FaultController.on_wire)
         self.fault_injector = None
+        # resume-cursor query target (ChunkSink.resume_cursor); set by
+        # the NodeHost beside chunk_handler
+        self.resume_handler = None
 
     def name(self) -> str:
         return "inproc"

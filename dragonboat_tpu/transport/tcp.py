@@ -34,6 +34,8 @@ from .wire import (
     KIND_BATCH,
     KIND_CHUNK,
     KIND_COMPRESSED,
+    KIND_RESUME_QUERY,
+    KIND_RESUME_RESP,
     MAGIC,
     MAX_PAYLOAD,
     WIRE_COMPRESS_THRESHOLD,
@@ -138,6 +140,28 @@ class _TCPSnapshotConnection(ISnapshotConnection):
         except OSError:
             pass
 
+    def query_resume(self, probe: Chunk) -> int:
+        """Resume-cursor exchange on the (otherwise write-only) snapshot
+        socket: one KIND_RESUME_QUERY frame out, one KIND_RESUME_RESP
+        frame back.  Any failure (old receiver closing on the unknown
+        kind, timeout, torn connection) degrades to 0 — the sender
+        restarts from chunk 0 and the receiver's idempotent re-delivery
+        discards what it already holds."""
+        try:
+            with self._lock:
+                _write_frame(
+                    self._sock, KIND_RESUME_QUERY, encode_chunk(probe)
+                )
+                frame = _read_frame(self._sock)
+            if frame is None:
+                return 0
+            kind, payload = frame
+            if kind != KIND_RESUME_RESP or len(payload) != 8:
+                return 0
+            return struct.unpack("<Q", payload)[0]
+        except (OSError, WireError, ValueError):
+            return 0
+
     def send_chunk(self, chunk: Chunk) -> None:
         inj = self._owner.fault_injector
         if inj is None:
@@ -181,6 +205,9 @@ class TCPTransport(ITransport):
         # the unified fault plane, same contract as the in-proc
         # transport (faults.FaultController.on_wire)
         self.fault_injector = None
+        # resume-cursor query target (ChunkSink.resume_cursor); set by
+        # the NodeHost beside chunk_handler
+        self.resume_handler = None
 
     def name(self) -> str:
         return "tcp"
@@ -287,6 +314,13 @@ class TCPTransport(ITransport):
                         # job fails fast and retries/reports, instead of
                         # pumping the rest of a doomed stream
                         raise WireError("chunk rejected by receiver")
+                elif kind == KIND_RESUME_QUERY:
+                    cursor = 0
+                    if self.resume_handler is not None:
+                        cursor = self.resume_handler(decode_chunk(payload))
+                    _write_frame(
+                        sock, KIND_RESUME_RESP, struct.pack("<Q", cursor)
+                    )
                 else:
                     raise WireError(f"unknown frame kind {kind}")
         except (WireError, ValueError) as e:

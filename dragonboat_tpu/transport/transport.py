@@ -17,6 +17,7 @@ from collections import deque
 from typing import Callable, Dict, Optional
 
 from .. import settings
+from ..bigstate.pacing import TokenBucket
 from ..logger import get_logger
 from ..pb import Message, MessageBatch, MessageType
 from ..raftio import ITransport
@@ -136,6 +137,7 @@ class Transport:
         snapshot_status_cb: Optional[Callable[[int, int, bool], None]] = None,
         max_snapshot_send_bytes_per_second: int = 0,
         metrics_registry=None,
+        stream_event_cb: Optional[Callable[[int, str, str], None]] = None,
     ):
         self.raw = raw
         self.resolver = resolver
@@ -149,6 +151,22 @@ class Transport:
         # (shard_id, to_replica, failed) -> report to the sending raft peer
         self.snapshot_status_cb = snapshot_status_cb
         self.max_snapshot_send_rate = max_snapshot_send_bytes_per_second
+        # ONE bucket shared by every stream job: the cap bounds this
+        # host's aggregate snapshot egress, not each stream's (N
+        # concurrent catch-ups used to multiply the cap N-fold).  The
+        # bucket is live-retunable (set_snapshot_send_rate / the
+        # bigstate.pacing.CapFeedback loop).
+        self.snapshot_pacer: Optional[TokenBucket] = (
+            TokenBucket(max_snapshot_send_bytes_per_second)
+            if max_snapshot_send_bytes_per_second > 0
+            else None
+        )
+        # throttle seconds of DISCARDED buckets: the *_total metric must
+        # stay monotone across cap off->on transitions (a counter that
+        # resets breaks every rate()/delta consumer)
+        self._stream_throttled_base = 0.0
+        # (shard_id, kind, detail) -> flight-recorder lane (nodehost)
+        self.stream_event_cb = stream_event_cb
         self._stream_jobs = 0
         self._stream_lock = threading.Lock()
         self._queues: Dict[str, _SendQueue] = {}
@@ -156,7 +174,14 @@ class Transport:
         self._threads: Dict[str, threading.Thread] = {}
         self._lock = threading.Lock()
         self._stopped = False
-        self.metrics = {"sent": 0, "dropped": 0, "failed": 0, "snapshots_sent": 0}
+        self.metrics = {
+            "sent": 0, "dropped": 0, "failed": 0, "snapshots_sent": 0,
+            # the snapshot_stream_* surface (docs/BIGSTATE.md): chunk and
+            # byte egress, resume events (a retry that continued from a
+            # non-zero receiver cursor instead of restarting), and the
+            # cumulative seconds the bandwidth cap held senders back
+            "stream_chunks": 0, "stream_bytes": 0, "stream_resumes": 0,
+        }
         self._metrics_registry = metrics_registry
         # the unified fault plane (faults.FaultController); propagated
         # to the raw ITransport so every outbound batch/chunk crosses it
@@ -333,23 +358,69 @@ class Transport:
         t.start()
         return True
 
+    def set_snapshot_send_rate(self, rate: int) -> None:
+        """Retune the shared stream cap at runtime (the CapFeedback
+        loop's knob; 0/negative removes the cap).  In-flight streams
+        pick the new rate up at their next chunk."""
+        self.max_snapshot_send_rate = rate
+        if rate > 0:
+            if self.snapshot_pacer is None:
+                self.snapshot_pacer = TokenBucket(rate)
+            else:
+                self.snapshot_pacer.set_rate(rate)
+        elif self.snapshot_pacer is not None:
+            # keep the *_total throttle counter monotone past the
+            # bucket's retirement
+            self._stream_throttled_base += self.snapshot_pacer.throttled_seconds
+            self.snapshot_pacer = None
+
+    def stream_throttled_seconds(self) -> float:
+        """Cumulative cap-induced sleep across ALL buckets this
+        transport ever ran (the snapshot_stream_throttle_seconds_total
+        gauge source — monotone even when the cap is toggled)."""
+        p = self.snapshot_pacer
+        return self._stream_throttled_base + (
+            p.throttled_seconds if p is not None else 0.0
+        )
+
+    def _stream_event(self, shard_id: int, kind: str, detail: str) -> None:
+        cb = self.stream_event_cb
+        if cb is None:
+            return
+        try:
+            cb(shard_id, kind, detail)
+        except Exception:  # noqa: BLE001 — observability must not
+            # break the stream job
+            _log.exception("stream event callback raised")
+
     def _stream_job(self, m: Message, target: str) -> None:
         """One stream job with BOUNDED retry: a transient failure (peer
-        restarting, a fault window, one torn connection) re-streams from
-        chunk 0 after a short backoff instead of immediately reporting
-        the snapshot failed — reporting failure resets the remote to
-        WAIT and costs a full leader round trip before the next attempt.
-        Only after ``snapshot_stream_max_tries`` consecutive failures is
-        the failure surfaced (snapshot_status_cb + unreachable)."""
+        restarting, a fault window, one torn connection) RESUMES after a
+        short backoff instead of immediately reporting the snapshot
+        failed — reporting failure resets the remote to WAIT and costs a
+        full leader round trip before the next attempt.  Each retry asks
+        the receiver for its receive cursor (``query_resume``) and
+        continues from there; chunks already on the receiver's disk are
+        neither read nor re-sent.  Only after
+        ``snapshot_stream_max_tries`` consecutive failures is the
+        failure surfaced (snapshot_status_cb + unreachable)."""
         source = None
         tries = max(1, settings.Soft.snapshot_stream_max_tries)
+        self._stream_event(
+            m.shard_id, "snapshot_stream_start",
+            f"to={m.to} index={m.snapshot.index} target={target}",
+        )
         try:
             if not m.snapshot.dummy and self.snapshot_source_opener is not None:
                 source = self.snapshot_source_opener(m.snapshot)
             for attempt in range(tries):
                 try:
-                    self._stream_once(m, target, source)
+                    self._stream_once(m, target, source, attempt)
                     self.metrics["snapshots_sent"] += 1
+                    self._stream_event(
+                        m.shard_id, "snapshot_stream_complete",
+                        f"to={m.to} index={m.snapshot.index}",
+                    )
                     return
                 except Exception as e:  # noqa: BLE001 — any transport error
                     if self._stopped or attempt == tries - 1:
@@ -367,6 +438,10 @@ class Transport:
                         raise
         except Exception as e:  # noqa: BLE001 — retries exhausted
             _log.warning("snapshot stream to %s failed: %s", target, e)
+            self._stream_event(
+                m.shard_id, "snapshot_stream_fail",
+                f"to={m.to} index={m.snapshot.index}: {e}",
+            )
             self._snapshot_failed(m)
             if self.unreachable_cb is not None:
                 self.unreachable_cb(m)
@@ -376,39 +451,72 @@ class Transport:
             with self._stream_lock:
                 self._stream_jobs -= 1
 
-    def _stream_once(self, m: Message, target: str, source) -> None:
-        from .chunk import iter_snapshot_chunks
+    def _stream_once(
+        self, m: Message, target: str, source, attempt: int = 0
+    ) -> None:
+        from .chunk import iter_snapshot_chunks, resume_probe
 
+        start = 0
+        if attempt > 0 and source is not None and not m.snapshot.dummy:
+            # a RETRY of a partially-delivered stream: ask the receiver
+            # where its cursor stands.  The query rides its OWN probe
+            # connection: an old receiver closes the socket on the
+            # unknown frame kind, and chunks sent down that same dead
+            # socket would burn the whole attempt — a fresh chunk
+            # connection below keeps restart-from-zero working against
+            # pre-resume peers.  Any query failure answers 0, which the
+            # receiver's idempotent re-delivery tolerates.
+            probe_conn = self.raw.get_snapshot_connection(target)
+            try:
+                start = probe_conn.query_resume(resume_probe(m, source))
+            except Exception:  # noqa: BLE001 — degrade to restart
+                start = 0
+            finally:
+                probe_conn.close()
         conn = self.raw.get_snapshot_connection(target)
+        sent_chunks = 0
+        sent_bytes = 0
         try:
-            # deficit pacing against MaxSnapshotSendBytesPerSecond
-            # (reference: snapshot bandwidth limits [U]).  Each sent
-            # chunk adds its size to a byte deficit that drains at
-            # `rate`; the next chunk waits until the deficit clears.
-            # Debt is never forgiven (chunks larger than one second
-            # of budget still average out correctly) and idle time
-            # banks no burst credit.  Sleeps are sliced so close()
-            # interrupts promptly.
-            rate = self.max_snapshot_send_rate
-            deficit = 0.0
-            last = time.monotonic()
-            for c in iter_snapshot_chunks(m, source):
+            if start > 0:
+                with self._stream_lock:
+                    self.metrics["stream_resumes"] += 1
+                self._stream_event(
+                    m.shard_id, "snapshot_stream_resume",
+                    f"to={m.to} index={m.snapshot.index} "
+                    f"from_chunk={start}",
+                )
+            inj = self.fault_injector
+            # the nemesis stream plane (faults.STREAM_KINDS); getattr so
+            # bespoke test injectors with only on_wire keep working
+            stream_hook = getattr(inj, "on_snapshot_stream", None)
+            for c in iter_snapshot_chunks(m, source, start_chunk=start):
                 if self._stopped:
                     raise ConnectionError("transport stopped")
+                if stream_hook is not None:
+                    # snapshot_stream_kill raises here — the streamer
+                    # dies mid-transfer and the retry/resume path above
+                    # picks the transfer back up
+                    stream_hook(self.source_address, target, c)
                 conn.send_chunk(c)
-                if rate <= 0:
-                    continue
-                now = time.monotonic()
-                deficit = max(0.0, deficit - (now - last) * rate)
-                last = now
-                deficit += len(c.data)
-                while deficit > 0 and not self._stopped:
-                    time.sleep(min(deficit / rate, 0.1))
-                    now = time.monotonic()
-                    deficit = max(0.0, deficit - (now - last) * rate)
-                    last = now
+                sent_chunks += 1
+                sent_bytes += len(c.data)
+                # re-read per chunk: set_snapshot_send_rate promises
+                # in-flight streams pick a NEW/removed cap up at their
+                # next chunk, not just a retuned existing bucket
+                pacer = self.snapshot_pacer
+                if pacer is not None:
+                    # token-bucket cap shared across ALL stream jobs:
+                    # follower catch-up cannot starve the commit path
+                    # of bandwidth (bigstate.pacing; the cumulative
+                    # sleep surfaces as snapshot_stream_throttle_*)
+                    pacer.throttle(
+                        len(c.data), should_abort=lambda: self._stopped
+                    )
         finally:
             conn.close()
+            with self._stream_lock:
+                self.metrics["stream_chunks"] += sent_chunks
+                self.metrics["stream_bytes"] += sent_bytes
 
     def _snapshot_failed(self, m: Message) -> None:
         if self.snapshot_status_cb is not None:

@@ -42,6 +42,15 @@ from ..pb import (
 MAGIC = 0x54524654
 KIND_BATCH = 1
 KIND_CHUNK = 2
+# resumable snapshot streams (docs/BIGSTATE.md): a reconnecting sender
+# asks the receiver for its receive cursor before re-streaming.  The
+# query payload is an encoded data-less Chunk carrying the stream
+# identity; the response is one little-endian u64 (the next chunk
+# offset the receiver needs, 0 = restart).  Unknown kinds close the
+# connection on OLD receivers, which the sender treats as cursor 0 —
+# rolling upgrades degrade to restart-from-zero, never to corruption.
+KIND_RESUME_QUERY = 3
+KIND_RESUME_RESP = 4
 # frame-kind flag: payload is zlib-compressed (wire entry compression —
 # reference: EntryCompression on replicated batches [U]; ours is adaptive)
 KIND_COMPRESSED = 0x80

@@ -1,0 +1,163 @@
+#!/usr/bin/env bash
+# Big-state-plane smoke (bigstate tentpole, docs/BIGSTATE.md): boot a
+# 3-host in-proc cluster on the OnDiskKV reference SM, fall a follower
+# behind a compacted 8MB state, then assert
+#   1. the laggard catches up via a STREAMED snapshot under a 4MB/s
+#      bandwidth cap (snapshot_stream_bytes_total covers the state,
+#      the token bucket actually throttled),
+#   2. the cap is RESPECTED: effective stream rate <= ~1.35x cap
+#      (burst headroom + the final sub-second partial interval),
+#   3. the commit path is unaffected while the stream runs: p50
+#      proposal latency during catch-up within 3x the healthy p50,
+#   4. the receive cursor machinery is wired end to end (a forced
+#      mid-stream kill resumes instead of restarting from zero).
+# Cheap (~10s, host+disk path only, no device) — wired into tier1.sh
+# as a post-step.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import shutil
+import sys
+import threading
+import time
+
+sys.path.insert(0, "tests")
+
+from dragonboat_tpu import (
+    Config,
+    EngineConfig,
+    ExpertConfig,
+    Fault,
+    FaultController,
+    FaultPlan,
+    NodeHost,
+    NodeHostConfig,
+    settings,
+)
+from dragonboat_tpu.bigstate.ondisk import ondisk_kv_factory, put_cmd
+from dragonboat_tpu.storage.logdb import in_mem_logdb_factory
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+from test_nodehost import propose_r, wait_for_leader
+
+settings.Soft.snapshot_chunk_size = 256 * 1024
+settings.Soft.snapshot_stream_max_tries = 8
+
+ADDRS = {1: "bs-smoke-1", 2: "bs-smoke-2", 3: "bs-smoke-3"}
+STATE_MB = 8
+CAP = 4 * 1024 * 1024
+reset_inproc_network()
+for rid in ADDRS:
+    shutil.rmtree(f"/tmp/nh-bs-smoke-{rid}", ignore_errors=True)
+shutil.rmtree("/tmp/bs-smoke-sm", ignore_errors=True)
+
+
+def mk(rid):
+    return NodeHost(NodeHostConfig(
+        nodehost_dir=f"/tmp/nh-bs-smoke-{rid}",
+        rtt_millisecond=2,
+        raft_address=ADDRS[rid],
+        expert=ExpertConfig(
+            engine=EngineConfig(exec_shards=2, apply_shards=2),
+            logdb_factory=in_mem_logdb_factory,
+        ),
+    ))
+
+
+fac = {rid: ondisk_kv_factory(f"/tmp/bs-smoke-sm/h{rid}") for rid in ADDRS}
+nhs = {rid: mk(rid) for rid in ADDRS}
+ctl = FaultController(seed=3, plan=FaultPlan())
+try:
+    for rid, nh in nhs.items():
+        nh.start_replica(
+            ADDRS, False, fac[rid],
+            Config(replica_id=rid, shard_id=1, election_rtt=20,
+                   heartbeat_rtt=2),
+        )
+    lid = wait_for_leader(nhs)
+    nh = nhs[lid]
+    s = nh.get_noop_session(1)
+
+    def p50(samples=60):
+        lat = []
+        for _ in range(samples):
+            t0 = time.monotonic()
+            propose_r(nh, s, put_cmd(b"p", b"x"))
+            lat.append(time.monotonic() - t0)
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    p50_healthy = p50()
+
+    fid = next(r for r in ADDRS if r != lid)
+    nhs[fid].close()
+    live = {r: h for r, h in nhs.items() if r != fid}
+    lid = wait_for_leader(live)
+    nh = nhs[lid]
+    s = nh.get_noop_session(1)
+    val = os.urandom(1024 * 1024)
+    for i in range(STATE_MB):
+        propose_r(nh, s, put_cmd(b"big-%d" % i, val))
+    for h in live.values():
+        h.sync_request_snapshot(1, compaction_overhead=1)
+        h.set_snapshot_send_rate(CAP)
+        h.transport.set_fault_injector(ctl)
+    kill = Fault("snapshot_stream_kill", p=1.0)
+    ctl.activate(kill)
+
+    nhf = mk(fid)
+    nhs[fid] = nhf
+    nhf.start_replica(
+        ADDRS, False, fac[fid],
+        Config(replica_id=fid, shard_id=1, election_rtt=20,
+               heartbeat_rtt=2),
+    )
+    t0 = time.monotonic()
+
+    def heal():
+        while ctl.stats.get("stream_kills", 0) < 1:
+            if time.monotonic() - t0 > 20:
+                return
+            time.sleep(0.001)
+        ctl.deactivate(kill)
+
+    threading.Thread(target=heal, daemon=True, name="bs-smoke-heal").start()
+
+    p50_during = p50()  # (3) measured while the capped stream runs
+
+    last = b"big-%d" % (STATE_MB - 1)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and nhf.stale_read(1, last) != val:
+        time.sleep(0.05)
+    assert nhf.stale_read(1, last) == val, "laggard never caught up"
+    caught_s = time.monotonic() - t0
+
+    sbytes = sum(h.transport.metrics["stream_bytes"] for h in live.values())
+    resumes = sum(h.transport.metrics["stream_resumes"] for h in live.values())
+    throttled = sum(
+        h.transport.snapshot_pacer.throttled_seconds
+        for h in live.values() if h.transport.snapshot_pacer is not None
+    )
+    assert sbytes >= STATE_MB << 20, (sbytes, STATE_MB << 20)       # (1)
+    assert throttled > 0, "token bucket never engaged"              # (1)
+    eff = sbytes / caught_s
+    assert eff <= 1.35 * CAP, f"cap violated: {eff/1e6:.1f}MB/s"    # (2)
+    assert p50_during <= max(3 * p50_healthy, p50_healthy + 0.004), (
+        f"commit p50 degraded: {p50_healthy*1e3:.2f} -> "
+        f"{p50_during*1e3:.2f} ms"
+    )                                                               # (3)
+    assert ctl.stats.get("stream_kills", 0) >= 1                    # (4)
+    assert resumes >= 1, "killed streamer restarted from zero"      # (4)
+    print(
+        f"BIGSTATE_SMOKE_OK streamed={sbytes >> 20}MB in {caught_s:.1f}s "
+        f"(cap {CAP >> 20}MB/s, eff {eff/1e6:.1f}MB/s) "
+        f"p50 {p50_healthy*1e3:.2f}->{p50_during*1e3:.2f}ms "
+        f"kills={ctl.stats.get('stream_kills')} resumes={resumes}"
+    )
+finally:
+    ctl.stop()
+    for h in nhs.values():
+        try:
+            h.close()
+        except Exception:
+            pass
+EOF

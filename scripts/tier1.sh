@@ -1,11 +1,14 @@
 #!/usr/bin/env bash
 # Tier-1 verify: the ROADMAP.md command (plus --durations=15 so the
-# budget hogs are named in every run), runnable from any cwd, with three
+# budget hogs are named in every run), runnable from any cwd, with four
 # cheap post-steps: the observability smoke (scripts/obs_smoke.sh, ~5s),
 # the serving-front-plane smoke (scripts/gateway_smoke.sh, ~10s: batched
 # session proposals, lease reads, routing convergence, overload
-# shedding) and the static-analysis gates + analyzer self-tests
-# (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).  Prints
+# shedding), the big-state smoke (scripts/bigstate_smoke.sh, ~5s:
+# capped resumable snapshot stream, cap respected, commit p50 held,
+# mid-transfer kill resumes) and the static-analysis gates + analyzer
+# self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
+# Prints
 # DOTS_PASSED=<n> and a TIER1_BUDGET runtime line against the 870s
 # ROADMAP budget, and exits non-zero if any step fails.
 cd "$(dirname "$0")/.." || exit 1
@@ -21,5 +24,6 @@ fi
 echo "TIER1_BUDGET: pytest ${total}s of 870s (headroom ${headroom}s)${warn}"
 timeout -k 10 120 bash scripts/obs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/gateway_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/bigstate_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

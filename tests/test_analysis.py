@@ -697,3 +697,50 @@ def test_lockcheck_env_gate_matches_invariants_pattern():
         assert lockcheck.ENABLED is True
     finally:
         lockcheck.enable(old)
+
+
+# ---------------------------------------------------------------------------
+# stream-read (the big-state streaming path: bounded reads only)
+# ---------------------------------------------------------------------------
+STREAM_READ_SRC = '''
+def reassemble(f):
+    return f.read()
+
+
+def copy(src, dst):
+    while True:
+        piece = src.read(1 << 20)
+        if not piece:
+            break
+        dst.write(piece)
+
+
+def meta(f):
+    # raftlint: ignore[stream-read] bounded metadata blob
+    return f.read()
+'''
+
+
+def test_stream_read_flags_unbounded_read_in_stream_modules():
+    for mod in (
+        "dragonboat_tpu/transport/chunk.py",
+        "dragonboat_tpu/storage/snapshotter.py",
+        "dragonboat_tpu/bigstate/dr.py",
+        "dragonboat_tpu/tools.py",
+    ):
+        fs = lint_source(STREAM_READ_SRC, mod)
+        # reassemble() flagged; copy()'s sized read and the annotated
+        # meta() read pass
+        assert rules_of(fs) == {"stream-read"} and len(fs) == 1, (mod, fs)
+
+
+def test_stream_read_scoped_to_stream_modules():
+    assert lint_source(STREAM_READ_SRC, "dragonboat_tpu/gateway/x.py") == []
+
+
+def test_stream_read_ignore_annotation_is_live():
+    stripped = STREAM_READ_SRC.replace(
+        "# raftlint: ignore[stream-read]", "# stripped"
+    )
+    fs = lint_source(stripped, "dragonboat_tpu/bigstate/dr.py")
+    assert len(fs) == 2 and rules_of(fs) == {"stream-read"}

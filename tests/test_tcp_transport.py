@@ -208,6 +208,35 @@ class TestTCPSockets:
         assert chunks[0] == c
         conn.close()
 
+    def test_resume_query_roundtrip(self, pair):
+        """The resumable-stream frame pair (docs/BIGSTATE.md): a
+        KIND_RESUME_QUERY on the snapshot socket answers with the
+        receiver's cursor; no handler installed answers 0."""
+        a, b, _, _ = pair
+        probe = Chunk(
+            shard_id=3, replica_id=2, from_=1, chunk_count=9,
+            index=42, term=7, message_term=7, file_size=1234,
+        )
+        seen = []
+
+        def handler(c):
+            seen.append(c)
+            return 5
+
+        a.resume_handler = handler
+        conn = b.get_snapshot_connection(a.listen_address)
+        assert conn.query_resume(probe) == 5
+        assert seen and seen[0].index == 42 and seen[0].chunk_count == 9
+        # the same socket still carries chunks after the exchange
+        c = Chunk(shard_id=3, replica_id=2, chunk_id=0, chunk_count=1,
+                  data=b"z")
+        conn.send_chunk(c)
+        conn.close()
+        a.resume_handler = None
+        conn2 = b.get_snapshot_connection(a.listen_address)
+        assert conn2.query_resume(probe) == 0  # no handler -> restart
+        conn2.close()
+
     def test_corrupt_frame_closes_connection(self, pair):
         a, b, received, _ = pair
         host, port = a.listen_address.rsplit(":", 1)
