@@ -862,6 +862,193 @@ def phase_jaxcheck() -> dict:
     }
 
 
+def phase_hostplane(rows_list=None, launches: int = 6) -> dict:
+    """Host-plane plan/merge stage cost, scalar (the r5 shape) vs
+    vectorized (r6, ops/hostplane.py), over fabricated generations.
+
+    The r5 ledger's Config 4 showed t_plan (887 s) + t_updates (538 s)
+    of per-row Python dominating a 2,731 s 50k-shard election at 250k
+    replica rows while the device plane cost ~4 s.  This phase times
+    exactly the stages the r6 vectorization replaced, on fabricated
+    generation traces at each ``rows`` tier:
+
+    * plan  — the classifier's static-eligibility pass: per-row
+      ``_RowMeta`` attribute probes behind dict lookups (scalar) vs
+      one ``classify_static`` lane pass (vectorized);
+    * updates — the merge row-set machinery: per-row flag probes,
+      ``*_at`` dict builds and ``all(g in …)`` membership scans
+      (scalar) vs ``build_merge_sets`` + ``pos_of``/``covered`` index
+      arrays (vectorized).
+
+    Two generation shapes run per launch — an election-storm mix
+    (most rows live) and a steady-state mix (sparse) — because the
+    scalar cost is O(rows) in BOTH (the storm pays it in the loop
+    bodies, the steady state in the scans).  Parity is asserted every
+    generation: the numbers are only comparable if the outputs are
+    byte-identical.  Host-only (numpy; no device, no cluster).
+    Default tier 10k rows rides the standard bench; the 50k/250k
+    tiers (the r5 ledger's scale) run when BENCH_HOSTPLANE_HEAVY=1 —
+    same env-gating convention as SCALE_CHURN.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from dragonboat_tpu.ops import hostplane as hp
+
+    if rows_list is None:
+        rows_list = [10_000]
+        if bool(int(os.environ.get("BENCH_HOSTPLANE_HEAVY", "0"))):
+            rows_list += [50_000, 250_000]
+
+    class _Meta:  # the r5 per-row probe target
+        __slots__ = ("plan_ok", "dirty", "esc_hold")
+
+        def __init__(self, plan_ok, dirty, esc_hold):
+            self.plan_ok = plan_ok
+            self.dirty = dirty
+            self.esc_hold = esc_hold
+
+    def _gen(rng, G, storm: bool):
+        from dragonboat_tpu.ops.types import (
+            F_APPEND, F_CHANGED, F_COUNT, F_ESC, F_NEED_SS,
+        )
+
+        flags = np.zeros((G,), np.int64)
+        mix = (
+            ((F_CHANGED, 0.9), (F_COUNT, 0.1), (F_APPEND, 0.5),
+             (F_NEED_SS, 0.01), (F_ESC, 0.002))
+            if storm else
+            ((F_CHANGED, 0.02), (F_COUNT, 0.01), (F_APPEND, 0.005),
+             (F_NEED_SS, 0.001), (F_ESC, 0.0005))
+        )
+        for bit, p in mix:
+            flags |= np.where(rng.random(G) < p, bit, 0)
+        alive = rng.random(G) < 0.98
+        batch_gs = np.nonzero(
+            rng.random(G) < (0.95 if storm else 0.05)
+        )[0].astype(np.int64)
+        prop_gs = (
+            batch_gs[rng.random(len(batch_gs)) < 0.02]
+            if len(batch_gs) else np.zeros((0,), np.int64)
+        )
+        return flags, alive, batch_gs, prop_gs
+
+    def _scalar_r5_merge(flags_l, alive_l, batch_l, prop_l, G):
+        """The RAW r5 loop shapes, canonicalization-free: what the old
+        merge tail actually paid per launch.  (hostplane's
+        build_merge_sets_scalar is the PARITY oracle and sorts/boxes
+        its outputs for comparison — timing it overstated the scalar
+        cost by ~20%, review finding.)"""
+        from dragonboat_tpu.ops.types import (
+            F_ANY_LIVE, F_APPEND, F_COUNT, F_ESC, F_NEED_SS,
+        )
+
+        batch_set = set(batch_l)
+        esc_batch = [g for g in batch_l if flags_l[g] & F_ESC]
+        esc_other = [
+            g for g in range(G)
+            if alive_l[g] and g not in batch_set and flags_l[g] & F_ESC
+        ]
+        esc_set = set(esc_batch) | set(esc_other)
+        live = [g for g in batch_l if g not in esc_set]
+        for g in range(G):
+            if (
+                alive_l[g]
+                and g not in batch_set
+                and g not in esc_set
+                and flags_l[g] & F_ANY_LIVE
+            ):
+                live.append(g)
+        slot_rows = [g for g in prop_l if g not in esc_set]
+        slot_set = set(slot_rows)
+        buf_rows = [g for g in live if flags_l[g] & F_COUNT]
+        append_rows = [g for g in live if flags_l[g] & F_APPEND]
+        need_rows = [g for g in live if flags_l[g] & F_NEED_SS]
+        sum_rows = [
+            g for g in live if (flags_l[g] & F_ANY_LIVE) or g in slot_set
+        ]
+        return buf_rows, append_rows, slot_rows, need_rows, sum_rows
+
+    tiers = []
+    for G in rows_list:
+        rng = np.random.default_rng(6)
+        lanes = hp.RowLanes(G)
+        lanes.attached[:] = rng.random(G) < 0.98
+        lanes.dirty[:] = rng.random(G) < 0.05
+        lanes.plan_ok[:] = rng.random(G) < 0.9
+        lanes.esc_hold[:] = np.where(rng.random(G) < 0.01, 3, 0)
+        metas = {
+            g: _Meta(bool(lanes.plan_ok[g]), bool(lanes.dirty[g]),
+                     int(lanes.esc_hold[g]))
+            for g in range(G) if lanes.attached[g]
+        }
+        gs = np.where(lanes.attached, np.arange(G), -1).astype(np.int64)
+        gs_l = gs.tolist()
+        t_plan_s = t_plan_v = 0.0
+        t_upd_s = t_upd_v = 0.0
+        for li in range(launches):
+            # ---- plan classifier ---------------------------------
+            t0 = _time.perf_counter()
+            out_s = [False] * len(gs_l)
+            for i, g in enumerate(gs_l):  # the r5 probe shape
+                m = metas.get(g)
+                if (
+                    m is not None
+                    and m.plan_ok
+                    and not m.dirty
+                    and m.esc_hold == 0
+                ):
+                    out_s[i] = True
+            t_plan_s += _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            out_v = hp.classify_static(lanes, gs)
+            t_plan_v += _time.perf_counter() - t0
+            assert out_v.tolist() == out_s, "classify parity broke"
+            # ---- merge row sets ----------------------------------
+            for storm in (True, False):
+                flags, alive, batch_gs, prop_gs = _gen(rng, G, storm)
+                flags_l = flags.tolist()
+                alive_l = alive.tolist()
+                batch_l = batch_gs.tolist()
+                prop_l = prop_gs.tolist()
+                t0 = _time.perf_counter()
+                raw = _scalar_r5_merge(flags_l, alive_l, batch_l,
+                                       prop_l, G)
+                # the r5 dict builds + membership scans (device rows =
+                # the exact sets, the common single-sync launch shape)
+                at = {g: k for k, g in enumerate(raw[4])}
+                _ = all(g in at for g in raw[4])
+                t_upd_s += _time.perf_counter() - t0
+                t0 = _time.perf_counter()
+                sets = hp.build_merge_sets(
+                    flags, alive, batch_gs, prop_gs, G=G
+                )
+                pos = hp.pos_of(G, sets.sum_rows)
+                _ = hp.covered(pos, sets.sum_rows)
+                t_upd_v += _time.perf_counter() - t0
+                # parity OUTSIDE the timed windows: the vectorized
+                # sets against the canonical oracle, and the raw r5
+                # shapes against the same sets
+                hp.assert_merge_parity(
+                    flags, alive, batch_gs, prop_gs, sets, G=G
+                )
+                assert sorted(raw[4]) == sets.sum_rows.tolist(), (
+                    "raw r5 shape diverged from the oracle"
+                )
+        tiers.append({
+            "rows": G,
+            "launches": launches,
+            "t_plan_scalar_ms": round(t_plan_s * 1000, 2),
+            "t_plan_vec_ms": round(t_plan_v * 1000, 2),
+            "plan_speedup": round(t_plan_s / max(t_plan_v, 1e-9), 1),
+            "t_updates_scalar_ms": round(t_upd_s * 1000, 2),
+            "t_updates_vec_ms": round(t_upd_v * 1000, 2),
+            "updates_speedup": round(t_upd_s / max(t_upd_v, 1e-9), 1),
+        })
+    return {"tiers": tiers, "parity": True}
+
+
 def phase_balance(
     shards: int = 16,
     hosts: int = 4,
@@ -1411,7 +1598,7 @@ def main() -> None:
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
-             gateway=None, bigstate=None) -> None:
+             gateway=None, bigstate=None, hostplane=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -1454,6 +1641,11 @@ def main() -> None:
                     # (bigstate/; laggard catch-up MB/s at 3 cap levels
                     # + concurrent commit-throughput delta)
                     "bigstate": bigstate,
+                    # r12 schema addition: host-plane vectorization
+                    # guard (ops/hostplane.py; scalar-vs-vectorized
+                    # plan/merge stage wall time per rows tier — the
+                    # r6 ledgers track t_plan/t_updates through this)
+                    "hostplane": hostplane,
                 }
             ),
             flush=True,
@@ -1663,6 +1855,22 @@ def main() -> None:
             bsb = {"error": bs_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb)
+
+    # Host-plane vectorization guard (pure numpy — no device, cheap):
+    # scalar-vs-vectorized plan/merge stage costs per rows tier
+    hpb = None
+    if bool(int(os.environ.get("BENCH_HOSTPLANE", "1"))) and remaining() > 45:
+        code = (
+            "import json, bench;"
+            "print('BENCHHP ' + json.dumps(bench.phase_hostplane()))"
+        )
+        hpb, hp_err = run_sub(
+            code, "BENCHHP", max(45, min(240, int(remaining() - 30)))
+        )
+        if hpb is None:
+            hpb = {"error": hp_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb)
 
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is

@@ -62,6 +62,20 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     routing table would serialize every client of every shard through
     one mutex.
 
+``host-loop``
+    In the colocated host-plane modules (``ops/colocated.py``,
+    ``ops/hostplane.py``), a function whose ``def`` line carries a
+    ``# hostplane-hot`` comment is a declared array-at-once pass over
+    ALL rows of a generation: ``for`` statements and comprehensions
+    are banned inside it — per-row Python in the plan/merge stages is
+    exactly what the r6 vectorization removed (t_plan 887 s +
+    t_updates 538 s of a 2,731 s 50k-shard election at 250k rows,
+    docs/BENCH_NOTES_r05.md) and must not rot back in.  A ``#
+    raftlint: ignore[host-loop] <reason>`` on the ``def`` line (or on
+    a pure-comment line directly above it) exempts a whole function —
+    the documented scalar fallbacks and parity oracles (``*_scalar``
+    twins in ops/hostplane.py).
+
 ``stream-read``
     The snapshot streaming path (``transport/chunk.py``,
     ``storage/snapshotter.py``, ``storage/snapshotio.py``,
@@ -143,6 +157,14 @@ STREAM_READ_MODULES = (
 # snapshot-read paths (docs/GATEWAY.md "Routing")
 GATEWAY_MODULES = ("dragonboat_tpu/gateway/",)
 GATEWAY_HOT_RE = re.compile(r"#\s*gateway-hot\b")
+
+# the colocated host plane: `# hostplane-hot` functions are
+# array-at-once passes — no for-over-rows (docs/ANALYSIS.md)
+HOSTPLANE_MODULES = (
+    "dragonboat_tpu/ops/colocated.py",
+    "dragonboat_tpu/ops/hostplane.py",
+)
+HOSTPLANE_HOT_RE = re.compile(r"#\s*hostplane-hot\b")
 
 # attributes whose read is a static (trace-time, host-free) fact
 _STATIC_FACT_ATTRS = {"shape", "ndim", "size", "dtype"}
@@ -247,9 +269,14 @@ class _Linter(ast.NodeVisitor):
             self.relpath, STREAM_READ_MODULES
         )
         self.check_gateway = _module_matches(self.relpath, GATEWAY_MODULES)
-        # count of enclosing `# gateway-hot` functions (nested defs
-        # inside a hot function inherit the discipline)
+        self.check_hostplane = _module_matches(
+            self.relpath, HOSTPLANE_MODULES
+        )
+        # count of enclosing `# gateway-hot` / `# hostplane-hot`
+        # functions (nested defs inside a hot function inherit the
+        # discipline)
         self._hot_depth = 0
+        self._hp_depth = 0
         # file-wide guarded fields: attr -> (lock attr, defining func node)
         self.guarded: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
         # module-level struct.Struct assignments: name -> Q slot indices
@@ -391,6 +418,11 @@ class _Linter(ast.NodeVisitor):
         )
         if hot:
             self._hot_depth += 1
+        hp = self.check_hostplane and bool(
+            HOSTPLANE_HOT_RE.search(self._line(node.lineno))
+        )
+        if hp:
+            self._hp_depth += 1
         self._func_stack.append(node)
         try:
             self.generic_visit(node)
@@ -400,6 +432,8 @@ class _Linter(ast.NodeVisitor):
             self._held_self = held_self
             if hot:
                 self._hot_depth -= 1
+            if hp:
+                self._hp_depth -= 1
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_func(node)
@@ -589,17 +623,28 @@ class _Linter(ast.NodeVisitor):
             for n in ast.walk(node)
         )
 
-    def _host_sync_func_exempt(self) -> bool:
-        """A `# raftlint: ignore[host-sync] <reason>` on an enclosing
-        def line exempts the whole function — the documented host-side
-        helpers living inside a device module."""
+    def _func_exempt(self, rule: str) -> bool:
+        """A `# raftlint: ignore[<rule>] <reason>` on an enclosing def
+        line — or on a pure-comment line directly above it (the same
+        ignore-next-line style `_suppressed` accepts) — exempts the
+        whole function: the documented host-side helpers living inside
+        a device module (host-sync) and the documented scalar
+        fallbacks / parity oracles of the host plane (host-loop).
+        Decorated defs are also covered via the decorator lines."""
         for func in self._func_stack:
-            m = IGNORE_RE.search(self._line(func.lineno))
-            if m and "host-sync" in {
-                r.strip() for r in m.group(1).split(",")
-            }:
-                return True
+            lines = {func.lineno}
+            if self._line(func.lineno - 1).strip().startswith("#"):
+                lines.add(func.lineno - 1)
+            for ln in lines:
+                m = IGNORE_RE.search(self._line(ln))
+                if m and rule in {
+                    r.strip() for r in m.group(1).split(",")
+                }:
+                    return True
         return False
+
+    def _host_sync_func_exempt(self) -> bool:
+        return self._func_exempt("host-sync")
 
     def _check_host_sync(self, node: ast.Call) -> None:
         f = node.func
@@ -697,6 +742,44 @@ class _Linter(ast.NodeVisitor):
                     "u64 pack of unmasked value (append `& MASK64`; "
                     "docs/PARITY.md 64-bit policy)",
                 )
+
+    # ---- host-loop (for-over-rows in # hostplane-hot functions) ---------
+
+    def _check_host_loop(self, node: ast.AST, what: str) -> None:
+        if not self._hp_depth or self._func_exempt("host-loop"):
+            return
+        self._emit(
+            "host-loop",
+            node.lineno,
+            f"{what} inside a # hostplane-hot array pass (use numpy "
+            "array ops over all rows; per-row Python is the t_plan/"
+            "t_updates cost the r6 vectorization removed — "
+            "docs/ANALYSIS.md)",
+        )
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_host_loop(node, "`for` loop")
+        self.generic_visit(node)
+
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        self._check_host_loop(node, "`async for` loop")
+        self.generic_visit(node)
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._check_host_loop(node, "list comprehension")
+        self.generic_visit(node)
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        self._check_host_loop(node, "set comprehension")
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        self._check_host_loop(node, "dict comprehension")
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._check_host_loop(node, "generator expression")
+        self.generic_visit(node)
 
     # ---- hygiene --------------------------------------------------------
 

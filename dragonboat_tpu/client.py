@@ -151,6 +151,15 @@ class LatencyBudget:
             if self._since_refresh >= self._P99_REFRESH:
                 self._p99_cache = None
 
+    def samples(self) -> int:
+        """Observed-latency count in the sliding window.  0 means
+        :meth:`p99` is returning the BOOTSTRAP guess, not a
+        measurement — consumers acting on p99 (e.g. the gateway's
+        snapshot-cap feedback) should treat that as "no signal", not
+        as a degraded commit path."""
+        with self._lock:
+            return len(self._lat)
+
     def p99(self) -> float:
         with self._lock:
             if not self._lat:

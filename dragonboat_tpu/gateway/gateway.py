@@ -74,6 +74,9 @@ class GatewayConfig:
         shed_dump_window: float = 5.0,
         shed_dump_cooldown: float = 30.0,
         budget: Optional[LatencyBudget] = None,
+        cap_feedback: bool = True,
+        cap_feedback_target_p99: float = 0.25,
+        cap_feedback_interval: float = 1.0,
     ):
         if workers < 1:
             raise ValueError("workers must be >= 1")
@@ -88,6 +91,16 @@ class GatewayConfig:
         self.shed_dump_window = shed_dump_window
         self.shed_dump_cooldown = shed_dump_cooldown
         self.budget = budget
+        # snapshot-stream cap feedback (ROADMAP 5a): a NodeHost with a
+        # gateway attached gets its `bigstate.pacing.CapFeedback` AIMD
+        # loop fed from THIS gateway's LatencyBudget automatically —
+        # the gateway observes every commit's latency anyway, which is
+        # exactly the live signal the loop was missing.  cap_feedback=
+        # False opts out (operators driving the cap by hand or from
+        # their own control loop).
+        self.cap_feedback = cap_feedback
+        self.cap_feedback_target_p99 = cap_feedback_target_p99
+        self.cap_feedback_interval = cap_feedback_interval
 
 
 class GatewayFuture:
@@ -240,9 +253,21 @@ class Gateway:
         # _hosts_lock concentrated contention on the overload path)
         self._shed_recorder = None
         self._taps = []  # (host, fn) pairs for detach on close
+        # per-host snapshot-cap AIMD loops fed from self.budget (see
+        # GatewayConfig.cap_feedback); guarded-by: _hosts_lock
+        self._cap_loops: Dict[str, object] = {}
+        self._cap_stop = threading.Event()
+        self._cap_thread: Optional[threading.Thread] = None
         for key, nh in self._hosts.items():
             self._attach_host(key, nh)
         self._refresh_shed_recorder()
+        if self.config.cap_feedback:
+            self._cap_thread = threading.Thread(
+                target=self._cap_feedback_main,
+                daemon=True,
+                name="tpu-gw-capfeedback",
+            )
+            self._cap_thread.start()
         self._wake_events = [
             threading.Event() for _ in range(self.config.workers)
         ]
@@ -272,6 +297,124 @@ class Gateway:
         except Exception:  # noqa: BLE001 — a host without a fanout
             # (test double) still routes via discovery
             _log.exception("gateway: could not tap host %s", key)
+        self._maybe_attach_cap_feedback(key, nh)
+
+    def _maybe_attach_cap_feedback(self, key: str, nh) -> None:
+        """Register the host for snapshot-cap feedback (ROADMAP 5a):
+        the gateway's feedback thread wires any CONFIGURED stream cap
+        (transport.snapshot_pacer) to a ``CapFeedback`` AIMD loop fed
+        from ``self.budget``.  Binding is resolved PER TICK, not here:
+        the operator's runtime knob (``set_snapshot_send_rate``) can
+        create, retune or remove the bucket long after attach — a
+        snapshot taken now would miss a late-configured cap, clamp a
+        raised one back to a stale base, or keep ticking an orphaned
+        bucket (review findings).  Hosts without a cap are left alone —
+        the loop never INVENTS a cap the operator didn't configure."""
+        if not self.config.cap_feedback:
+            return
+        if getattr(nh, "transport", None) is None:
+            return
+        with self._hosts_lock:
+            self._cap_loops[key] = {"nh": nh, "fb": None}
+
+    def _cap_feedback_main(self) -> None:
+        from ..bigstate.pacing import CapFeedback  # stdlib-only module
+
+        while not self._cap_stop.wait(self.config.cap_feedback_interval):
+            samples_fn = getattr(self.budget, "samples", None)
+            # no observed commits yet: p99() is returning the BOOTSTRAP
+            # guess, not a measurement — keep binding/tracking loops
+            # but make no rate adjustment.  An idle gateway must not
+            # read a default 1s bootstrap as a degraded commit path and
+            # shrink the operator's cap to the floor with zero load —
+            # the exact big-state joiner-before-traffic window the cap
+            # exists for (review finding).
+            have_signal = not (callable(samples_fn) and samples_fn() == 0)
+            with self._hosts_lock:
+                loops = list(self._cap_loops.items())
+            for key, ent in loops:
+                try:
+                    # each tick runs UNDER _hosts_lock with a membership
+                    # re-check: remove_host/close pop the entry and then
+                    # RESTORE the cap to base — a tick racing past that
+                    # restore from a stale snapshot would re-shrink a
+                    # cap nothing will ever grow back (review finding).
+                    # The tick body is cheap (cached p99 + set_rate),
+                    # and host add/remove is rare, so the lock hold is
+                    # fine.
+                    with self._hosts_lock:
+                        if self._cap_loops.get(key) is not ent:
+                            continue  # retired while we walked
+                        tr = getattr(ent["nh"], "transport", None)
+                        pacer = getattr(tr, "snapshot_pacer", None)
+                        fb = ent["fb"]
+                        if pacer is None:
+                            # cap removed (set_snapshot_send_rate(0)):
+                            # the loop retires, never ticks the orphan
+                            ent["fb"] = None
+                            continue
+                        # the operator's configured base, re-read per
+                        # tick so a runtime retune moves the ceiling too
+                        base = float(
+                            getattr(tr, "max_snapshot_send_rate", 0) or 0
+                        )
+                        if base <= 0:
+                            ent["fb"] = None
+                            continue
+                        if fb is None or fb.bucket is not pacer:
+                            fb = CapFeedback(
+                                pacer,
+                                base_rate=base,
+                                target_p99=(
+                                    self.config.cap_feedback_target_p99
+                                ),
+                                budget=self.budget,
+                            )
+                            ent["fb"] = fb
+                        elif fb.base_rate != base:
+                            fb.base_rate = base
+                            fb.floor_rate = base / 16.0
+                        if have_signal:
+                            fb.tick()
+                except Exception:  # noqa: BLE001 — one host's loop
+                    # must not kill the others'
+                    _log.exception("gateway: cap feedback tick failed")
+
+    @staticmethod
+    def _retire_cap_loop(ent) -> None:
+        """Restore the host's cap to its configured base when the
+        feedback stops owning it (remove_host / close): without this a
+        cap shrunk by a transient latency spike would strand the host
+        at the AIMD floor forever — nothing else would grow it back
+        (review finding)."""
+        fb = ent.get("fb")
+        if fb is None:
+            return
+        tr = getattr(ent["nh"], "transport", None)
+        if getattr(tr, "snapshot_pacer", None) is fb.bucket and (
+            fb.bucket.rate != fb.base_rate
+        ):
+            try:
+                fb.bucket.set_rate(fb.base_rate)
+            except Exception:  # noqa: BLE001 — host mid-close
+                pass
+
+    def cap_feedback_stats(self) -> Dict[str, dict]:
+        """Per-host cap-feedback observability: current rate vs base
+        and the number of adjustments applied (hosts whose cap is
+        unconfigured/removed have no live loop and are omitted)."""
+        with self._hosts_lock:
+            loops = dict(self._cap_loops)
+        out = {}
+        for key, ent in loops.items():
+            fb = ent.get("fb")
+            if fb is not None:
+                out[key] = {
+                    "rate": fb.bucket.rate,
+                    "base_rate": fb.base_rate,
+                    "adjustments": fb.adjustments,
+                }
+        return out
 
     def _refresh_shed_recorder(self) -> None:
         rec = None
@@ -297,6 +440,10 @@ class Gateway:
             self._hosts = t
         if nh is None:
             return
+        with self._hosts_lock:
+            cap_ent = self._cap_loops.pop(key, None)
+        if cap_ent is not None:
+            self._retire_cap_loop(cap_ent)
         for pair in list(self._taps):
             if pair[0] is nh:
                 try:
@@ -678,6 +825,15 @@ class Gateway:
         if self._closed:
             return
         self._closed = True
+        self._cap_stop.set()
+        if self._cap_thread is not None:
+            self._cap_thread.join(timeout=2.0)
+        with self._hosts_lock:
+            cap_loops, self._cap_loops = self._cap_loops, {}
+        for ent in cap_loops.values():
+            # hosts outlive the gateway: give them their configured
+            # caps back (see _retire_cap_loop)
+            self._retire_cap_loop(ent)
         for ev in self._wake_events:
             ev.set()
         for t in self._workers:

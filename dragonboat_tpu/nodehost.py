@@ -541,7 +541,12 @@ class NodeHost:
         runtime (0 removes it).  The cap is one token bucket shared by
         every stream job of this host; the ``bigstate.pacing.
         CapFeedback`` loop drives this knob to keep follower catch-up
-        from starving the commit path."""
+        from starving the commit path.  A host fronted by a
+        ``gateway.Gateway`` gets that loop wired to a LIVE latency
+        source automatically — the gateway feeds its LatencyBudget's
+        commit latencies into a per-host AIMD loop unless
+        ``GatewayConfig(cap_feedback=False)`` opts out
+        (docs/GATEWAY.md "Snapshot-cap feedback")."""
         self.transport.set_snapshot_send_rate(bytes_per_second)
 
     def _deliver_received_snapshot(self, m: Message) -> None:

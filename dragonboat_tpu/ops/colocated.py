@@ -54,6 +54,7 @@ import numpy as np
 
 from ..analysis import jitcheck
 from ..engine.execengine import IStepEngine
+from . import hostplane
 from ..logger import get_logger
 from ..node import StepInputs
 from ..pb import Entry
@@ -75,6 +76,8 @@ from .engine import (
     _R_COUNT,
     _R_LEADER,
     _R_ROLE,
+    _R_TERM,
+    _R_LAST,
     _bucket,
     _place_rows,
     _pos_map,
@@ -495,6 +498,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._host_shard[g] = 0
         self._host_replica[g] = 0
         self._host_peers[g, :] = 0
+        self._lanes.reset_row(g, attached=False)
         self._tables_dirty = True
         if not any(
             s == shard_id for s, _ in self._row_of
@@ -956,27 +960,52 @@ class ColocatedVectorEngine(VectorStepEngine):
         )
         _t0 = _time.perf_counter()
         n_fast = 0
-        for node in nodes:
+        # ---- batched plan classifier --------------------------------
+        # ONE vectorized pass over the SoA lanes (ops/hostplane.py)
+        # decides static eligibility for the whole generation —
+        # plan_ok/dirty/esc_hold as bool lanes instead of per-row
+        # _RowMeta attribute probes.  Rows that pass still re-verify
+        # the cheap per-launch dynamic conditions (empty queues, clean
+        # binding, no snapshot/read state) inline; rows that fail take
+        # the scalar _plan_device classifier below — the escalation/
+        # slow-path oracle, exactly the contract the plan_ok fast tick
+        # lane (57 µs -> 5 µs per row) proved.
+        row_of = self._row_of
+        gs_list = [
+            row_of.get((n.shard_id, n.replica_id), -1) for n in nodes
+        ]
+        static_arr = hostplane.classify_static(
+            self._lanes, np.asarray(gs_list, np.int64)
+        )
+        if hostplane.PARITY:
+            hostplane.check_classify_parity(
+                self._lanes, gs_list, static_arr
+            )
+        static_ok = static_arr.tolist()
+        # rows of nodes seen stopping THIS generation: cleared from the
+        # launch's alive mask (their detach may still be queued behind
+        # the core lock)
+        self._gen_stopping = []
+        for i, node in enumerate(nodes):
             if node.stopped or node.stopping:
+                if gs_list[i] >= 0:
+                    self._gen_stopping.append(gs_list[i])
                 continue
             # ---- fast tick lane -------------------------------------
             # A clean resident row whose ONLY input is the lock-free
             # tick lane skips the drain lock and the full classifier:
             # the static checks were proven by the last full plan
-            # (meta.plan_ok) and everything that can change them either
-            # arrives through the queues (checked empty right here,
-            # GIL-atomic truthiness) or invalidates plan_ok at its
-            # source.  At 50k rows the full per-row plan was ~57 us and
-            # t_plan was 152 s of a 269 s election (10k-shard TPU run);
-            # the fast lane is ~5 us.
-            g = self._row_of.get(self._row_key(node))
-            meta = self._meta.get(g) if g is not None else None
+            # (the plan_ok lane, batch-checked above) and everything
+            # that can change them either arrives through the queues
+            # (checked empty right here, GIL-atomic truthiness) or
+            # invalidates plan_ok at its source.  At 50k rows the full
+            # per-row plan was ~57 us and t_plan was 152 s of a 269 s
+            # election (10k-shard TPU run); the fast lane is ~5 us.
+            g = gs_list[i]
+            meta = self._meta.get(g) if static_ok[i] else None
             if (
                 meta is not None
                 and meta.node is node  # not a stale pre-restart binding
-                and meta.plan_ok
-                and not meta.dirty
-                and meta.esc_hold == 0
                 and node not in self._save_quarantine
                 and not (
                     node._received
@@ -1070,11 +1099,12 @@ class ColocatedVectorEngine(VectorStepEngine):
         if batch or self._pending_live:
             if self._pending_live or any(plan for _, _, _, plan in batch):
                 _t0 = _time.perf_counter()
+                dirty_lane = self._lanes.dirty  # one load; np bool [G]
                 self._upload_rows(
                     [
                         (g, node.peer.raft)
                         for node, g, si, plan in batch
-                        if self._meta[g].dirty
+                        if dirty_lane[g]
                     ]
                 )
                 # float ms: lazy upload streams many sub-ms batches and
@@ -1105,6 +1135,39 @@ class ColocatedVectorEngine(VectorStepEngine):
             self.stats["t_persist_ms"] += int(
                 (_time.perf_counter() - _t0) * 1000
             )
+
+    def _sel_cover(self, G, caps, counts, sel_rows, sets):  # hostplane-hot
+        """Index-array coverage of the device's single-sync row
+        selection: when every host-side merge set is contained in the
+        device-selected sections (and the counts fit the warmed
+        capacity tier), return the five row->gather-position maps plus
+        the vals source rows; ``None`` sends the launch down the exact
+        two-sync fallback.  Replaces the old per-row ``*_at`` dict
+        builds and ``all(g in …)`` membership scans (O(rows) Python per
+        launch — pinned array-at-once by raftlint's host-loop rule)."""
+        n_buf, n_slot, n_need, n_append, n_sum = counts
+        if not (
+            n_buf <= caps["b"] and n_slot <= caps["sl"]
+            and n_need <= caps["n"] and n_append <= caps["a"]
+            and n_sum <= caps["s"]
+        ):
+            return None
+        rows_buf, rows_slot, rows_need, rows_append, rows_sum = sel_rows
+        pos_buf = hostplane.pos_of(G, rows_buf[:n_buf])
+        pos_slot = hostplane.pos_of(G, rows_slot[:n_slot])
+        pos_need = hostplane.pos_of(G, rows_need[:n_need])
+        pos_ring = hostplane.pos_of(G, rows_append[:n_append])
+        pos_sum = hostplane.pos_of(G, rows_sum[:n_sum])
+        if not (
+            hostplane.covered(pos_buf, sets.buf_rows)
+            and hostplane.covered(pos_slot, sets.slot_rows)
+            and hostplane.covered(pos_need, sets.need_rows)
+            and hostplane.covered(pos_ring, sets.append_rows)
+            and hostplane.covered(pos_sum, sets.sum_rows)
+        ):
+            return None
+        return (pos_buf, pos_slot, pos_need, pos_ring, pos_sum,
+                rows_sum[:n_sum])
 
     def _device_step_colocated(self, batch) -> List[Tuple]:
         G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
@@ -1139,19 +1202,34 @@ class ColocatedVectorEngine(VectorStepEngine):
         # each separate device_put pays ~10-20 ms of link latency
         combo_np = np.zeros((G, 4), np.int32)
         combo_np[:, _C_TICKS] = tick_counts
-        alive_np = np.zeros((G,), bool)
-        for g, meta in self._meta.items():
-            # a stopping member's rows must neither consume routed
-            # traffic nor be routable targets: a stopped-but-undetached
-            # leader would keep winning device elections while its host
-            # no longer publishes payloads to the entry cache — healthy
-            # peers then fail-stop on unreconstructible appends
-            alive_np[g] = not meta.dirty and not (
-                meta.node.stopped or meta.node.stopping
-            )
+        # alive straight off the SoA lanes (attached & clean) — the old
+        # per-launch Python scan over the whole meta table cost
+        # ~0.5 µs/row (~125 ms/launch at 250k rows).  Stopping rows
+        # must neither consume routed traffic nor be routable targets
+        # (a stopped-but-undetached leader would keep winning device
+        # elections while its host no longer publishes payloads to the
+        # entry cache — healthy peers then fail-stop on
+        # unreconstructible appends): STOPPED rows can never be
+        # lane-alive because every stop path detaches first
+        # (stop_shard/unregister, close/unregister_many, _halt_replica
+        # all clear the lane before node.stop() runs); a STOPPING
+        # not-yet-detached row is cleared here from this generation's
+        # plan-loop observations, and for the at-most-one launch that
+        # can race the detach's core-lock acquisition a stopping node
+        # still merges and publishes payloads (see the stopping-row
+        # merge contract below), so routed appends stay
+        # reconstructible.
+        alive_np = self._lanes.alive_mask()
+        gen_stopping = getattr(self, "_gen_stopping", None)
+        if gen_stopping:
+            alive_np[gen_stopping] = False
+        batch_gs = np.asarray(
+            [g for _, g, _, _ in batch], np.int64
+        )
+        prop_gs = np.asarray(prop_rows, np.int64)
         combo_np[:, _C_ALIVE] = alive_np
-        combo_np[[g for _, g, _, _ in batch], _C_BATCH] = 1
-        combo_np[prop_rows, _C_PROP] = 1
+        combo_np[batch_gs, _C_BATCH] = 1
+        combo_np[prop_gs, _C_PROP] = 1
         combo = self._put_rows(jnp.asarray(combo_np))
         host_inbox = _host_inbox_from_ticks(combo, M=M, E=E)
         if sparse:
@@ -1349,25 +1427,31 @@ class ColocatedVectorEngine(VectorStepEngine):
             "routed_dropped_ring", 0
         ) + int(rstats[3])
 
+        # ---- merge row sets (array-at-once) --------------------------
+        # ONE vectorized pass over the [G] flags word classifies every
+        # row of the launch (ops/hostplane.py): escalations, live rows,
+        # and the buf/append/need/slot/sum sets that used to be per-row
+        # list/dict comprehensions over the whole meta table (~1-2 µs a
+        # row — the dominant share of t_updates at 250k rows, r5
+        # ledger).  The scalar twins remain the parity oracle
+        # (DRAGONBOAT_TPU_HOSTPLANE_PARITY runs both every launch).
+        sets = hostplane.build_merge_sets(
+            flags, alive_np, batch_gs, prop_gs, G=G
+        )
+        hostplane.record_generation(flags, alive_np, batch_gs, prop_gs, G)
+        if hostplane.PARITY:
+            hostplane.check_merge_parity(
+                flags, alive_np, batch_gs, prop_gs, sets, G=G
+            )
+
         # ---- escalations ---------------------------------------------
-        # ONE C-level conversion: per-row numpy scalar indexing of the
-        # flag word costs ~150 ns a touch and the loops below touch it
-        # several times per row — at 250k rows that alone was tens of
-        # ms per generation
-        flags = flags.tolist()
-        batch_gs = {g for _, g, _, _ in batch}
         esc_batch = [
-            (node, g, si)
-            for node, g, si, plan in batch
-            if flags[g] & _F_ESC
+            (batch[i][0], batch[i][1], batch[i][2])
+            for i in sets.esc_batch_pos.tolist()
         ]
         # resident rows stepped only by routed traffic can escalate too:
         # discard their effects (the routed inputs are raft-safe to lose)
-        esc_other = [
-            g
-            for g, meta in self._meta.items()
-            if alive_np[g] and g not in batch_gs and flags[g] & _F_ESC
-        ]
+        esc_other = sets.esc_other.tolist()
         updates: List[Tuple] = []
         if esc_batch or esc_other:
             self.stats["escalations"] += len(esc_batch) + len(esc_other)
@@ -1386,66 +1470,47 @@ class ColocatedVectorEngine(VectorStepEngine):
                 u = node.step_with_inputs(si)
                 if u is not None:
                     updates.append((node, u))
-        esc_set = set(g for _, g, _ in esc_batch) | set(esc_other)
 
         # ---- live rows: batch rows + any resident row with effects ----
+        esc_keep = np.ones((len(batch),), bool)
+        esc_keep[sets.esc_batch_pos] = False
         live: List[Tuple] = [
             (node, g, si)
-            for node, g, si, plan in batch
-            if g not in esc_set
+            for (node, g, si, plan), k in zip(batch, esc_keep.tolist())
+            if k
         ]
-        for g, meta in self._meta.items():
-            if not alive_np[g] or g in batch_gs or g in esc_set:
-                continue
-            if flags[g] & _F_ANY_LIVE:
+        for g in sets.live_other.tolist():
+            meta = self._meta.get(g)
+            if meta is not None:
                 live.append((meta.node, g, None))
 
-        buf_rows = [g for _, g, _ in live if flags[g] & _F_COUNT]
-        append_rows = [g for _, g, _ in live if flags[g] & _F_APPEND]
-        slot_rows = [g for g in prop_rows if g not in esc_set]
-        need_rows = [g for _, g, _ in live if flags[g] & _F_NEED_SS]
-        slot_set = set(slot_rows)
-        # rows whose VALUES the merge loop reads: anything flagged or
-        # carrying proposal slots (the rest only tick)
-        sum_rows = [
-            g for _, g, _ in live
-            if (flags[g] & _F_ANY_LIVE) or g in slot_set
-        ]
+        buf_rows = sets.buf_rows
+        append_rows = sets.append_rows
+        slot_rows = sets.slot_rows
+        need_rows = sets.need_rows
+        sum_rows = sets.sum_rows
         _t0 = _time.perf_counter()
         # device-selected detail (the single-sync fast path): the blob
         # already carries detail/vals for the rows the DEVICE selected
         # with the same flag logic; verify the host's sets are covered
         # and fall back to an exact two-sync gather when not (capacity
-        # overflow, or a row the device's live approximation missed)
+        # overflow, or a row the device's live approximation missed).
+        # Coverage and row->gather-position maps are index arrays
+        # (hostplane.pos_of/covered) — the old per-row dict builds and
+        # `all(g in …)` membership scans were O(rows) Python per launch
         n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d = (
             int(x) for x in sel_counts
         )
-        dev_ok = (
-            n_buf_d <= caps["b"] and n_slot_d <= caps["sl"]
-            and n_need_d <= caps["n"] and n_append_d <= caps["a"]
-            and n_sum_d <= caps["s"]
+        cover = self._sel_cover(
+            G, caps,
+            (n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d),
+            (sel_rows_buf, sel_rows_slot, sel_rows_need,
+             sel_rows_append, sel_rows_sum),
+            sets,
         )
+        dev_ok = cover is not None
         if dev_ok:
-            buf_at = {
-                int(g): k for k, g in enumerate(sel_rows_buf[:n_buf_d])
-            }
-            slot_at = {
-                int(g): k for k, g in enumerate(sel_rows_slot[:n_slot_d])
-            }
-            need_at = {
-                int(g): k for k, g in enumerate(sel_rows_need[:n_need_d])
-            }
-            ring_at = {
-                int(g): k for k, g in enumerate(sel_rows_append[:n_append_d])
-            }
-            sum_at = {int(g): k for k, g in enumerate(sel_rows_sum[:n_sum_d])}
-            dev_ok = (
-                all(g in buf_at for g in buf_rows)
-                and all(g in slot_at for g in slot_rows)
-                and all(g in need_at for g in need_rows)
-                and all(g in ring_at for g in append_rows)
-                and all(g in sum_at for g in sum_rows)
-            )
+            pos_buf, pos_slot, pos_need, pos_ring, pos_sum, sum_src = cover
         if dev_ok:
             # live rows only: the padded capacity tail is garbage the
             # merge loop never indexes, and converting it cost tens of
@@ -1474,11 +1539,14 @@ class ColocatedVectorEngine(VectorStepEngine):
             self.stats["sel_fallbacks"] = (
                 self.stats.get("sel_fallbacks", 0) + 1
             )
-            idx4 = _build_idx4(buf_rows, slot_rows, need_rows, append_rows)
+            idx4 = _build_idx4(
+                buf_rows.tolist(), slot_rows.tolist(),
+                need_rows.tolist(), append_rows.tolist(),
+            )
             # the kernel ran on the ASSEMBLED inbox (host slots + routed
             # regions), so the out slot arrays are M + P*B wide
             detail, vals_np = _fetch_detail_vals(
-                merged, out, idx4, sum_rows, self._put,
+                merged, out, idx4, sum_rows.tolist(), self._put,
                 self.O, M + P * B, E, P, self.W, allow_fused=False,
             )
             if detail is not None:
@@ -1487,11 +1555,14 @@ class ColocatedVectorEngine(VectorStepEngine):
             else:
                 buf_np = slot_base = slot_term = ent_drop = need_np = None
                 ring_t = ring_c = None
-            buf_at = {g: k for k, g in enumerate(buf_rows)}
-            ring_at = {g: k for k, g in enumerate(append_rows)}
-            slot_at = {g: k for k, g in enumerate(slot_rows)}
-            need_at = {g: k for k, g in enumerate(need_rows)}
-            sum_at = {g: k for k, g in enumerate(sum_rows)}
+            # position maps over the HOST-ordered gather sections (the
+            # same order _build_idx4 packed them in)
+            pos_buf = hostplane.pos_of(G, buf_rows)
+            pos_ring = hostplane.pos_of(G, append_rows)
+            pos_slot = hostplane.pos_of(G, slot_rows)
+            pos_need = hostplane.pos_of(G, need_rows)
+            pos_sum = hostplane.pos_of(G, sum_rows)
+            sum_src = sum_rows
         # tier selection: promote immediately to the smallest warmed
         # tier that fits this launch's needs (overflow used the exact
         # fallback above, once); demote only after 64 consecutive
@@ -1529,9 +1600,68 @@ class ColocatedVectorEngine(VectorStepEngine):
         from .engine import SLOT_DROPPED
 
         _t0 = _time.perf_counter()
+        # ---- per-row effect merge, batch-indexed ---------------------
+        # Everything the loop used to look up per row (gather positions
+        # via the *_at dicts, flag probes, bases, delivered-bit unpack,
+        # limit checks, mirror writes) is gathered ONCE here over the
+        # [*, G] arrays; the residual per-row body below only mutates
+        # the Python raft objects it must (scalar sync, append merge,
+        # update construction) — see ops/hostplane.py.
+        gs_m = np.asarray([g for _, g, _ in live], np.int64)
+        n_live = len(gs_m)
+        if n_live:
+            sum_k = pos_sum[gs_m]
+            buf_k = pos_buf[gs_m]
+            slot_k = pos_slot[gs_m]
+            need_k = pos_need[gs_m]
+            ring_k = pos_ring[gs_m]
+            app_l = ((flags[gs_m] & _F_APPEND) != 0).tolist()
+            bases_l = self._base[gs_m].tolist()
+            sum_k_l = sum_k.tolist()
+            buf_k_l = buf_k.tolist()
+            slot_k_l = slot_k.tolist()
+            need_k_l = need_k.tolist()
+            ring_k_l = ring_k.tolist()
+            # delivered bits unpacked for ALL buf rows in one shot (the
+            # per-row word/shift unpack cost ~1-2 µs a row)
+            has_buf = buf_k >= 0
+            nb = int(has_buf.sum())
+            if nb:
+                bits = delivered_bits[gs_m[has_buf]]
+                dr_pack = (
+                    (bits[:, self._dw_word] >> self._dw_shift) & 1
+                ).astype(bool)
+                dr_at = np.full((n_live,), -1, np.int32)
+                dr_at[has_buf] = np.arange(nb, dtype=np.int32)
+                dr_at_l = dr_at.tolist()
+            # bulk mirror write for every row the loop will merge
+            # (rows it then skips — stopped/halted — are freed and
+            # re-seeded at their next upload, so the write is moot)
+            in_sum = sum_k >= 0
+            if vals_np is not None and in_sum.any():
+                self._mirror[:6, gs_m[in_sum]] = np.asarray(
+                    vals_np
+                )[sum_k[in_sum], :6].T
+        if vals_np is not None and len(sum_src):
+            # fast-lane invalidation, batch-wide: rows approaching an
+            # int32 lane limit or streaming a snapshot re-run the full
+            # plan (the only plan facts a DEVICE step can change;
+            # everything else arrives via the host queues, which the
+            # fast lane checks each launch).  Safe-side: clearing
+            # plan_ok for a row the loop later skips only forces one
+            # extra full plan.  (The fallback gather pads vals to a
+            # bucket; only the first len(sum_src) rows are real.)
+            v = np.asarray(vals_np)[: len(sum_src)]
+            over = (
+                (v[:, _R_TERM] > _LIM_SOFT) | (v[:, _R_LAST] > _LIM_SOFT)
+            )
+            if over.any():
+                self._lanes.plan_ok[np.asarray(sum_src)[over]] = False
+        if len(need_rows):
+            self._lanes.plan_ok[need_rows] = False
         # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
         snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
-        for node, g, si in live:
+        for j, (node, g, si) in enumerate(live):
             # a STOPPING node still merges and persists this launch's
             # results: its device acks were already routed to peers in
             # this very launch, and dropping the corresponding append
@@ -1545,42 +1675,30 @@ class ColocatedVectorEngine(VectorStepEngine):
             if node.stopped or self._meta.get(g) is None:
                 continue
             r = node.peer.raft
-            base = int(self._base[g])  # the shard's shared base
+            base = bases_l[j]  # the shard's shared base
             if si is not None:
                 _tick_bookkeeping(node, si.ticks + si.gc_ticks)
-            if g not in sum_at:
+            k = sum_k_l[j]
+            if k < 0:
                 # no flags, no slots: the row only ticked
                 continue
-            sv = vals_l[sum_at[g]]
+            sv = vals_l[k]
             term, vote, committed, leader, role, last = sv[:6]
-            # fast-lane invalidation: re-run the full plan when this
-            # row approaches an int32 lane limit or streams a snapshot
-            # (the only plan facts a DEVICE step can change; everything
-            # else arrives via the host queues, which the fast lane
-            # checks each launch)
-            if (
-                term > _LIM_SOFT
-                or last > _LIM_SOFT
-                or g in need_at
-            ):
-                _m = self._meta.get(g)
-                if _m is not None:
-                    _m.plan_ok = False
             committed += base
             last += base
-            appended = bool(flags[g] & _F_APPEND)
             # scalar sync BEFORE the merge: the noop-barrier-vs-lost-
             # payload distinction in _merge_appends needs the POST-step
             # role (a row that just won its election self-appends the
             # barrier; its host mirror still says candidate)
             r.term, r.vote, r.leader_id = term, vote, leader
             r.role = RaftRole(role)
-            if appended:
+            if app_l[j]:
                 try:
                     stamped = self._merge_appends(
                         r, g, int(sv[_R_APPEND_LO]) + base, last,
-                        staging.get(g, {}), slot_at, slot_base, slot_term,
-                        ent_drop, ring_t[ring_at[g]], ring_c[ring_at[g]],
+                        staging.get(g, {}), slot_k_l[j], slot_base,
+                        slot_term, ent_drop, ring_t[ring_k_l[j]],
+                        ring_c[ring_k_l[j]],
                         fallback=self._cache_lookup,
                         barrier=(
                             int(sv[_R_BARRIER_IDX]) + base,
@@ -1610,33 +1728,30 @@ class ColocatedVectorEngine(VectorStepEngine):
                 and node.device_reads.has_pending()
             ):
                 node.drop_device_reads()
-            if g in buf_at:
-                bits = delivered_bits[g]
-                dr = (
-                    (bits[self._dw_word] >> self._dw_shift) & 1
-                ).astype(bool)
+            if buf_k_l[j] >= 0:
                 self._attach_messages(
-                    r, node, buf_np[buf_at[g]], int(sv[_R_COUNT]),
-                    staging.get(g, {}), delivered_row=dr,
+                    r, node, buf_np[buf_k_l[j]], int(sv[_R_COUNT]),
+                    staging.get(g, {}), delivered_row=dr_pack[dr_at_l[j]],
                     base=base,
                 )
-            if g in slot_at:
-                sb = slot_base[slot_at[g]]
-                drop = ent_drop[slot_at[g]]
+            sk = slot_k_l[j]
+            if sk >= 0:
+                sb = slot_base[sk]
+                drop = ent_drop[sk]
                 for slot, ents in staging.get(g, {}).items():
                     if sb[slot] == SLOT_DROPPED:
                         r.dropped_entries.extend(ents)
                     elif sb[slot] >= 0:
                         r.dropped_entries.extend(
-                            e for j, e in enumerate(ents) if drop[slot, j]
+                            e for i_e, e in enumerate(ents)
+                            if drop[slot, i_e]
                         )
-            if g in need_at:
-                self._send_snapshots(r, g, need_np[need_at[g]],
+            if need_k_l[j] >= 0:
+                self._send_snapshots(r, g, need_np[need_k_l[j]],
                                      snapshot_sends)
             u = node.peer.get_update(last_applied=node.sm.last_applied)
             node.dispatch_dropped(u)
             updates.append((node, u))
-            self._mirror[:6, g] = sv[:6]
             node._check_leader_change()
         self.stats["t_updates_ms"] += int((_time.perf_counter() - _t0) * 1000)
 
@@ -1666,10 +1781,13 @@ class ColocatedVectorEngine(VectorStepEngine):
                     rm.become_snapshot(ss_index)
 
         if self._pending_live:
-            # in-flight routed traffic: wake every resident node's engine
-            # so some worker launches again and the messages are consumed
-            for meta in self._meta.values():
-                if not meta.dirty and meta.node.notify_work is not None:
+            # in-flight routed traffic: wake every ALIVE resident
+            # node's engine so some worker launches again and the
+            # messages are consumed (lane scan — the notify itself is
+            # per-node, but dirty rows no longer pay a Python probe)
+            for g in np.nonzero(self._lanes.alive_mask())[0].tolist():
+                meta = self._meta.get(g)
+                if meta is not None and meta.node.notify_work is not None:
                     meta.node.notify_work()
         return updates
 

@@ -52,6 +52,7 @@ from ..logger import get_logger
 from ..pb import Entry, EntryType, Message, MessageType, Snapshot
 from ..raft.raft import Raft, RaftRole
 from ..raft.remote import RemoteState
+from . import hostplane
 from . import kernel as K
 from . import sync as S
 from .types import (
@@ -88,13 +89,18 @@ N_VALS = 10
 # rows only: at 65k rows the old [12, G] summary + [G, O] delivered
 # readbacks were ~5 MB per launch, which on a remote-device link (the
 # TPU tunnel) costs tens of seconds — the flags word is 256 KB and the
-# steady-state gather is a few rows.
-_F_CHANGED, _F_COUNT, _F_APPEND, _F_NEED_SS, _F_ESC = 1, 2, 4, 8, 16
-# leader row with a peer lane still behind its log: quiesce entry is
-# blocked while set (see QuiesceManager.tick(busy=...)) — the scalar
-# remotes of a resident row are stale, so this must come off the device
-_F_PEERS_BEHIND = 32
-_F_ANY_LIVE = _F_CHANGED | _F_COUNT | _F_APPEND | _F_NEED_SS
+# steady-state gather is a few rows.  The bit values live in types.py
+# (shared with the vectorized host-plane machinery in ops/hostplane.py);
+# the `_F_*` aliases keep this module's historical spelling.
+from .types import (  # noqa: E402 — alias block, not a new dependency
+    F_CHANGED as _F_CHANGED,
+    F_COUNT as _F_COUNT,
+    F_APPEND as _F_APPEND,
+    F_NEED_SS as _F_NEED_SS,
+    F_ESC as _F_ESC,
+    F_PEERS_BEHIND as _F_PEERS_BEHIND,
+    F_ANY_LIVE as _F_ANY_LIVE,
+)
 
 
 def _bucket(n: int) -> int:
@@ -136,10 +142,11 @@ def _scatter_rows(state: DeviceState, pos, sub: DeviceState) -> DeviceState:
 
 def _pos_map(G: int, gs) -> np.ndarray:
     """Host-built [G] position map for _scatter_rows/_scatter_inbox_rows:
-    pos[g] = index into the sub batch, -1 elsewhere."""
-    pos = np.full((G,), -1, np.int32)
-    pos[np.asarray(gs, np.int64)] = np.arange(len(gs), dtype=np.int32)
-    return pos
+    pos[g] = index into the sub batch, -1 elsewhere.  ONE definition —
+    delegates to hostplane.pos_of, the same map the merge tail's
+    index-array machinery uses (review finding: two byte-equivalent
+    copies would drift)."""
+    return hostplane.pos_of(G, gs)
 
 
 @jax.jit
@@ -392,35 +399,66 @@ def _tick_bookkeeping(node, ticks: int) -> None:
 
 
 class _RowMeta:
-    __slots__ = ("node", "dirty", "esc_hold", "plan_ok")
+    """Per-row metadata view.  The TRUTH lives in the engine's
+    ``hostplane.RowLanes`` SoA arrays so the vectorized plan classifier
+    and merge stage read whole lanes at once; these properties keep the
+    scalar paths' field syntax (``meta.dirty = True`` etc.) working
+    unchanged.  Field semantics:
 
-    def __init__(self, node):
+    * dirty — the scalar Raft is authoritative and the device row is
+      stale (fresh rows, cold-stepped rows, escalated rows).
+    * plan_ok — the last FULL _plan_device pass for this row passed
+      every static eligibility check; while it holds (and the cheap
+      per-launch conditions — empty queues, clean row, no snapshot/
+      read state — are re-verified inline), the colocated fast tick
+      lane may skip the full classifier.  Invalidated by the events
+      that can change a static check: merge-loop snapshot sends,
+      int32-limit proximity, membership traffic (which arrives via
+      the queues and forces the full path anyway).
+    * esc_hold — steps to HOLD the row on the scalar path after an
+      escalation (set via set_escalation_hold so both engines share
+      the formula).  An escalation triggered by ROUTED-ONLY inputs
+      discards those inputs (raft-safe for SAFETY, not for liveness):
+      re-uploading immediately starves the scalar of the wire round
+      trip it needs to act — observed as an infinite probe->reject->
+      escalate loop when a resident leader's next_idx walked below its
+      ring window (r4 colocated chaos: a healed follower never caught
+      up; ~3k ESC_WINDOW escalations doing nothing).  A few held steps
+      let real wire traffic reach the scalar, which then probes from
+      the full authoritative log.
+    """
+
+    __slots__ = ("node", "_lanes", "_g")
+
+    def __init__(self, node, lanes, g: int):
         self.node = node
-        # dirty = the scalar Raft is authoritative and the device row is
-        # stale (fresh rows, cold-stepped rows, escalated rows)
-        self.dirty = True
-        # plan_ok = the last FULL _plan_device pass for this row passed
-        # every static eligibility check; while it holds (and the cheap
-        # per-launch conditions — empty queues, clean row, no snapshot/
-        # read state — are re-verified inline), the colocated fast tick
-        # lane may skip the full classifier.  Invalidated by the events
-        # that can change a static check: merge-loop snapshot sends,
-        # int32-limit proximity, membership traffic (which arrives via
-        # the queues and forces the full path anyway).
-        self.plan_ok = False
-        # steps to HOLD the row on the scalar path after an escalation.
-        # (set via set_escalation_hold so both engines share the
-        # formula.)
-        # An escalation triggered by ROUTED-ONLY inputs discards those
-        # inputs (raft-safe for SAFETY, not for liveness): re-uploading
-        # immediately starves the scalar of the wire round-trip it needs
-        # to act — observed as an infinite probe->reject->escalate loop
-        # when a resident leader's next_idx walked below its ring window
-        # (r4 colocated chaos: a healed follower never caught up; ~3k
-        # ESC_WINDOW escalations doing nothing).  A few held steps let
-        # real wire traffic reach the scalar, which then probes from the
-        # full authoritative log.
-        self.esc_hold = 0
+        self._lanes = lanes
+        self._g = g
+        lanes.reset_row(g, attached=True)
+
+    @property
+    def dirty(self) -> bool:
+        return bool(self._lanes.dirty[self._g])
+
+    @dirty.setter
+    def dirty(self, v: bool) -> None:
+        self._lanes.dirty[self._g] = v
+
+    @property
+    def plan_ok(self) -> bool:
+        return bool(self._lanes.plan_ok[self._g])
+
+    @plan_ok.setter
+    def plan_ok(self, v: bool) -> None:
+        self._lanes.plan_ok[self._g] = v
+
+    @property
+    def esc_hold(self) -> int:
+        return int(self._lanes.esc_hold[self._g])
+
+    @esc_hold.setter
+    def esc_hold(self, v: int) -> None:
+        self._lanes.esc_hold[self._g] = v
 
     def set_escalation_hold(self, config) -> None:
         self.esc_hold = max(4, 2 * config.heartbeat_rtt + 2)
@@ -485,6 +523,10 @@ class VectorStepEngine(IStepEngine):
         )
         self._row_of: Dict[int, int] = {}  # shard_id -> g
         self._meta: Dict[int, _RowMeta] = {}  # g -> meta
+        # SoA truth store behind every _RowMeta (ops/hostplane.py): the
+        # vectorized plan classifier and merge stage read these lanes
+        # array-at-once instead of probing per-row attributes
+        self._lanes = hostplane.RowLanes(capacity)
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         # per-row index base (the 64-bit story): the host log is 64-bit
         # throughout; device rows hold indexes REBASED by a per-row
@@ -630,6 +672,7 @@ class VectorStepEngine(IStepEngine):
             g = self._row_of.pop(shard_id, None)
             if g is not None:
                 self._meta.pop(g, None)
+                self._lanes.reset_row(g, attached=False)
                 self._free.append(g)
 
     def _halt_replica(self, g: int) -> None:
@@ -644,6 +687,7 @@ class VectorStepEngine(IStepEngine):
         self.stats["divergence_halts"] += 1
         self._row_of.pop(self._row_key(node), None)
         self._meta.pop(g, None)
+        self._lanes.reset_row(g, attached=False)
         self._free.append(g)
         node.stop()
 
@@ -705,7 +749,7 @@ class VectorStepEngine(IStepEngine):
             return None
         g = self._free.pop()
         self._row_of[self._row_key(node)] = g
-        self._meta[g] = _RowMeta(node)
+        self._meta[g] = _RowMeta(node, self._lanes, g)
         return g
 
     # ------------------------------------------------------------------
@@ -1417,7 +1461,7 @@ class VectorStepEngine(IStepEngine):
                     int(sv[_R_APPEND_LO]) + base,
                     last,
                     staging.get(g, {}),
-                    slot_at,
+                    slot_at.get(g, -1),
                     slot_base,
                     slot_term,
                     ent_drop,
@@ -1505,7 +1549,7 @@ class VectorStepEngine(IStepEngine):
         lo: int,
         last: int,
         stage: Dict[int, List[Entry]],
-        slot_at,
+        slot_idx: int,
         slot_base,
         slot_term,
         ent_drop,
@@ -1515,12 +1559,16 @@ class VectorStepEngine(IStepEngine):
         barrier: Optional[Tuple[int, int]] = None,
         base: int = 0,
     ) -> List[Entry]:
+        # ``slot_idx`` is the row's position in the gathered slot
+        # sections (-1 = the row carried no proposal slots) — an
+        # index-array lookup the callers batch-compute, replacing the
+        # old per-row `g in slot_at` dict probes (hostplane refactor)
         W = self.W
         # candidates[idx] = (slot_order, Entry, term); later slots win
         cand: Dict[int, List[Tuple[int, Entry, int]]] = {}
-        sb = slot_base[slot_at[g]] if g in slot_at else None
-        stm = slot_term[slot_at[g]] if g in slot_at else None
-        drop = ent_drop[slot_at[g]] if g in slot_at else None
+        sb = slot_base[slot_idx] if slot_idx >= 0 else None
+        stm = slot_term[slot_idx] if slot_idx >= 0 else None
+        drop = ent_drop[slot_idx] if slot_idx >= 0 else None
         for slot in sorted(stage):
             ents = stage[slot]
             if sb is not None and sb[slot] >= 0:

@@ -1,0 +1,398 @@
+"""Array-at-once host-plane machinery for the colocated launch path.
+
+The r5 ledger (docs/BENCH_NOTES_r05.md, Config 4) showed that at 250k
+replica rows the DEVICE plane costs ~4 s of a 2,731 s 50k-shard
+election while ``t_plan`` (887 s) and ``t_updates`` (538 s) — per-row
+Python in the colocated engine's plan and merge stages — dominate.
+This module is the fix: the per-row work that is pure *metadata math*
+(eligibility classification, merge row-set construction, coverage
+checks, index maps) runs as numpy array ops over ALL rows per
+generation instead of per-row attribute probes and dict builds.
+
+Three layers:
+
+* ``RowLanes`` — the SoA truth store for per-row engine metadata
+  (``attached``/``dirty``/``plan_ok``/``esc_hold``).  The per-row
+  ``_RowMeta`` objects in ``ops/engine.py`` are thin property views
+  over these lanes, so every existing scalar path keeps its field
+  syntax while the vectorized passes read whole lanes at once.
+
+* vectorized passes — ``classify_static`` (the batched plan
+  classifier's static-eligibility prefilter), ``build_merge_sets``
+  (the post-launch row sets: escalations, live rows, buf/append/
+  need/slot/sum), ``pos_of``/``covered`` (index-array replacements
+  for the old per-row ``*_at`` dict builds and ``all(g in …)``
+  membership scans).  These carry the ``# hostplane-hot`` marker:
+  raftlint's ``host-loop`` rule bans ``for``-over-rows inside them so
+  the vectorization cannot rot back into per-row Python.
+
+* scalar twins — ``classify_static_scalar`` / ``build_merge_sets_scalar``
+  replicate the pre-vectorization per-row logic verbatim.  They are
+  the PARITY ORACLE: with ``PARITY`` enabled (env
+  ``DRAGONBOAT_TPU_HOSTPLANE_PARITY=1``, or set directly by tests) the
+  colocated engine runs both implementations on every generation and
+  fail-stops on any divergence.  They also let ``bench.py
+  phase_hostplane`` measure the stage cost the vectorization removed.
+
+The scalar ``_plan_device`` classifier in ops/engine.py remains the
+slow-path fallback for rows that fail the static prefilter — exactly
+the contract the ``plan_ok`` fast tick lane (57 µs -> 5 µs) proved.
+Deliberately numpy-only: nothing here may touch jax — the host plane
+must never inject device syncs into the launch tail (that is the
+device plane's job, audited separately by analysis/jaxcheck).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, NamedTuple, Sequence
+
+import numpy as np
+
+from .types import F_ANY_LIVE, F_APPEND, F_COUNT, F_ESC, F_NEED_SS
+
+# parity mode: run the scalar twins beside every vectorized pass and
+# assert identical outputs (tests flip the module attribute directly;
+# the env var serves soak/CI runs).  Off by default — the twins are
+# O(rows) Python, the very cost this module exists to remove.
+PARITY = os.environ.get("DRAGONBOAT_TPU_HOSTPLANE_PARITY", "") == "1"
+
+
+class HostPlaneParityError(AssertionError):
+    """Vectorized and scalar host-plane passes disagreed (a bug in one
+    of them); the engine fail-stops the launch loudly rather than
+    letting the two decode paths diverge the cluster."""
+
+
+class RowLanes:
+    """SoA metadata lanes for device rows — the ``_RowMeta`` truth store.
+
+    One lane per static plan fact the classifier needs:
+
+    * ``attached`` — a ``_RowMeta`` exists for this row (set at attach,
+      cleared at detach/halt/release; ``attached & ~dirty`` is the
+      device-authoritative "alive" set the launch masks ride on).
+    * ``dirty`` — the scalar Raft is authoritative and the device row
+      is stale (fresh rows, cold-stepped rows, escalated rows).
+    * ``plan_ok`` — the last FULL ``_plan_device`` pass passed every
+      static eligibility check (the fast tick lane's proof).
+    * ``esc_hold`` — steps left to hold the row on the scalar path
+      after an escalation.
+
+    All writes happen under the engine's core lock (the same contract
+    the ``_RowMeta`` fields always had); the vectorized readers run
+    under that lock too.
+    """
+
+    __slots__ = ("attached", "dirty", "plan_ok", "esc_hold")
+
+    def __init__(self, capacity: int):
+        self.attached = np.zeros((capacity,), bool)
+        # rows start dirty: scalar-authoritative until the first upload
+        self.dirty = np.ones((capacity,), bool)
+        self.plan_ok = np.zeros((capacity,), bool)
+        self.esc_hold = np.zeros((capacity,), np.int64)
+
+    def reset_row(self, g: int, attached: bool) -> None:
+        """Fresh-row state (attach) or freed-row state (detach/halt)."""
+        self.attached[g] = attached
+        self.dirty[g] = True
+        self.plan_ok[g] = False
+        self.esc_hold[g] = 0
+
+    def alive_mask(self) -> np.ndarray:  # hostplane-hot
+        """The device-authoritative row set: attached and clean.  A
+        fresh [G] bool array (callers mutate it for per-generation
+        stopping corrections).  Replaces the old per-launch Python scan
+        over the whole ``_meta`` table (~0.5 µs/row — ~125 ms/launch at
+        250k rows)."""
+        return self.attached & ~self.dirty
+
+
+# ---------------------------------------------------------------------------
+# the batched plan classifier (static-eligibility prefilter)
+# ---------------------------------------------------------------------------
+def classify_static(lanes: RowLanes, gs: np.ndarray) -> np.ndarray:  # hostplane-hot
+    """[n] bool: rows whose last full-plan proof still stands.
+
+    ``gs`` is the per-node row-id array (-1 for unattached).  A True
+    lane means the row may take the fast tick lane PROVIDED the cheap
+    per-launch dynamic conditions (empty queues, no snapshot/read
+    state, save quarantine, stale binding) also hold — those live on
+    Python objects and are re-verified per row by the caller, exactly
+    as the fast lane always did.  A False lane routes the node to the
+    scalar ``_plan_device`` classifier (the slow-path oracle)."""
+    ok = gs >= 0
+    safe = np.where(ok, gs, 0)
+    return (
+        ok
+        & lanes.plan_ok[safe]
+        & ~lanes.dirty[safe]
+        & (lanes.esc_hold[safe] == 0)
+    )
+
+
+# raftlint: ignore[host-loop] parity oracle — the pre-vectorization per-row shape, kept for the harness
+def classify_static_scalar(lanes: RowLanes, gs: Sequence[int]) -> np.ndarray:
+    """Per-row twin of :func:`classify_static` (the r5 probe shape)."""
+    out = np.zeros((len(gs),), bool)
+    for i, g in enumerate(gs):
+        if g < 0:
+            continue
+        out[i] = (
+            bool(lanes.plan_ok[g])
+            and not bool(lanes.dirty[g])
+            and int(lanes.esc_hold[g]) == 0
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merge row sets (the post-launch tail's classification)
+# ---------------------------------------------------------------------------
+class MergeSets(NamedTuple):
+    """Row sets the merge stage consumes, as sorted int32 row-id arrays
+    (``esc_batch_pos`` is positions into the BATCH list, everything
+    else is device row ids).  Replaces the old per-row list/dict
+    comprehensions over the whole meta table."""
+
+    esc_batch_pos: np.ndarray  # batch positions whose row escalated
+    esc_other: np.ndarray      # alive non-batch rows that escalated
+    live_other: np.ndarray     # alive non-batch rows with any-live flags
+    buf_rows: np.ndarray       # live rows with host-visible outbox bytes
+    append_rows: np.ndarray    # live rows that ring-appended
+    slot_rows: np.ndarray      # non-escalated proposal-slot rows
+    need_rows: np.ndarray      # live rows with a peer needing a snapshot
+    sum_rows: np.ndarray       # live rows whose VALUES the merge reads
+
+
+def _mask_of(G: int, rows) -> np.ndarray:  # hostplane-hot
+    m = np.zeros((G,), bool)
+    if len(rows):
+        m[np.asarray(rows, np.int64)] = True
+    return m
+
+
+def build_merge_sets(  # hostplane-hot
+    flags: np.ndarray,
+    alive: np.ndarray,
+    batch_gs: np.ndarray,
+    prop_gs: np.ndarray,
+    *,
+    G: int,
+) -> MergeSets:
+    """Vectorized merge-row classification for one launch.
+
+    Inputs: the [G] int32 flags word (types.F_*), the [G] bool alive
+    mask (attached & clean, with this generation's stopping rows
+    cleared), the batch row ids in batch order, and the proposal-slot
+    row ids.  Mirrors the scalar semantics bit for bit (the parity
+    harness holds both to it):
+
+    * escalated batch rows replay on the scalar path; escalated ALIVE
+      non-batch rows (stepped only by routed traffic) just discard
+      their device effects;
+    * live = batch rows + alive resident rows with any-live flags,
+      minus escalations;
+    * buf/append/need sets are flag-gated subsets of live; slot rows
+      are the non-escalated proposal rows; sum rows are live rows with
+      any-live flags or proposal slots (the rest only ticked).
+    """
+    batch_mask = _mask_of(G, batch_gs)
+    prop_mask = _mask_of(G, prop_gs)
+    esc = (flags & F_ESC) != 0
+    anylive = (flags & F_ANY_LIVE) != 0
+    esc_batch_pos = np.nonzero(esc[batch_gs])[0].astype(np.int32) if len(
+        batch_gs
+    ) else np.zeros((0,), np.int32)
+    esc_other = np.nonzero(alive & ~batch_mask & esc)[0].astype(np.int32)
+    live_mask = ~esc & (batch_mask | (alive & ~batch_mask & anylive))
+    slot_mask = prop_mask & ~esc  # prop rows ride the batch; esc drops them
+    i32 = np.int32
+    return MergeSets(
+        esc_batch_pos=esc_batch_pos,
+        esc_other=esc_other,
+        live_other=np.nonzero(live_mask & ~batch_mask)[0].astype(i32),
+        buf_rows=np.nonzero(live_mask & ((flags & F_COUNT) != 0))[0].astype(i32),
+        append_rows=np.nonzero(live_mask & ((flags & F_APPEND) != 0))[0].astype(i32),
+        slot_rows=np.nonzero(slot_mask)[0].astype(i32),
+        need_rows=np.nonzero(live_mask & ((flags & F_NEED_SS) != 0))[0].astype(i32),
+        sum_rows=np.nonzero(live_mask & (anylive | slot_mask))[0].astype(i32),
+    )
+
+
+# raftlint: ignore[host-loop] parity oracle — replicates the r5 per-row loops verbatim for the harness
+def build_merge_sets_scalar(
+    flags: Sequence[int],
+    alive: Sequence[bool],
+    batch_gs: Sequence[int],
+    prop_gs: Sequence[int],
+    *,
+    G: int,
+) -> MergeSets:
+    """Per-row twin of :func:`build_merge_sets` — the exact loop shapes
+    the colocated merge tail ran before vectorization (flag probes per
+    row, membership via Python sets), with outputs sorted into the
+    canonical MergeSets form for comparison."""
+    flags = list(flags)
+    batch_set = set(int(g) for g in batch_gs)
+    esc_batch_pos = [
+        i for i, g in enumerate(batch_gs) if flags[int(g)] & F_ESC
+    ]
+    esc_other = [
+        g for g in range(G)
+        if alive[g] and g not in batch_set and flags[g] & F_ESC
+    ]
+    esc_set = {int(batch_gs[i]) for i in esc_batch_pos} | set(esc_other)
+    live = [int(g) for g in batch_gs if int(g) not in esc_set]
+    for g in range(G):
+        if (
+            alive[g]
+            and g not in batch_set
+            and g not in esc_set
+            and flags[g] & F_ANY_LIVE
+        ):
+            live.append(g)
+    slot_rows = [int(g) for g in prop_gs if int(g) not in esc_set]
+    slot_set = set(slot_rows)
+    buf_rows = [g for g in live if flags[g] & F_COUNT]
+    append_rows = [g for g in live if flags[g] & F_APPEND]
+    need_rows = [g for g in live if flags[g] & F_NEED_SS]
+    sum_rows = [
+        g for g in live if (flags[g] & F_ANY_LIVE) or g in slot_set
+    ]
+    live_other = [g for g in live if g not in batch_set]
+    srt = lambda xs: np.asarray(sorted(xs), np.int32)  # noqa: E731
+    return MergeSets(
+        esc_batch_pos=np.asarray(sorted(esc_batch_pos), np.int32),
+        esc_other=srt(esc_other),
+        live_other=srt(live_other),
+        buf_rows=srt(buf_rows),
+        append_rows=srt(append_rows),
+        slot_rows=srt(slot_rows),
+        need_rows=srt(need_rows),
+        sum_rows=srt(sum_rows),
+    )
+
+
+# ---------------------------------------------------------------------------
+# index maps (the *_at dict replacements)
+# ---------------------------------------------------------------------------
+def pos_of(G: int, rows: np.ndarray) -> np.ndarray:  # hostplane-hot
+    """[G] int32 position map: pos[g] = index of g in ``rows``, -1
+    elsewhere — the index-array replacement for the per-row
+    ``{g: k for k, g in enumerate(rows)}`` dict builds."""
+    pos = np.full((G,), -1, np.int32)
+    n = len(rows)
+    if n:
+        pos[np.asarray(rows, np.int64)] = np.arange(n, dtype=np.int32)
+    return pos
+
+
+def covered(pos: np.ndarray, rows: np.ndarray) -> bool:  # hostplane-hot
+    """Every row of ``rows`` has a position in ``pos`` — the
+    index-array replacement for ``all(g in at for g in rows)``."""
+    if not len(rows):
+        return True
+    return bool((pos[np.asarray(rows, np.int64)] >= 0).all())
+
+
+# ---------------------------------------------------------------------------
+# parity harness
+# ---------------------------------------------------------------------------
+def _diff(name: str, a: np.ndarray, b: np.ndarray) -> str:
+    return (
+        f"{name}: vectorized {np.asarray(a).tolist()[:32]} != "
+        f"scalar {np.asarray(b).tolist()[:32]}"
+    )
+
+
+def assert_classify_parity(lanes: RowLanes, gs: Sequence[int],
+                           vec: np.ndarray) -> None:
+    ref = classify_static_scalar(lanes, list(gs))
+    if not np.array_equal(np.asarray(vec, bool), ref):
+        raise HostPlaneParityError(_diff("classify_static", vec, ref))
+
+
+def assert_merge_parity(
+    flags: np.ndarray,
+    alive: np.ndarray,
+    batch_gs: np.ndarray,
+    prop_gs: np.ndarray,
+    vec: MergeSets,
+    *,
+    G: int,
+) -> None:
+    """Run the scalar oracle on the same launch inputs and compare
+    every set (vectorized outputs sorted first — the oracle's canonical
+    form).  Raises :class:`HostPlaneParityError` naming the first
+    diverging set."""
+    ref = build_merge_sets_scalar(
+        np.asarray(flags).tolist(),
+        np.asarray(alive, bool).tolist(),
+        list(np.asarray(batch_gs).tolist()),
+        list(np.asarray(prop_gs).tolist()),
+        G=G,
+    )
+    for name in MergeSets._fields:
+        got = np.sort(np.asarray(getattr(vec, name)))
+        want = np.asarray(getattr(ref, name))
+        if not np.array_equal(got, want):
+            raise HostPlaneParityError(_diff(name, got, want))
+
+
+# parity failures observed by the in-engine checker (check_* wrappers):
+# the engine must not crash a live launch mid-merge over a checker
+# finding, so the wrappers record + log instead of raising — tests and
+# soaks gate on PARITY_FAILURE_COUNT == 0 / the list being empty.  The
+# list keeps only the first _FAILURE_CAP diffs (a multi-day soak with
+# a persistent divergence appends per launch — an unbounded list would
+# OOM the soak long before anyone reads it); the counter is exact.
+PARITY_FAILURES: List[str] = []
+PARITY_FAILURE_COUNT = 0
+_FAILURE_CAP = 256
+
+
+def _record_failure(e: Exception) -> None:  # pragma: no cover - bug path
+    global PARITY_FAILURE_COUNT
+    PARITY_FAILURE_COUNT += 1
+    if len(PARITY_FAILURES) < _FAILURE_CAP:
+        PARITY_FAILURES.append(str(e))
+
+
+def check_classify_parity(lanes: RowLanes, gs, vec) -> None:
+    try:
+        assert_classify_parity(lanes, gs, vec)
+    except HostPlaneParityError as e:  # pragma: no cover - bug path
+        _record_failure(e)
+
+
+def check_merge_parity(flags, alive, batch_gs, prop_gs, vec, *, G) -> None:
+    try:
+        assert_merge_parity(flags, alive, batch_gs, prop_gs, vec, G=G)
+    except HostPlaneParityError as e:  # pragma: no cover - bug path
+        _record_failure(e)
+
+
+# recorded generation traces (parity satellite): with ``RECORD`` on,
+# the colocated engine appends one entry per launch so tests can replay
+# scalar-vs-vectorized over REAL generation inputs (elections,
+# escalations, membership churn) rather than only fabricated ones.
+RECORD = False
+TRACE: List[dict] = []
+_TRACE_CAP = 512
+
+
+def record_generation(flags, alive, batch_gs, prop_gs, G: int) -> None:
+    if not RECORD:
+        return
+    TRACE.append(
+        dict(
+            flags=np.array(flags, np.int64, copy=True),
+            alive=np.array(alive, bool, copy=True),
+            batch_gs=np.array(batch_gs, np.int64, copy=True),
+            prop_gs=np.array(prop_gs, np.int64, copy=True),
+            G=G,
+        )
+    )
+    if len(TRACE) > _TRACE_CAP:
+        del TRACE[: len(TRACE) - _TRACE_CAP]

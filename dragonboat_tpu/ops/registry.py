@@ -17,6 +17,14 @@ Canonical geometry: every dimension is given a DISTINCT size so the
 auditor can identify axes by size alone (the G-last rule finds the G
 axis as "the axis of size CANON['G']"); G is the only size that may
 appear in a batched array, so keep the others unique and small.
+
+Scope note (r6): ``ops/hostplane.py`` — the array-at-once host-plane
+machinery — is deliberately numpy-only and carries NO jitted entry
+points, so it registers nothing here; the auditor's
+``unregistered-jit`` AST scan covers it like every other ops/ module,
+and any future ``@jax.jit`` added there must be registered or the
+scan fails.  Its per-row discipline is enforced separately by
+raftlint's ``host-loop`` rule (docs/ANALYSIS.md).
 """
 from __future__ import annotations
 

@@ -107,6 +107,19 @@ SLOT_UNUSED = -3      # slot was not a PROPOSE / row escalated
 SLOT_FORWARDED = -2   # follower forwarded the proposal to the leader
 SLOT_DROPPED = -1     # proposal dropped (no leader / transfer in flight)
 
+# per-row flag bits of the post-step flags-word readback (the ONLY
+# full-width [G] readback a launch performs — see engine._summarize_flags).
+# Defined HERE (not in engine.py) because three layers consume them:
+# the device-side summarize program, the host merge stage, and the
+# array-at-once host-plane machinery in ops/hostplane.py — one
+# definition keeps the device readback and the vectorized host decode
+# from ever disagreeing on a bit.
+F_CHANGED, F_COUNT, F_APPEND, F_NEED_SS, F_ESC = 1, 2, 4, 8, 16
+# leader row with a peer lane still behind its log: quiesce entry is
+# blocked while set (the scalar remotes of a resident row are stale)
+F_PEERS_BEHIND = 32
+F_ANY_LIVE = F_CHANGED | F_COUNT | F_APPEND | F_NEED_SS
+
 
 class DeviceState(NamedTuple):
     """SoA mirror of one scalar ``Raft`` per row.

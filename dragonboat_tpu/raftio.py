@@ -204,9 +204,13 @@ class BalanceMoveInfo:
     """One rebalancing move transition (balance/ control plane).
 
     ``step`` is the move state the transition refers to: ``plan``,
-    ``add``, ``catchup``, ``transfer``, ``remove``, ``rollback``.
-    ``src``/``dst`` are host keys (raft addresses); for pure leadership
-    transfers ``replica_id`` is the transfer target.
+    ``add``, ``catchup``, ``catchup_progress``, ``transfer``,
+    ``remove``, ``rollback``.  ``src``/``dst`` are host keys (raft
+    addresses); for pure leadership transfers ``replica_id`` is the
+    transfer target.  ``detail`` carries step-specific context — for
+    ``catchup_progress`` the live ``snapshot_stream_*`` numbers
+    (bytes moved, resume count, ETA) so operators watching move events
+    see TRANSFER progress instead of a blind applied-index poll.
     """
 
     shard_id: int
@@ -215,6 +219,7 @@ class BalanceMoveInfo:
     dst: str
     replica_id: int
     step: str = ""
+    detail: str = ""
 
 
 class IRaftEventListener(abc.ABC):

@@ -374,6 +374,13 @@ class Transport:
             self._stream_throttled_base += self.snapshot_pacer.throttled_seconds
             self.snapshot_pacer = None
 
+    def active_stream_jobs(self) -> int:
+        """Snapshot stream jobs currently in flight (the
+        snapshot_stream_active gauge source; public accessor so
+        consumers like the balance executor's catchup progress report
+        don't reach into the private counter)."""
+        return self._stream_jobs
+
     def stream_throttled_seconds(self) -> float:
         """Cumulative cap-induced sleep across ALL buckets this
         transport ever ran (the snapshot_stream_throttle_seconds_total

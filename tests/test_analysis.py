@@ -446,6 +446,124 @@ def test_host_sync_real_tree_suppression_is_live():
 
 
 # ---------------------------------------------------------------------------
+# host-loop (the colocated host plane: ops/colocated.py, ops/hostplane.py)
+# ---------------------------------------------------------------------------
+HOST_LOOP_SRC = '''
+import numpy as np
+
+def build_sets(flags, rows):  # hostplane-hot
+    out = []
+    for g in rows:
+        out.append(flags[g])
+    at = {int(g): k for k, g in enumerate(rows)}
+    ok = all(g in at for g in rows)
+    return out, at, ok
+
+def vectorized(flags, rows):  # hostplane-hot
+    pos = np.full((flags.shape[0],), -1, np.int32)
+    pos[rows] = np.arange(len(rows), dtype=np.int32)
+    return pos
+
+def unmarked_helper(rows):
+    return [g for g in rows]
+
+# raftlint: ignore is NOT needed on unmarked functions; the def-line
+# form below documents a scalar fallback inside the hot discipline
+def oracle(flags, rows):  # hostplane-hot  # raftlint: ignore[host-loop] documented scalar fallback (parity oracle)
+    return [flags[g] for g in rows]
+'''
+
+
+def test_host_loop_catches_for_over_rows():
+    fs = lint_source(HOST_LOOP_SRC, "dragonboat_tpu/ops/colocated.py")
+    # the for loop, the dict comprehension, and the all(...) generator
+    assert rules_of(fs) == {"host-loop"} and len(fs) == 3, fs
+    flagged = [HOST_LOOP_SRC.splitlines()[f.line - 1] for f in fs]
+    assert any("for g in rows:" in ln for ln in flagged), flagged
+    assert any("enumerate(rows)" in ln for ln in flagged), flagged
+    assert any("all(" in ln for ln in flagged), flagged
+
+
+def test_host_loop_scoped_to_hostplane_modules_and_marked_funcs():
+    # other modules are out of scope; unmarked functions may loop
+    assert lint_source(HOST_LOOP_SRC, "dragonboat_tpu/obs/trace.py") == []
+    unmarked = HOST_LOOP_SRC.replace("  # hostplane-hot", "")
+    assert lint_source(unmarked, "dragonboat_tpu/ops/hostplane.py") == []
+
+
+def test_host_loop_def_line_ignore_exempts_function():
+    # the `oracle` function above loops but carries the def-line ignore
+    fs = lint_source(HOST_LOOP_SRC, "dragonboat_tpu/ops/hostplane.py")
+    lines = {f.line for f in fs}
+    oracle_line = next(
+        i + 1
+        for i, ln in enumerate(HOST_LOOP_SRC.splitlines())
+        if "def oracle" in ln
+    )
+    assert oracle_line + 1 not in lines
+
+
+def test_host_loop_ignore_above_def_line_exempts_function():
+    """The ignore-next-line style works on defs too (the real tree's
+    scalar-oracle comments sit above the def)."""
+    src = (
+        "# raftlint: ignore[host-loop] documented parity oracle\n"
+        "def twin(rows):  # hostplane-hot\n"
+        "    return [g for g in rows]\n"
+    )
+    assert lint_source(src, "dragonboat_tpu/ops/hostplane.py") == []
+    stripped = src.replace("# raftlint: ignore[host-loop]", "# nope")
+    fs = lint_source(stripped, "dragonboat_tpu/ops/hostplane.py")
+    assert rules_of(fs) == {"host-loop"}
+
+
+def test_host_loop_point_suppression():
+    src = HOST_LOOP_SRC.replace(
+        "    for g in rows:",
+        "    # raftlint: ignore[host-loop] boundary loop: per-node dict lookups\n"
+        "    for g in rows:",
+        1,
+    )
+    fs = lint_source(src, "dragonboat_tpu/ops/colocated.py")
+    assert len(fs) == 2 and rules_of(fs) == {"host-loop"}
+
+
+def test_host_loop_real_tree_annotation_is_live():
+    """hostplane.build_merge_sets carries the # hostplane-hot marker; a
+    for-over-rows seeded into its body must surface — the real tree's
+    annotation is live, not decorative."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/hostplane.py")
+    src = open(path).read()
+    assert "# hostplane-hot" in src
+    assert lint_source(src, "dragonboat_tpu/ops/hostplane.py") == []
+    needle = "    batch_mask = _mask_of(G, batch_gs)"
+    assert needle in src
+    seeded = src.replace(
+        needle,
+        "    junk = [int(f) for f in flags]\n" + needle,
+        1,
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/ops/hostplane.py")
+    assert any(f.rule == "host-loop" for f in fs)
+
+
+def test_host_loop_real_tree_colocated_annotation_is_live():
+    """The colocated _sel_cover coverage check is annotated; seeding a
+    per-row membership scan into it must surface."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/colocated.py")
+    src = open(path).read()
+    needle = "        rows_buf, rows_slot, rows_need, rows_append, rows_sum = sel_rows"
+    assert needle in src
+    seeded = src.replace(
+        needle,
+        needle + "\n        junk = {int(g): 1 for g in rows_buf}",
+        1,
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/ops/colocated.py")
+    assert any(f.rule == "host-loop" for f in fs)
+
+
+# ---------------------------------------------------------------------------
 # hygiene: import-hot, bare-except, thread-discipline
 # ---------------------------------------------------------------------------
 def test_import_hot_flags_function_level_imports_in_hot_modules():

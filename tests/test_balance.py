@@ -447,6 +447,87 @@ class TestExecutorSequencing:
         assert hosts["s1"].leader[0] == 2
 
 
+class _FakeStreamTransport:
+    """Just the snapshot_stream_* surface the executor samples."""
+
+    def __init__(self):
+        self.metrics = {"stream_bytes": 0, "stream_resumes": 0}
+        self._stream_jobs = 0
+
+
+class _MoveEventLog:
+    """Records every balance_move_* callback with its info."""
+
+    def __init__(self):
+        self.events = []
+
+    def __getattr__(self, name):
+        if not name.startswith("balance_move"):
+            raise AttributeError(name)
+
+        def record(info):
+            self.events.append((name, info))
+
+        return record
+
+
+class TestCatchupStreamProgress:
+    def test_move_report_carries_stream_progress_and_eta(self):
+        """ROADMAP 5b: the catchup leg must surface snapshot_stream_*
+        progress (bytes, resume count, ETA) in its move report and in
+        rate-limited catchup_progress events — not just poll applied
+        indexes blindly."""
+        hosts, log, members, view, ex = stub_world(leader_rid=2)
+        evlog = _MoveEventLog()
+        ex.events = evlog
+        ex.progress_interval = 0.0  # emit every poll in the test
+        for h in hosts.values():
+            h.transport = _FakeStreamTransport()
+        # the joiner "streams" its snapshot: every catchup poll of the
+        # destination moves bytes on the sender (s2 drives the API)
+        dst = hosts["s4"]
+        orig_stats = dst.balance_shard_stats
+
+        def stats_with_traffic():
+            tr = hosts["s2"].transport
+            tr.metrics["stream_bytes"] += 4096
+            if tr.metrics["stream_resumes"] == 0:
+                tr.metrics["stream_resumes"] = 1  # one mid-move resume
+            return orig_stats()
+
+        dst.balance_shard_stats = stats_with_traffic
+        ex.execute(Move(kind="replace", shard_id=1, src_host="s1",
+                        src_replica_id=1, dst_host="s4", new_replica_id=4),
+                   view)
+        report = ex.last_move_report["catchup"]
+        assert report["snapshot_stream_bytes"] >= 4096
+        assert report["snapshot_stream_resumes"] == 1
+        assert report["snapshot_stream_active"] == 0
+        assert report["applied"] == report["target"] == 10
+        assert "eta_seconds" in report
+        steps = [
+            info for name, info in evlog.events
+            if name == "balance_move_step"
+            and info.step == "catchup_progress"
+        ]
+        assert steps, [n for n, _ in evlog.events]
+        assert any("stream_bytes=" in s.detail and "resumes=1" in s.detail
+                   for s in steps), steps[-1].detail
+        # the report survives the move for post-hoc inspection
+        assert ex.last_move_report["kind"] == "replace"
+
+    def test_hosts_without_transports_report_zeros(self):
+        """Test doubles / closed hosts contribute zeros — the report
+        never breaks the move over missing observability."""
+        hosts, log, members, view, ex = stub_world(leader_rid=2)
+        ex.execute(Move(kind="replace", shard_id=1, src_host="s1",
+                        src_replica_id=1, dst_host="s4", new_replica_id=4),
+                   view)
+        report = ex.last_move_report["catchup"]
+        assert report["snapshot_stream_bytes"] == 0
+        assert report["snapshot_stream_resumes"] == 0
+
+
 class TestEventFanoutForwarding:
     def test_system_events_reach_the_listener(self):
         """Regression (balance verify finding): EventFanout used to

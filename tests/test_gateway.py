@@ -636,3 +636,168 @@ class TestOverload:
             assert gw.admission.depth(1) == 0  # nothing charged
         finally:
             close_all(nhs, gw)
+
+
+# ---------------------------------------------------------------------------
+# snapshot-cap feedback auto-wiring (ROADMAP 5a)
+# ---------------------------------------------------------------------------
+class _CapFakeHost:
+    """A NodeHost stand-in with only what the cap wiring touches: a
+    transport carrying a shared snapshot pacer behind the
+    ``set_snapshot_send_rate`` runtime knob.  No event fanout — the
+    gateway tolerates tap failures (routes via discovery)."""
+
+    class _T:
+        def __init__(self, rate):
+            from dragonboat_tpu.bigstate.pacing import TokenBucket
+
+            self.max_snapshot_send_rate = rate or 0
+            self.snapshot_pacer = TokenBucket(rate) if rate else None
+
+        def set_snapshot_send_rate(self, rate):
+            from dragonboat_tpu.bigstate.pacing import TokenBucket
+
+            self.max_snapshot_send_rate = rate
+            if rate > 0:
+                if self.snapshot_pacer is None:
+                    self.snapshot_pacer = TokenBucket(rate)
+                else:
+                    self.snapshot_pacer.set_rate(rate)
+            else:
+                self.snapshot_pacer = None
+
+    def __init__(self, rate):
+        self.transport = self._T(rate)
+
+    def set_snapshot_send_rate(self, rate):
+        self.transport.set_snapshot_send_rate(rate)
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+class TestCapFeedbackWiring:
+    def test_degraded_commit_latency_shrinks_the_cap(self):
+        """A host with a configured stream cap, fronted by a gateway
+        whose LatencyBudget observes degraded commits, gets its cap
+        shrunk automatically; healthy latency recovers it (AIMD)."""
+        budget = LatencyBudget(bootstrap=0.01, floor=0.001)
+        host = _CapFakeHost(rate=1_000_000.0)
+        gw = Gateway(
+            {"h1": host},
+            GatewayConfig(
+                budget=budget,
+                cap_feedback_target_p99=0.05,
+                cap_feedback_interval=0.02,
+            ),
+        )
+        try:
+            pacer = host.transport.snapshot_pacer
+            # the loop binds lazily from the feedback thread (the
+            # runtime knob may configure caps long after attach)
+            assert _wait_for(lambda: "h1" in gw.cap_feedback_stats())
+            for _ in range(32):
+                budget.observe(0.5)  # p99 way over the 50ms target
+            assert _wait_for(lambda: pacer.rate < 1_000_000.0), (
+                "cap never shrank"
+            )
+            st = gw.cap_feedback_stats()["h1"]
+            assert st["adjustments"] >= 1 and st["base_rate"] == 1_000_000.0
+            # healthy again: flush the degraded samples out of the
+            # budget's sliding window so p99 actually drops, then the
+            # loop recovers toward (and caps at) base
+            for _ in range(600):
+                budget.observe(0.001)
+            low = pacer.rate
+            assert _wait_for(lambda: pacer.rate > low), "cap never recovered"
+        finally:
+            gw.close()
+
+    def test_close_restores_the_configured_cap(self):
+        """A cap shrunk by the AIMD loop must not outlive the gateway
+        at the floor: close() hands the host its configured base back
+        (the host outlives the gateway; nothing else would grow it)."""
+        budget = LatencyBudget(bootstrap=0.01, floor=0.001)
+        host = _CapFakeHost(rate=1_000_000.0)
+        gw = Gateway(
+            {"h1": host},
+            GatewayConfig(
+                budget=budget, cap_feedback_target_p99=0.05,
+                cap_feedback_interval=0.02,
+            ),
+        )
+        try:
+            pacer = host.transport.snapshot_pacer
+            for _ in range(32):
+                budget.observe(0.5)
+            assert _wait_for(lambda: pacer.rate < 1_000_000.0)
+        finally:
+            gw.close()
+        assert host.transport.snapshot_pacer.rate == 1_000_000.0
+
+    def test_late_configured_cap_and_runtime_retune(self):
+        """The runtime knob works END TO END: a cap configured AFTER
+        attach gains a loop automatically, and raising the configured
+        base moves the AIMD ceiling instead of being clamped back to
+        the stale attach-time base (review findings)."""
+        budget = LatencyBudget(bootstrap=0.01, floor=0.001)
+        host = _CapFakeHost(rate=None)  # no cap at attach time
+        gw = Gateway(
+            {"h1": host},
+            GatewayConfig(
+                budget=budget, cap_feedback_target_p99=0.05,
+                cap_feedback_interval=0.02,
+            ),
+        )
+        try:
+            assert gw.cap_feedback_stats() == {}
+            host.set_snapshot_send_rate(1_000_000.0)  # operator knob
+            assert _wait_for(lambda: "h1" in gw.cap_feedback_stats())
+            # raise the configured base: the loop must track it, and
+            # with healthy p99 the rate may grow PAST the old base
+            host.set_snapshot_send_rate(2_000_000.0)
+            assert _wait_for(
+                lambda: gw.cap_feedback_stats().get("h1", {}).get(
+                    "base_rate"
+                ) == 2_000_000.0
+            )
+            # remove the cap: the loop retires instead of ticking an
+            # orphaned bucket
+            host.set_snapshot_send_rate(0)
+            assert _wait_for(lambda: gw.cap_feedback_stats() == {})
+        finally:
+            gw.close()
+
+    def test_opt_out_and_capless_hosts(self):
+        """cap_feedback=False attaches no loop; a host without a
+        configured cap (pacer None) never gets one invented for it."""
+        host = _CapFakeHost(rate=8_000_000.0)
+        gw = Gateway({"h1": host}, GatewayConfig(cap_feedback=False))
+        try:
+            assert gw.cap_feedback_stats() == {}
+            assert gw._cap_thread is None
+        finally:
+            gw.close()
+        capless = _CapFakeHost(rate=None)
+        gw2 = Gateway({"h1": capless}, GatewayConfig())
+        try:
+            assert gw2.cap_feedback_stats() == {}
+            assert capless.transport.snapshot_pacer is None
+        finally:
+            gw2.close()
+
+    def test_remove_host_drops_its_loop(self):
+        host = _CapFakeHost(rate=1_000_000.0)
+        gw = Gateway({"h1": host}, GatewayConfig(cap_feedback_interval=0.05))
+        try:
+            assert _wait_for(lambda: "h1" in gw.cap_feedback_stats())
+            gw.remove_host("h1")
+            assert gw.cap_feedback_stats() == {}
+        finally:
+            gw.close()

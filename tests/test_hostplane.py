@@ -1,0 +1,302 @@
+"""Scalar-vs-vectorized host-plane parity (the r6 vectorization).
+
+The colocated engine's plan classifier and merge row-set machinery now
+run as numpy array ops over all rows per generation
+(dragonboat_tpu/ops/hostplane.py); the pre-vectorization per-row loops
+survive as the PARITY ORACLE.  These tests hold the two
+implementations to byte-identical outputs over:
+
+* fabricated generation traces — randomized flag/alive/batch/prop
+  mixes (seeded), crafted escalation rows, proposal rows, and the
+  all-false-mask no-op invariant;
+* RECORDED generation traces from a LIVE colocated cluster running an
+  election, proposals, nemesis-forced kernel escalations and a
+  membership change, with the in-engine parity checker armed the whole
+  time (DRAGONBOAT_TPU_HOSTPLANE_PARITY's test-side twin).
+
+jaxcheck note: ops/hostplane.py is deliberately numpy-only (no jitted
+entry points), so the device-plane audit surface is unchanged — the
+empty-baseline gate is covered by tests/test_jaxcheck.py's
+zero-unbaselined tree test.
+"""
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from dragonboat_tpu.ops import hostplane as hp
+from dragonboat_tpu.ops.types import (
+    F_ANY_LIVE,
+    F_APPEND,
+    F_CHANGED,
+    F_COUNT,
+    F_ESC,
+    F_NEED_SS,
+)
+
+
+def _random_trace(rng, G):
+    """One fabricated generation: realistic flag mixes, alive subset,
+    batch subset, prop rows ⊆ batch (the engine invariant — prop rows
+    are collected from the batch's encode pass)."""
+    flags = np.zeros((G,), np.int64)
+    for bit, p in (
+        (F_CHANGED, 0.5),
+        (F_COUNT, 0.2),
+        (F_APPEND, 0.15),
+        (F_NEED_SS, 0.05),
+        (F_ESC, 0.08),
+    ):
+        flags |= np.where(rng.random(G) < p, bit, 0)
+    alive = rng.random(G) < 0.9
+    batch_gs = np.nonzero(rng.random(G) < 0.6)[0].astype(np.int64)
+    if len(batch_gs):
+        prop_gs = batch_gs[rng.random(len(batch_gs)) < 0.2]
+    else:
+        prop_gs = np.zeros((0,), np.int64)
+    return flags, alive, batch_gs, prop_gs
+
+
+class TestFabricatedTraces:
+    def test_randomized_parity(self):
+        rng = np.random.default_rng(1234)
+        for G in (8, 64, 257):
+            for _ in range(25):
+                flags, alive, batch, prop = _random_trace(rng, G)
+                sets = hp.build_merge_sets(flags, alive, batch, prop, G=G)
+                hp.assert_merge_parity(flags, alive, batch, prop, sets, G=G)
+
+    def test_escalation_rows(self):
+        """Escalated batch rows split from escalated routed-only rows,
+        and both leave every other set."""
+        G = 16
+        flags = np.zeros((G,), np.int64)
+        flags[2] = F_ESC | F_APPEND | F_COUNT  # escalated batch row
+        flags[7] = F_ESC | F_CHANGED           # escalated alive non-batch
+        flags[9] = F_ESC                       # escalated but NOT alive
+        flags[3] = F_APPEND
+        alive = np.zeros((G,), bool)
+        alive[[3, 7, 9]] = True
+        alive[9] = False
+        batch = np.asarray([2, 3, 4], np.int64)
+        prop = np.asarray([2, 4], np.int64)
+        sets = hp.build_merge_sets(flags, alive, batch, prop, G=G)
+        hp.assert_merge_parity(flags, alive, batch, prop, sets, G=G)
+        assert sets.esc_batch_pos.tolist() == [0]      # batch pos of g=2
+        assert sets.esc_other.tolist() == [7]          # not 9: dead row
+        assert 2 not in sets.slot_rows.tolist()        # esc drops slots
+        assert sets.slot_rows.tolist() == [4]
+        assert 2 not in sets.sum_rows.tolist()
+        assert sets.append_rows.tolist() == [3]
+
+    def test_all_false_mask_is_noop(self):
+        """The no-op invariant: zero flags, nothing alive, empty batch
+        -> every set empty (a generation that did nothing must merge
+        nothing)."""
+        G = 32
+        sets = hp.build_merge_sets(
+            np.zeros((G,), np.int64), np.zeros((G,), bool),
+            np.zeros((0,), np.int64), np.zeros((0,), np.int64), G=G,
+        )
+        hp.assert_merge_parity(
+            np.zeros((G,), np.int64), np.zeros((G,), bool),
+            np.zeros((0,), np.int64), np.zeros((0,), np.int64), sets, G=G,
+        )
+        for name in sets._fields:
+            assert len(getattr(sets, name)) == 0, name
+
+    def test_tick_only_batch_rows_stay_out_of_sum(self):
+        """Batch rows with zero flags are live (tick bookkeeping) but
+        carry no values to merge — they must not enter sum_rows."""
+        G = 8
+        flags = np.zeros((G,), np.int64)
+        alive = np.ones((G,), bool)
+        batch = np.asarray([1, 2], np.int64)
+        sets = hp.build_merge_sets(
+            flags, alive, batch, np.zeros((0,), np.int64), G=G
+        )
+        hp.assert_merge_parity(
+            flags, alive, batch, np.zeros((0,), np.int64), sets, G=G
+        )
+        assert sets.sum_rows.tolist() == []
+        assert sets.live_other.tolist() == []
+
+    def test_parity_error_names_the_diverging_set(self):
+        G = 8
+        flags = np.zeros((G,), np.int64)
+        flags[1] = F_COUNT | F_CHANGED
+        alive = np.ones((G,), bool)
+        batch = np.asarray([1], np.int64)
+        sets = hp.build_merge_sets(
+            flags, alive, batch, np.zeros((0,), np.int64), G=G
+        )
+        bad = sets._replace(buf_rows=np.asarray([3], np.int32))
+        with pytest.raises(hp.HostPlaneParityError, match="buf_rows"):
+            hp.assert_merge_parity(
+                flags, alive, batch, np.zeros((0,), np.int64), bad, G=G
+            )
+
+
+class TestClassify:
+    def test_lane_parity_and_unattached(self):
+        lanes = hp.RowLanes(16)
+        lanes.attached[:8] = True
+        lanes.dirty[:6] = False
+        lanes.plan_ok[[0, 1, 4]] = True
+        lanes.esc_hold[1] = 3
+        gs = np.asarray([0, 1, 2, 4, 6, -1, 15], np.int64)
+        vec = hp.classify_static(lanes, gs)
+        hp.assert_classify_parity(lanes, gs.tolist(), vec)
+        # 0: ok; 1: esc_hold; 2: no plan_ok; 4: ok; 6: dirty; -1:
+        # unattached; 15: dirty default
+        assert vec.tolist() == [True, False, False, True, False, False,
+                                False]
+
+    def test_reset_row_clears_the_proof(self):
+        lanes = hp.RowLanes(4)
+        lanes.attached[2] = True
+        lanes.dirty[2] = False
+        lanes.plan_ok[2] = True
+        lanes.reset_row(2, attached=False)
+        assert not hp.classify_static(lanes, np.asarray([2]))[0]
+        assert lanes.dirty[2] and not lanes.plan_ok[2]
+        assert not lanes.alive_mask()[2]
+
+
+class TestIndexMaps:
+    def test_pos_of_and_covered(self):
+        pos = hp.pos_of(8, np.asarray([5, 2, 7], np.int64))
+        assert pos.tolist() == [-1, -1, 1, -1, -1, 0, -1, 2]
+        assert hp.covered(pos, np.asarray([2, 5]))
+        assert not hp.covered(pos, np.asarray([2, 3]))
+        assert hp.covered(pos, np.zeros((0,), np.int64))  # empty set
+
+    def test_pos_of_empty(self):
+        assert (hp.pos_of(4, np.zeros((0,), np.int64)) == -1).all()
+
+
+class TestLiveClusterParity:
+    """Recorded-generation parity over a REAL colocated cluster: the
+    in-engine checker (check_*_parity) runs on every launch while the
+    cluster elects, commits proposals, survives nemesis-forced kernel
+    escalations and applies a membership change; afterwards the
+    recorded traces replay through both implementations once more."""
+
+    def test_election_proposals_escalations_membership(self):
+        import test_chaos_colocated as tcc
+        from dragonboat_tpu import Fault
+        from test_nodehost import set_cmd, wait_for_leader
+
+        old_parity, old_record = hp.PARITY, hp.RECORD
+        hp.PARITY = True
+        hp.RECORD = True
+        hp.PARITY_FAILURES.clear()
+        hp.TRACE.clear()
+        cluster = tcc.ColocatedCluster(seed=99)
+
+        def propose(i):
+            for nh in cluster.nhs.values():
+                try:
+                    s = nh.get_noop_session(1)
+                    nh.sync_propose(
+                        s, set_cmd(f"k{i}", f"v{i}".encode()), timeout=5.0
+                    )
+                    return
+                except Exception:  # noqa: BLE001 — try the next host
+                    continue
+
+        try:
+            wait_for_leader(cluster.nhs)
+            # committed traffic through the device path
+            for i in range(10):
+                propose(i)
+            # nemesis-forced escalations: the colocated engine consumes
+            # them at PLAN time (forced scalar excursions), exercising
+            # the classifier's slow path under churn
+            cluster.nemesis.install_engine(cluster.group.core)
+            f = cluster.nemesis.activate(Fault("escalate", targets=(1,), p=0.3))
+            for i in range(10, 25):
+                propose(i)
+            cluster.nemesis.deactivate(f)
+            assert cluster.nemesis.stats.get("engine_escalations", 0) > 0, (
+                "escalation lane never exercised"
+            )
+            # REAL kernel escalations (F_ESC in a launch): partition a
+            # follower, commit past the W=8 ring window, heal — the
+            # leader's below-ring replicate escalates (ESC_WINDOW)
+            cluster.partition([3])
+            for i in range(100, 120):
+                propose(i)
+            cluster.heal()
+            deadline = time.time() + 20.0
+            while time.time() < deadline:
+                if cluster.stats().get("escalations", 0) > 0:
+                    break
+                propose(int(time.time() * 1000) % 10**6 + 1000)
+                time.sleep(0.05)
+            # membership change: forces host-path rows (evictions +
+            # re-uploads) through the classifier's slow path
+            lead_nh = None
+            for nh in cluster.nhs.values():
+                lid, ok = nh.get_leader_id(1)
+                if ok and lid:
+                    lead_nh = nh
+                    break
+            assert lead_nh is not None
+            try:
+                lead_nh.sync_request_add_replica(
+                    1, 9, "colo-chaos-1", timeout=10.0
+                )
+            except Exception:  # noqa: BLE001 — churny add may time out;
+                pass  # the classifier exercise happened regardless
+            for i in range(25, 30):
+                propose(i)
+            time.sleep(0.5)
+            st = cluster.stats()
+            assert st.get("launches", 0) > 0
+            assert hp.PARITY_FAILURES == [], hp.PARITY_FAILURES[:3]
+            # replay the recorded generations through both paths
+            traces = list(hp.TRACE)
+            assert len(traces) >= 10, "too few generations recorded"
+            exercised_esc = False
+            for t in traces:
+                sets = hp.build_merge_sets(
+                    t["flags"], t["alive"], t["batch_gs"], t["prop_gs"],
+                    G=t["G"],
+                )
+                hp.assert_merge_parity(
+                    t["flags"], t["alive"], t["batch_gs"], t["prop_gs"],
+                    sets, G=t["G"],
+                )
+                if len(sets.esc_batch_pos) or len(sets.esc_other):
+                    exercised_esc = True
+            if not exercised_esc:
+                # timing didn't surface a real ESC launch in the ring
+                # buffer: perturb recorded traces instead (set F_ESC on
+                # a live row) so the replay still covers the
+                # escalation lanes against REAL generation shapes
+                for t in traces[-8:]:
+                    flags = t["flags"].copy()
+                    rows = (
+                        t["batch_gs"]
+                        if len(t["batch_gs"])
+                        else np.nonzero(t["alive"])[0]
+                    )
+                    if not len(rows):
+                        continue
+                    flags[rows[0]] |= F_ESC
+                    sets = hp.build_merge_sets(
+                        flags, t["alive"], t["batch_gs"], t["prop_gs"],
+                        G=t["G"],
+                    )
+                    hp.assert_merge_parity(
+                        flags, t["alive"], t["batch_gs"], t["prop_gs"],
+                        sets, G=t["G"],
+                    )
+                    exercised_esc = True
+            assert exercised_esc, "escalation lanes never replayed"
+        finally:
+            hp.PARITY, hp.RECORD = old_parity, old_record
+            hp.TRACE.clear()
+            cluster.close()
