@@ -1049,6 +1049,334 @@ def phase_hostplane(rows_list=None, launches: int = 6) -> dict:
     return {"tiers": tiers, "parity": True}
 
 
+def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
+    """Serial vs double-buffered colocated launch loop under the
+    simulated-tunnel sync-latency shim (ROADMAP item 2 / ISSUE 11).
+
+    The r5 sync-latency model: every device->host sync on the TPU
+    tunnel costs ~100-214 ms of round-trip latency regardless of size,
+    and sequential syncs do not pipeline — so the serial launch loop's
+    generation time is floor-bound and probe p50 was stuck at ~3.5 s at
+    1,000 shards.  The pipelined loop (ops/colocated.py, depth 2)
+    requests the readback at dispatch and collects it one generation
+    later, overlapping the floor with the next launch's upload/dispatch
+    and completing commit-proving rows from the head blob before the
+    detail merge.
+
+    This phase makes that measurable WITHOUT hardware: the
+    ``sync_floor_ms`` engine knob (env ``DRAGONBOAT_TPU_SYNC_FLOOR_MS``
+    for production runs) delays every blob collect until <floor> ms
+    after its D2H request, which is exactly the tunnel's observed
+    behavior.  For each floor in ``BENCH_PIPELINE_FLOORS`` (default
+    0,10,100 ms) it boots the same colocated 3-replica cluster once per
+    depth in ``BENCH_PIPELINE_DEPTHS`` (default "1,2": the serial r6
+    loop vs the double-buffered default; add 3 for the deep sweep) — and drives
+    pipelined proposers plus a serial sync-propose probe, reporting
+    committed proposals/sec, probe p50 and the engine's overlap/early-
+    completion counters.  Headline: ``speedup_at_floor`` and
+    ``probe_p50_ratio`` at the highest floor (the 100 ms tunnel model;
+    targets >=1.7x and <=0.5x per ISSUE 11).  ``BENCH_PIPELINE_SHARDS``
+    scales the fleet (default 16; the ROADMAP target geometry is 1000).
+    """
+    import shutil
+    import sys
+    import threading
+    import time as _time
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dragonboat_tpu import (
+        Config,
+        EngineConfig,
+        ExpertConfig,
+        NodeHost,
+        NodeHostConfig,
+    )
+    from dragonboat_tpu.ops import hostplane
+    from dragonboat_tpu.ops.colocated import ColocatedEngineGroup
+    from dragonboat_tpu.storage.tan import tan_logdb_factory
+    from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+    if SHARDS is None:
+        SHARDS = int(os.environ.get("BENCH_PIPELINE_SHARDS", "16"))
+    if duration is None:
+        duration = float(os.environ.get("BENCH_PIPELINE_SECS", "6"))
+    floors = [
+        float(x)
+        for x in os.environ.get(
+            "BENCH_PIPELINE_FLOORS", "0,10,100"
+        ).split(",")
+    ]
+    depths = [
+        int(x)
+        for x in os.environ.get("BENCH_PIPELINE_DEPTHS", "1,2").split(",")
+    ]
+    REPLICAS = 3
+    workers_n = int(os.environ.get("BENCH_PIPELINE_WORKERS", "4"))
+    inflight = int(os.environ.get("BENCH_PIPELINE_INFLIGHT", "8"))
+    probe_secs = float(os.environ.get("BENCH_PIPELINE_PROBE_SECS", "4"))
+    payload = b"x" * 16
+
+    def run_config(depth: int, floor_ms: float) -> dict:
+        tag = f"{depth}-{int(floor_ms)}"
+        ADDRS = {r: f"pipe-nh-{tag}-{r}" for r in range(1, REPLICAS + 1)}
+        cap = 1
+        while cap < SHARDS * REPLICAS:
+            cap <<= 1
+        reset_inproc_network()
+        group = ColocatedEngineGroup(
+            capacity=cap, P=3, W=16, M=8, E=4, O=32, budget=4,
+            pipeline_depth=depth, sync_floor_ms=floor_ms,
+        )
+        nhs = {}
+        for rid, addr in ADDRS.items():
+            shutil.rmtree(f"/tmp/nh-pipe-{tag}-{rid}", ignore_errors=True)
+            nhs[rid] = NodeHost(
+                NodeHostConfig(
+                    nodehost_dir=f"/tmp/nh-pipe-{tag}-{rid}",
+                    rtt_millisecond=20,
+                    raft_address=addr,
+                    expert=ExpertConfig(
+                        engine=EngineConfig(exec_shards=1, apply_shards=4),
+                        step_engine_factory=group.factory,
+                        logdb_factory=tan_logdb_factory,
+                    ),
+                )
+            )
+        out = {"depth": depth, "floor_ms": floor_ms, "shards": SHARDS}
+        sm_cls = _bench_sm_cls()
+        # per-config parity delta: the module counter is cumulative
+        # across the matrix's configs (review finding)
+        parity0 = hostplane.PARITY_FAILURE_COUNT
+        try:
+            for nh in nhs.values():
+                nh.pause_ticks()
+            for shard in range(1, SHARDS + 1):
+                for rid, nh in nhs.items():
+                    nh.start_replica(
+                        ADDRS, False, sm_cls,
+                        Config(replica_id=rid, shard_id=shard,
+                               election_rtt=20, heartbeat_rtt=2,
+                               pre_vote=True, check_quorum=True,
+                               snapshot_entries=0),
+                    )
+            for nh in nhs.values():
+                nh.resume_ticks()
+            t0 = _time.time()
+            covered = 0
+            while _time.time() - t0 < max(120.0, SHARDS * 0.2):
+                covered = sum(
+                    1 for s in range(1, SHARDS + 1)
+                    if nhs[1]._nodes[s].peer.raft.log.committed >= 1
+                )
+                if covered == SHARDS:
+                    break
+                _time.sleep(0.25)
+            out["election_secs"] = round(_time.time() - t0, 1)
+            out["leader_coverage"] = covered
+
+            stop = _time.time() + duration
+            counts = [0] * workers_n
+            errors = [0] * workers_n
+
+            def worker(w):
+                my = list(range(1 + w, SHARDS + 1, workers_n))
+                nh = nhs[1 + (w % REPLICAS)]
+                sessions = {s: nh.get_noop_session(s) for s in my}
+                pending = []
+                done = 0
+                while _time.time() < stop:
+                    still = []
+                    for rs, s in pending:
+                        if rs._event.is_set():
+                            if rs.code == 1:
+                                done += 1
+                            else:
+                                errors[w] += 1
+                        else:
+                            still.append((rs, s))
+                    pending = still
+                    by_shard = {}
+                    for _rs, s in pending:
+                        by_shard[s] = by_shard.get(s, 0) + 1
+                    for s in my:
+                        while by_shard.get(s, 0) < inflight:
+                            try:
+                                rs = nh.propose(sessions[s], payload, 30.0)
+                            except Exception:  # noqa: BLE001
+                                errors[w] += 1
+                                break
+                            pending.append((rs, s))
+                            by_shard[s] = by_shard.get(s, 0) + 1
+                    _time.sleep(0.001)
+                    counts[w] = done
+                drain_end = _time.time() + 15.0
+                while pending and _time.time() < drain_end:
+                    pending = [
+                        (rs, s) for rs, s in pending
+                        if not rs._event.is_set()
+                    ]
+                    _time.sleep(0.01)
+                counts[w] = done
+
+            # cycle-exact probe: serial sync proposals under ambient
+            # load — each sample a true submit->commit round trip.
+            # Targets are shards LED by the probing host: a forwarded
+            # proposal pays 2-3 extra transport-hop generations that
+            # measure routing, not the launch pipeline (phase_c's
+            # fixed-target probe includes that cost; this one isolates
+            # the propose->commit launch chain the floor model covers).
+            def _probe_targets():
+                nh = nhs[1]
+                led = [
+                    s for s in range(1, SHARDS + 1)
+                    if nh.is_leader_of(s)
+                ][:3]
+                return led or [1, max(1, SHARDS // 2), SHARDS]
+
+            probe_ms = []
+
+            def prober():
+                nh = nhs[1]
+                targets = _probe_targets()
+                sess = {s: nh.get_noop_session(s) for s in targets}
+                i = 0
+                while _time.time() < stop:
+                    s = targets[i % len(targets)]
+                    i += 1
+                    t1 = _time.time()
+                    try:
+                        nh.sync_propose(sess[s], payload, timeout=30.0)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    probe_ms.append((_time.time() - t1) * 1000.0)
+
+            threads = [
+                threading.Thread(target=worker, args=(w,), daemon=True,
+                                 name=f"bench-pipe-worker-{w}")
+                for w in range(workers_n)
+            ] + [threading.Thread(target=prober, daemon=True,
+                                  name="bench-pipe-probe")]
+            t0 = _time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=duration + 60.0)
+            # rate denominator is the LOAD WINDOW only: counts freeze
+            # at `stop`, and the tail-drain/join time varies with the
+            # config's backlog (the serial floor-bound config drains
+            # longest), which would deflate its rate asymmetrically
+            # (review finding)
+            dt = max(stop - t0, 1e-9)
+            committed = sum(counts)
+            probe_ms.sort()
+
+            # ---- unloaded probe window: serial sync proposals with NO
+            # ambient workers.  On a saturated host core, loaded-probe
+            # latency is dominated by CPU contention in BOTH configs
+            # and hides the pipeline's latency signal; this window
+            # isolates the launch pipeline's propose->commit path (the
+            # number the sync-latency model predicts).
+            quiet_ms = []
+            qstop = _time.time() + probe_secs
+            nh1 = nhs[1]
+            qtargets = _probe_targets()
+            qsess = {s: nh1.get_noop_session(s) for s in qtargets}
+            qi = 0
+            while _time.time() < qstop:
+                s = qtargets[qi % len(qtargets)]
+                qi += 1
+                t1 = _time.time()
+                try:
+                    nh1.sync_propose(qsess[s], payload, timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    continue
+                quiet_ms.append((_time.time() - t1) * 1000.0)
+            quiet_ms.sort()
+
+            st = group.core.stats
+            out.update(
+                committed_per_sec=round(committed / dt, 1),
+                committed=committed,
+                errors=sum(errors),
+                probe_p50_ms=(
+                    round(probe_ms[len(probe_ms) // 2], 1)
+                    if probe_ms else None
+                ),
+                probe_n=len(probe_ms),
+                probe_unloaded_p50_ms=(
+                    round(quiet_ms[len(quiet_ms) // 2], 1)
+                    if quiet_ms else None
+                ),
+                probe_unloaded_n=len(quiet_ms),
+                launches=st.get("launches", 0),
+                overlap_s=round(st.get("pipeline_overlap_s", 0.0), 3),
+                early_completions=st.get("early_completions", 0),
+                detail_skipped=st.get("detail_skipped", 0),
+                fences=st.get("pipeline_fences", 0),
+                sel_fallbacks=st.get("sel_fallbacks", 0),
+                parity_failures=hostplane.PARITY_FAILURE_COUNT - parity0,
+            )
+        finally:
+            for nh in nhs.values():
+                try:
+                    nh.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        return out
+
+    report = {
+        "shards": SHARDS, "replicas": REPLICAS,
+        "secs_per_config": duration, "configs": [],
+    }
+    for floor in floors:
+        for depth in depths:
+            try:
+                report["configs"].append(run_config(depth, floor))
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                report["configs"].append(
+                    {"depth": depth, "floor_ms": floor, "error": str(e)}
+                )
+    by = {
+        (c.get("depth"), c.get("floor_ms")): c for c in report["configs"]
+    }
+    fmax = max(floors)
+    s = by.get((1, fmax))
+    headline = {}
+    for depth in depths:
+        if depth == 1:
+            continue
+        p = by.get((depth, fmax))
+        if not (s and p and s.get("committed_per_sec")
+                and p.get("committed_per_sec")):
+            continue
+        h = {
+            "speedup": round(
+                p["committed_per_sec"]
+                / max(s["committed_per_sec"], 1e-9), 2
+            )
+        }
+        for key, name in (
+            ("probe_p50_ms", "probe_p50_ratio"),
+            ("probe_unloaded_p50_ms", "probe_unloaded_p50_ratio"),
+        ):
+            if s.get(key) and p.get(key):
+                h[name] = round(p[key] / s[key], 2)
+        headline[str(depth)] = h
+    if headline:
+        report["floor_headline_ms"] = fmax
+        report["headline_by_depth"] = headline
+        # the product default (depth 2) keeps the flat headline keys;
+        # loaded and unloaded probe ratios are DIFFERENT measurements
+        # and keep their own names (review finding)
+        h2 = headline.get("2") or next(iter(headline.values()))
+        report["speedup_at_floor"] = h2.get("speedup")
+        report["probe_p50_ratio"] = h2.get("probe_p50_ratio")
+        report["probe_unloaded_p50_ratio"] = h2.get(
+            "probe_unloaded_p50_ratio"
+        )
+    return report
+
+
 def phase_balance(
     shards: int = 16,
     hosts: int = 4,
@@ -1598,7 +1926,8 @@ def main() -> None:
     # valid result.
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
-             gateway=None, bigstate=None, hostplane=None) -> None:
+             gateway=None, bigstate=None, hostplane=None,
+             pipeline=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -1646,6 +1975,11 @@ def main() -> None:
                     # plan/merge stage wall time per rows tier — the
                     # r6 ledgers track t_plan/t_updates through this)
                     "hostplane": hostplane,
+                    # r13 schema addition: launch-pipeline guard
+                    # (ops/colocated.py double-buffered generations;
+                    # serial-vs-depth-2 committed/sec + probe p50 at
+                    # simulated sync floors — docs/BENCH_NOTES_r07.md)
+                    "pipeline": pipeline,
                 }
             ),
             flush=True,
@@ -1871,6 +2205,22 @@ def main() -> None:
             hpb = {"error": hp_err or "failed"}
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb, hpb)
+
+    # Launch-pipeline guard: serial vs double-buffered colocated loop
+    # under the simulated-tunnel sync floor (BENCH_PIPELINE gate)
+    ppb = None
+    if bool(int(os.environ.get("BENCH_PIPELINE", "1"))) and remaining() > 150:
+        code = (
+            "import jax, json, bench;"
+            "print('BENCHPP ' + json.dumps(bench.phase_pipeline(jax)))"
+        )
+        ppb, pp_err = run_sub(
+            code, "BENCHPP", max(150, min(600, int(remaining() - 30)))
+        )
+        if ppb is None:
+            ppb = {"error": pp_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb, ppb)
 
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is

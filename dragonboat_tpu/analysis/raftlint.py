@@ -76,6 +76,22 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     the documented scalar fallbacks and parity oracles (``*_scalar``
     twins in ops/hostplane.py).
 
+``sync-budget``
+    In the colocated launch path (``ops/colocated.py``,
+    ``ops/engine.py``), a function whose ``def`` line carries a
+    ``# sync-hot`` comment is a declared member of the launch
+    pipeline's sync budget: every device->host round trip there costs
+    ~100-214 ms of tunnel latency regardless of size and sequential
+    syncs do not pipeline (docs/BENCH_NOTES_r05.md), so the budget is
+    ONE commit-proving readback per generation (the split head/detail
+    blob, requested at dispatch and collected at merge).  Bare
+    ``np.asarray(<device value>)``, ``jax.device_get(...)`` and
+    zero-arg ``.item()`` are banned inside such functions; the
+    sanctioned readbacks (the blob collect, the documented fallback
+    two-sync gather, debug-gated probes) carry a point
+    ``# raftlint: ignore[sync-budget] <reason>``, as do host-built
+    numpy conversions that never touch a device value.
+
 ``stream-read``
     The snapshot streaming path (``transport/chunk.py``,
     ``storage/snapshotter.py``, ``storage/snapshotio.py``,
@@ -165,6 +181,14 @@ HOSTPLANE_MODULES = (
     "dragonboat_tpu/ops/hostplane.py",
 )
 HOSTPLANE_HOT_RE = re.compile(r"#\s*hostplane-hot\b")
+
+# the colocated launch path: `# sync-hot` functions live inside the
+# one-readback-per-generation sync budget (docs/BENCH_NOTES_r07.md)
+SYNC_BUDGET_MODULES = (
+    "dragonboat_tpu/ops/colocated.py",
+    "dragonboat_tpu/ops/engine.py",
+)
+SYNC_HOT_RE = re.compile(r"#\s*sync-hot\b")
 
 # attributes whose read is a static (trace-time, host-free) fact
 _STATIC_FACT_ATTRS = {"shape", "ndim", "size", "dtype"}
@@ -272,11 +296,15 @@ class _Linter(ast.NodeVisitor):
         self.check_hostplane = _module_matches(
             self.relpath, HOSTPLANE_MODULES
         )
-        # count of enclosing `# gateway-hot` / `# hostplane-hot`
-        # functions (nested defs inside a hot function inherit the
-        # discipline)
+        self.check_sync_budget = _module_matches(
+            self.relpath, SYNC_BUDGET_MODULES
+        )
+        # count of enclosing `# gateway-hot` / `# hostplane-hot` /
+        # `# sync-hot` functions (nested defs inside a hot function
+        # inherit the discipline)
         self._hot_depth = 0
         self._hp_depth = 0
+        self._sync_depth = 0
         # file-wide guarded fields: attr -> (lock attr, defining func node)
         self.guarded: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
         # module-level struct.Struct assignments: name -> Q slot indices
@@ -423,6 +451,11 @@ class _Linter(ast.NodeVisitor):
         )
         if hp:
             self._hp_depth += 1
+        sh = self.check_sync_budget and bool(
+            SYNC_HOT_RE.search(self._line(node.lineno))
+        )
+        if sh:
+            self._sync_depth += 1
         self._func_stack.append(node)
         try:
             self.generic_visit(node)
@@ -434,6 +467,8 @@ class _Linter(ast.NodeVisitor):
                 self._hot_depth -= 1
             if hp:
                 self._hp_depth -= 1
+            if sh:
+                self._sync_depth -= 1
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_func(node)
@@ -540,6 +575,8 @@ class _Linter(ast.NodeVisitor):
             self._check_host_sync(node)
         if self.check_stream_read:
             self._check_stream_read(node)
+        if self._sync_depth:
+            self._check_sync_budget(node)
         self._check_thread(node)
         self.generic_visit(node)
 
@@ -675,6 +712,44 @@ class _Linter(ast.NodeVisitor):
             node.lineno,
             hit + " (~100-214 ms per sync on a remote link; "
             "docs/BENCH_NOTES_r05.md)",
+        )
+
+    def _check_sync_budget(self, node: ast.Call) -> None:
+        """Bare device->host syncs inside a `# sync-hot` function (the
+        colocated launch pipeline's one-readback-per-generation
+        budget).  Each stray sync is ~100-214 ms of tunnel latency that
+        defeats the double-buffered overlap — docs/BENCH_NOTES_r07.md."""
+        f = node.func
+        hit = None
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("asarray", "array")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _NUMPY_ALIASES
+        ):
+            hit = (
+                f"bare np.{f.attr}(...) in the launch pipeline — a"
+                " potential device readback outside the blob sync"
+            )
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr == "device_get"
+        ):
+            hit = "jax.device_get(...) outside the annotated blob readback"
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr == "item"
+            and not node.args
+        ):
+            hit = ".item() forces an extra device->host round trip"
+        if hit is None or self._func_exempt("sync-budget"):
+            return
+        self._emit(
+            "sync-budget",
+            node.lineno,
+            hit + " (~100-214 ms per sync on the tunnel; the launch "
+            "budget is ONE commit-proving readback per generation — "
+            "docs/BENCH_NOTES_r05.md sync-latency model)",
         )
 
     def _check_stream_read(self, node: ast.Call) -> None:

@@ -73,10 +73,12 @@ from .engine import (
     _R_APPEND_LO,
     _R_BARRIER_IDX,
     _R_BARRIER_TERM,
+    _R_COMMIT,
     _R_COUNT,
     _R_LEADER,
     _R_ROLE,
     _R_TERM,
+    _R_VOTE,
     _R_LAST,
     _bucket,
     _place_rows,
@@ -103,13 +105,38 @@ from .types import (
     SLOT_UNUSED as SLOT_UNUSED_I,
     Inbox,
     make_inbox,
+    make_state,
 )
+from ..metrics import global_registry as _metrics
 
 _log = get_logger("engine")
 
 import os as _os
 
 _DEBUG_LAUNCH = _os.environ.get("COLOC_DEBUG_LAUNCH", "") == "1"
+
+# -- double-buffered generations (the launch pipeline) -----------------
+# DRAGONBOAT_TPU_PIPELINE_DEPTH: how many generations may be in flight
+# at once.  2 (the default) double-buffers: while generation N's blob
+# readback is in flight, generation N+1 assembles, uploads and
+# dispatches — the donated-buffer program chain permits it, and on the
+# remote-device tunnel (every sync ~100-214 ms of round-trip latency,
+# docs/BENCH_NOTES_r05.md) the readback overlaps the next launch's
+# host work so sync count stops being the unit of product-path
+# latency.  1 = the serial r5/r6 loop (dispatch, sync, merge, repeat).
+_PIPE_DEPTH_DEFAULT = int(
+    _os.environ.get("DRAGONBOAT_TPU_PIPELINE_DEPTH", "2") or 2
+)
+# DRAGONBOAT_TPU_SYNC_FLOOR_MS: simulated-tunnel sync latency shim — a
+# readback's data is not considered landed until <floor> ms after the
+# D2H copy was REQUESTED (copy_to_host_async).  Models the r5 tunnel
+# finding on CPU: the floor is round-trip latency, paid from request to
+# data regardless of size, and requests issued early (at dispatch)
+# collect late for free — which is exactly what the pipeline exploits
+# and what `bench.py phase_pipeline` measures without hardware.
+_SYNC_FLOOR_MS_DEFAULT = float(
+    _os.environ.get("DRAGONBOAT_TPU_SYNC_FLOOR_MS", "0") or 0
+)
 
 # fast-lane invalidation margin: re-validate a row's int32 headroom via
 # the full plan well before the hard 2^31 ceiling (margin >> M*E and
@@ -237,8 +264,9 @@ _SEL_TIERS = (
 def _select_and_blob(merged, out, stats, packed, flags, combo,
                      *, CAP_B: int, CAP_SL: int, CAP_N: int, CAP_A: int,
                      CAP_S: int, HOST_OFF: int):
-    """Device-side row selection + detail/vals gather + single-blob
-    packing — the launch's ONE device->host sync.
+    """Device-side row selection + detail/vals gather + split-blob
+    packing — the launch's one commit-proving readback, as a (head,
+    detail) pair of int32 vectors whose D2H copies ride in parallel.
 
     Every sync round trip on a remote-device link costs ~100 ms of
     latency regardless of size (measured r5); the r5 launch paid ~5
@@ -246,10 +274,24 @@ def _select_and_blob(merged, out, stats, packed, flags, combo,
     host's row-set computation (live/buf/append/need/slot/sum) from the
     flag word, compacts each set with a stable argsort (selected rows
     first, ascending), gathers each section for its own capacity, and
-    concatenates EVERYTHING the host reads per launch into one int32
-    vector.  Counts above the static capacities are reported so the
-    host can fall back to an exact multi-sync gather (rare; it then
-    raises its capacity floors).
+    packs everything the host reads per launch into TWO int32 vectors:
+
+    * the HEAD carries the flags/delivered prefix, route stats, section
+      counts, the selected row ids and the per-row VALUES block — i.e.
+      everything that PROVES a proposal's commit (committed/term/role
+      per row).  The pipeline completes futures from this, the
+      earliest commit-proving sync, without waiting for the detail
+      payload to land and merge.
+    * the DETAIL carries the heavy sections (outbox bytes, slot
+      bookkeeping, need rows, ring windows) the append/message merge
+      needs.  Both copies are requested together at dispatch, so on a
+      latency-floor link they arrive for one round trip — the head is
+      simply parsed (and acted on) first, and a generation whose
+      sections are all empty never reads the detail at all.
+
+    Counts above the static capacities are reported so the host can
+    fall back to an exact multi-sync gather (rare; it then raises its
+    capacity floors).
 
     Capacities are PER SECTION because their per-row widths differ
     wildly: one buf row is O*N_FIELDS ints (352 at O=32) while a slot
@@ -262,17 +304,23 @@ def _select_and_blob(merged, out, stats, packed, flags, combo,
     PROPOSE is never device-routed — so the routed-region columns are
     always SLOT_UNUSED/0 and the host re-pads them for free.
 
-    Blob layout (all int32):
+    Head layout (all int32):
       [0:G]               flags
       [G:G+G*nw]          delivered bits (bitcast u32)
       [+6]                route stats
       [+5]                counts: n_buf, n_slot, n_need, n_append, n_sum
-      [+CAP_B]            row ids: buf   | [+CAP_B*O*NF]    out.buf rows
-      [+CAP_SL]           row ids: slot  | [+CAP_SL*M*(2+E)] slot_base|
-                                           slot_term | ent_drop rows
-      [+CAP_N]            row ids: need  | [+CAP_N*P]       need rows
-      [+CAP_A]            row ids: append| [+CAP_A*2W]      ring rows
-      [+CAP_S]            row ids: sum   | [+CAP_S*N_VALS]  values
+      [+CAP_B]            row ids: buf
+      [+CAP_SL]           row ids: slot
+      [+CAP_N]            row ids: need
+      [+CAP_A]            row ids: append
+      [+CAP_S]            row ids: sum
+      [+CAP_S*N_VALS]     values
+    Detail layout (all int32):
+      [0:CAP_B*O*NF]      out.buf rows
+      [+CAP_SL*M]         slot_base (host cols) | [+CAP_SL*M] slot_term
+      [+CAP_SL*M*E]       ent_drop (host cols)
+      [+CAP_N*P]          need rows
+      [+CAP_A*W]          ring_term | [+CAP_A*W] ring_cc
     """
     G = flags.shape[0]
     alive = combo[:, _C_ALIVE] != 0
@@ -302,25 +350,28 @@ def _select_and_blob(merged, out, stats, packed, flags, combo,
     rows_append, n_append = pick(append_sel, CAP_A)
     rows_sum, n_sum = pick(sum_sel, CAP_S)
     vals = _gather_vals(merged, out, rows_sum)      # [CAP_S, N_VALS]
-    return jnp.concatenate([
+    head = jnp.concatenate([
         flags,
         jax.lax.bitcast_convert_type(packed, jnp.int32).reshape(-1),
         stats.astype(I32),
         jnp.stack([n_buf, n_slot, n_need, n_append, n_sum]),
         rows_buf,
-        out.buf[rows_buf].reshape(-1),
         rows_slot,
-        out.slot_base[rows_slot][:, HOST_OFF:].reshape(-1),
-        out.slot_term[rows_slot][:, HOST_OFF:].reshape(-1),
-        out.ent_drop[rows_slot][:, HOST_OFF:].reshape(-1),
         rows_need,
-        out.need_snapshot[rows_need].reshape(-1),
         rows_append,
-        merged.ring_term[rows_append].reshape(-1),
-        merged.ring_cc[rows_append].reshape(-1),
         rows_sum,
         vals.reshape(-1),
     ])
+    detail = jnp.concatenate([
+        out.buf[rows_buf].reshape(-1),
+        out.slot_base[rows_slot][:, HOST_OFF:].reshape(-1),
+        out.slot_term[rows_slot][:, HOST_OFF:].reshape(-1),
+        out.ent_drop[rows_slot][:, HOST_OFF:].reshape(-1),
+        out.need_snapshot[rows_need].reshape(-1),
+        merged.ring_term[rows_append].reshape(-1),
+        merged.ring_cc[rows_append].reshape(-1),
+    ])
+    return head, detail
 
 
 @jax.jit
@@ -375,6 +426,36 @@ def _scatter_inbox_rows(host: Inbox, pos, sub: Inbox) -> Inbox:
     ))
 
 
+class _InFlightGen:
+    """One dispatched-but-unmerged generation of the launch pipeline.
+
+    Holds every host-side fact the deferred merge tail needs (the
+    generation's OWN inputs — the parity oracle must run against these,
+    not the interleaved stream) plus the device handles the exact
+    two-sync fallback gather reads.  ``merged``/``out`` pin the
+    generation's buffers alive until its merge runs; with depth 2 that
+    is the ISSUE's "two in-flight state handles"."""
+
+    __slots__ = (
+        "batch", "staging", "alive_np", "batch_gs", "prop_gs", "caps",
+        "merged", "out", "head_dev", "detail_dev", "t_req",
+    )
+
+    def __init__(self, *, batch, staging, alive_np, batch_gs, prop_gs,
+                 caps, merged, out, head_dev, detail_dev, t_req):
+        self.batch = batch
+        self.staging = staging
+        self.alive_np = alive_np
+        self.batch_gs = batch_gs
+        self.prop_gs = prop_gs
+        self.caps = caps
+        self.merged = merged
+        self.out = out
+        self.head_dev = head_dev
+        self.detail_dev = detail_dev
+        self.t_req = t_req
+
+
 class ColocatedVectorEngine(VectorStepEngine):
     """Shared device engine for several NodeHosts in one process.
 
@@ -383,7 +464,9 @@ class ColocatedVectorEngine(VectorStepEngine):
 
     def __init__(self, *, budget: int = 2, capacity: int = 64, P: int = 5,
                  W: int = 32, M: int = 8, E: int = 4, O: int = 32,
-                 rebase_chunk: int = 1 << 30, device=None, mesh=None):
+                 rebase_chunk: int = 1 << 30, device=None, mesh=None,
+                 pipeline_depth: Optional[int] = None,
+                 sync_floor_ms: Optional[float] = None):
         self.budget = budget
         self._pending: Optional[Inbox] = None
         self._pending_live = False  # last route delivered > 0 messages
@@ -436,6 +519,44 @@ class ColocatedVectorEngine(VectorStepEngine):
         # the warmed ladder + the consecutive-fits-lower-tier streak
         self._sel_tier = 0
         self._sel_fit_streak = 0
+        # ---- launch pipeline (double-buffered generations) ----------
+        # FIFO of dispatched-but-unmerged generations; the merge tail
+        # runs one generation behind the device at depth 2.  The fence
+        # contract (docs/PARITY.md "Pipeline safety argument"): rows
+        # being evicted/escalated/detached drain this to depth 0 before
+        # membership mutates — mirroring the ≤1-launch detach-race
+        # argument at any depth.
+        from collections import deque as _deque
+
+        self._inflight: "_deque[_InFlightGen]" = _deque()
+        self._pipeline_depth = max(
+            1,
+            pipeline_depth
+            if pipeline_depth is not None
+            else _PIPE_DEPTH_DEFAULT,
+        )
+        self._sync_floor_s = (
+            sync_floor_ms
+            if sync_floor_ms is not None
+            else _SYNC_FLOOR_MS_DEFAULT
+        ) / 1000.0
+        # deferred membership actions discovered mid-completion
+        # (escalation replays, snapshot-below / save-failure evictions,
+        # demotes): they mutate membership, so they run only once the
+        # pipeline is drained to depth 0 — never from inside a merge.
+        self._deferred: List[Tuple] = []
+        self._running_deferred = False
+        # True while a generation's merge tail is executing: membership
+        # mutators called from inside it (demote, save-failure evict)
+        # must defer instead of fencing — a fence mid-merge would
+        # complete LATER generations before this one finishes.
+        self._completing = False
+        # row slots freed while generations are in flight: an in-flight
+        # merge still references them by id, so they re-enter _free only
+        # at depth 0 (a re-attach reusing the slot mid-flight would let
+        # one generation's effects merge into another replica's row)
+        self._free_pending: List[int] = []
+        self._last_worker_id = 0
         super().__init__(None, capacity=capacity, P=P, W=W, M=M, E=E, O=O,
                          device=device, mesh=mesh)
         # nemesis escalations are consumed at plan time here: routed
@@ -454,6 +575,13 @@ class ColocatedVectorEngine(VectorStepEngine):
             # goes without it
             t_coalesce_ms=0, t_plan_ms=0, t_upload_ms=0, t_device_ms=0,
             t_detail_ms=0, t_updates_ms=0, t_persist_ms=0,
+            # pipeline observability: host work overlapped with an
+            # in-flight readback request (the double-buffering win),
+            # fences (drains to depth 0 forced by membership mutation),
+            # futures completed from the head-only early pass, and the
+            # floor-shim wait actually paid at collect time
+            pipeline_overlap_s=0.0, pipeline_fences=0,
+            early_completions=0, t_sync_wait_ms=0.0,
         )
 
     def _compute_base(self, r) -> int:
@@ -469,15 +597,34 @@ class ColocatedVectorEngine(VectorStepEngine):
         # distinct rows
         return (node.shard_id, node.replica_id)
 
+    def _free_slot(self, g: int) -> None:
+        """Return a row slot to the free pool — quarantined in
+        ``_free_pending`` while generations are in flight (an in-flight
+        merge still references the slot by id; re-attaching it before
+        depth 0 would merge one replica's device effects into
+        another's scalar state).  Flushed back at every drain."""
+        (self._free_pending if self._inflight else self._free).append(g)
+
+    def _flush_free_pending(self) -> None:
+        if self._free_pending and not self._inflight:
+            self._free.extend(self._free_pending)
+            self._free_pending.clear()
+
     def _attach(self, node) -> Optional[int]:
         key = self._row_key(node)
         g = self._row_of.get(key)
         if g is not None and self._meta[g].node is not node:
             # replica restarted without a detach (stop raced the step):
-            # drop the stale binding and re-key freshly
+            # drop the stale binding and re-key freshly.  PIPELINE
+            # FENCE first — this is a membership mutation like any
+            # detach, and in-flight merges still reference row g (the
+            # old node's device acks must persist before the row is
+            # released); the call site is the plan loop, never a
+            # merge, so fencing is legal here (review finding)
+            self._fence()
             self._row_of.pop(key)
             self._meta.pop(g, None)
-            self._free.append(g)
+            self._free_slot(g)
             self._release_row(g, node.shard_id)
             g = None
         is_new = key not in self._row_of
@@ -512,7 +659,12 @@ class ColocatedVectorEngine(VectorStepEngine):
 
     def _halt_replica(self, g: int) -> None:
         node = self._meta[g].node
-        super()._halt_replica(g)
+        super()._halt_replica(g)  # appends g to _free
+        if self._inflight and g in self._free:
+            # fail-stops happen mid-merge with later generations in
+            # flight: quarantine the slot until depth 0 (see _free_slot)
+            self._free.remove(g)
+            self._free_pending.append(g)
         self._release_row(g, node.shard_id)
 
     def detach_replica(self, shard_id: int, replica_id: int) -> None:
@@ -521,13 +673,22 @@ class ColocatedVectorEngine(VectorStepEngine):
     def detach_replicas(self, pairs) -> None:
         """Batch detach under ONE core-lock acquisition (NodeHost.close
         releases every row of a member at once; per-row locking would
-        interleave thousands of acquisitions with live launches)."""
+        interleave thousands of acquisitions with live launches).
+
+        PIPELINE FENCE: membership must not mutate under an in-flight
+        generation — the pending merges still reference these rows, and
+        a stopping node's device acks were already routed, so its
+        appends must persist before the row goes away (the ≤1-launch
+        detach-race argument, now enforced at any depth by draining
+        first: the drained merges run while the node is still live,
+        then the row is released)."""
         with self._lock:
+            self._fence()
             for shard_id, replica_id in pairs:
                 g = self._row_of.pop((shard_id, replica_id), None)
                 if g is not None:
                     self._meta.pop(g, None)
-                    self._free.append(g)
+                    self._free_slot(g)
                     self._release_row(g, shard_id)
 
     def _upload_rows(self, rows) -> None:
@@ -575,10 +736,15 @@ class ColocatedVectorEngine(VectorStepEngine):
 
     def _on_save_failure(self, pairs) -> None:
         super()._on_save_failure(pairs)
-        # evict the failing nodes' rows NOW (we hold the core lock:
+        # evict the failing nodes' rows (we hold the core lock:
         # colocated persist runs inside _step_colocated) so no further
         # device launch routes acks for appends their WAL cannot hold;
-        # the scalar path only sends after a successful save
+        # the scalar path only sends after a successful save.  With the
+        # pipeline live this defers to the next depth-0 point (before
+        # the next dispatch): the base class's save quarantine already
+        # keeps the rows out of every new plan, and the ≤depth launches
+        # already in flight were dispatched before the failure was
+        # knowable — the same exposure window as the detach race.
         self._evict_rows_to_host([
             g
             for node, _u in pairs
@@ -724,7 +890,26 @@ class ColocatedVectorEngine(VectorStepEngine):
         mirrors, then mark the rows host-authoritative.  Already-dirty
         rows are skipped wholesale: their scalar side is authoritative
         and materializing stale device lanes over it would corrupt it.
-        Caller holds the core lock."""
+        Caller holds the core lock.
+
+        PIPELINE FENCE: eviction mutates membership (rows leave the
+        device), so in-flight generations drain to depth 0 first —
+        their merges still reference these rows, and materializing a
+        row whose unmerged device appends are in flight would trip a
+        false divergence halt.  A caller running INSIDE a generation's
+        merge (demote on a compacted below-ring send, a save-failure
+        mid-persist) must not fence — completing later generations
+        before the current one finishes would break the FIFO scalar
+        sync — so the eviction defers to the next depth-0 point
+        instead (before the next dispatch, see _run_deferred)."""
+        if self._completing:
+            self._deferred.append(("evict", [int(g) for g in gs], cause))
+            return
+        if self._inflight and any(
+            (m := self._meta.get(g)) is not None and not m.dirty
+            for g in gs
+        ):
+            self._fence()
         pairs = []
         for g in gs:
             meta = self._meta.get(g)
@@ -809,6 +994,175 @@ class ColocatedVectorEngine(VectorStepEngine):
         self._pending = _zero_inbox_rows(
             self._pending, self._put_rows(jnp.asarray(mask))
         )
+
+    # -- the launch pipeline -------------------------------------------
+    def _fence(self) -> None:
+        """Drain the pipeline to depth 0, run the deferred membership
+        actions and persist every drained update — invoked before any
+        membership mutation (evict/detach/rebase/stale re-attach).
+        No-op when nothing is in flight or deferred.  Caller holds the
+        core lock; must NOT be called from inside a generation's merge
+        (those paths defer instead — see _evict_rows_to_host)."""
+        if not self._inflight and not self._deferred:
+            self._flush_free_pending()
+            return
+        if self._inflight:
+            self.stats["pipeline_fences"] += 1
+        updates = self._drain_pipeline()
+        if updates:
+            self._drain_update_retries(updates)
+            self._persist_and_process(updates, self._last_worker_id)
+
+    def _drain_pipeline(self) -> List[Tuple]:
+        """Complete every in-flight generation in dispatch order, then
+        run the deferred actions; returns the updates to persist."""
+        updates: List[Tuple] = []
+        while self._inflight:
+            updates.extend(self._complete_oldest())
+        updates.extend(self._run_deferred())
+        self._flush_free_pending()
+        return updates
+
+    def _complete_oldest(self) -> List[Tuple]:
+        rec = self._inflight.popleft()
+        self._completing = True
+        try:
+            return self._complete_generation(rec)
+        except BaseException:
+            # the generation chain is poisoned (its outputs feed every
+            # later in-flight handle): roll the resident set back to
+            # the last merged generation
+            self._reset_after_pipeline_failure()
+            raise
+        finally:
+            self._completing = False
+
+    def _run_deferred(self) -> List[Tuple]:
+        """Execute deferred membership actions (escalation replays,
+        snapshot-below/save-failure evictions, demotes) in the order
+        they were recorded — only at depth 0, so every generation that
+        stepped the affected rows has merged first.  Returns updates to
+        persist.  Reentrancy guard: an action's own eviction fences,
+        which calls back here — the inner call no-ops and the outer
+        loop keeps draining."""
+        if self._running_deferred or self._inflight:
+            return []
+        updates: List[Tuple] = []
+        self._running_deferred = True
+        try:
+            while self._deferred and not self._inflight:
+                action = self._deferred.pop(0)
+                kind = action[0]
+                if kind == "esc":
+                    updates.extend(
+                        self._apply_escalation(action[1], action[2],
+                                               action[3])
+                    )
+                elif kind == "evict":
+                    # covers mid-merge demotes and save-failure
+                    # quarantine evictions too — both defer through
+                    # _evict_rows_to_host's completing check
+                    self._evict_rows_to_host(action[1], action[2])
+                elif kind == "below":
+                    self._apply_snapshot_below(action[1])
+        finally:
+            self._running_deferred = False
+        return updates
+
+    def _apply_escalation(self, node, g: int, si) -> List[Tuple]:
+        """Deferred kernel-escalation recovery — the pipeline-safe form
+        of the serial restore-and-replay.  The device already restored
+        the row's pre-step state (_route_step's suppress mask), and any
+        LATER in-flight generation re-stepped it from there: a valid
+        raft evolution whose routed acks were delivered, so its effects
+        merged normally before this runs (FIFO drain).  Recovery is
+        therefore a plain eviction of the row's CURRENT device state
+        (drains pending routed traffic, materializes, marks dirty)
+        followed by a scalar replay of the escalated generation's
+        drained inputs — late replay of messages/proposals/ticks is
+        raft-safe, and at depth 1 the current state IS the restored
+        pre-step state, so this degenerates to the old serial shape."""
+        meta = self._meta.get(g)
+        if meta is None or meta.node is not node or node.stopped:
+            return []
+        self._evict_rows_to_host([g], "escalation")
+        meta = self._meta.get(g)
+        if meta is None:  # halted during the eviction's materialize
+            return []
+        meta.set_escalation_hold(node.config)
+        if si is None:
+            return []  # routed-only inputs: raft-safe to lose
+        u = node.step_with_inputs(si)
+        return [(node, u)] if u is not None else []
+
+    def _apply_snapshot_below(self, below) -> None:
+        """Deferred snapshot-below host excursion: evict the rows (the
+        int32 lane can't represent the durable snapshot index), then
+        mark the scalar remotes SNAPSHOT — after the materialize, which
+        would otherwise overwrite them and re-fire duplicate full
+        snapshot streams on every re-upload."""
+        self._evict_rows_to_host(
+            sorted({t[0] for t in below}), "snapshot_below"
+        )
+        for g, p, _, pid, ss_index in below:
+            meta = self._meta.get(g)
+            if meta is None or meta.node.stopped:
+                continue
+            rm = meta.node.peer.raft.get_remote(pid)
+            if rm is not None:
+                rm.become_snapshot(ss_index)
+
+    def _floor_wait(self, t_req: float) -> None:
+        """Simulated-tunnel sync latency: data counts as landed no
+        earlier than the floor after the D2H request was issued.  A
+        request issued at dispatch and collected after host work pays
+        only the remainder — the overlap the pipeline exists for."""
+        if self._sync_floor_s <= 0:
+            return
+        import time as _time
+
+        rem = self._sync_floor_s - (_time.monotonic() - t_req)
+        if rem > 0:
+            _time.sleep(rem)
+            self.stats["t_sync_wait_ms"] += rem * 1000.0
+
+    def _collect_blob(self, dev, t_req: float) -> np.ndarray:
+        """THE launch readback: blocking collect of a blob whose D2H
+        copy was requested at dispatch, honoring the sync-floor shim."""
+        # raftlint: ignore[sync-budget] the single sanctioned blob readback of the launch path
+        arr = np.asarray(dev)
+        self._floor_wait(t_req)
+        return arr
+
+    def _reset_after_pipeline_failure(self) -> None:
+        """A launch program failed after later generations chained onto
+        its outputs: every in-flight handle (state, pending regions,
+        blobs) is transitively poisoned.  Roll the WHOLE resident set
+        back to the last merged generation: scalar state is
+        authoritative through it, and the unmerged generations' effects
+        existed only device-side — appends and the acks they earned
+        vanish TOGETHER for every colocated row (one shared device
+        state), which is raft-safe message loss.  Rows re-upload from
+        scratch on their next step."""
+        self._inflight.clear()
+        self._pending_live = False
+        self._flush_free_pending()
+        for g, meta in list(self._meta.items()):
+            if not meta.dirty:
+                meta.dirty = True
+                meta.plan_ok = False
+                if meta.node.device_reads.has_pending():
+                    meta.node.drop_device_reads()
+        try:
+            self._state = self._put_rows(
+                make_state(self.capacity, self.P, self.W,
+                           replica_ids=np.zeros(self.capacity))
+            )
+            self._pending = self._put_rows(
+                make_inbox(self.capacity, self.P * self.budget, self.E)
+            )
+        except Exception:  # noqa: BLE001 — rebuilt lazily next launch
+            self._pending = None
 
     # -- the colocated step --------------------------------------------
     def step_shards(self, nodes, worker_id: int) -> None:
@@ -949,6 +1303,42 @@ class ColocatedVectorEngine(VectorStepEngine):
     def _step_colocated(self, nodes, worker_id: int) -> None:
         import time as _time
 
+        self._last_worker_id = worker_id
+        # ---- opportunistic completion: the earliest ripe sync -------
+        # Merge any in-flight generation whose readback has LANDED
+        # (floor elapsed, value ready) without blocking: proposals
+        # complete from the earliest sync that proves their commit, not
+        # from the pipe-full room check several generations later.
+        # Runs before planning, so the plan also sees the freshest
+        # merged scalars the link can provide.
+        ripe: List[Tuple] = []
+        while self._inflight:
+            rec = self._inflight[0]
+            if self._sync_floor_s > 0:
+                import time as _t
+
+                if _t.monotonic() - rec.t_req < self._sync_floor_s:
+                    break
+            # BOTH blobs must have landed: the merge may read the
+            # detail payload too, and blocking the core lock on a
+            # still-in-flight transfer is exactly the stall this
+            # non-blocking pass exists to avoid (review finding)
+            if any(
+                (ir := getattr(dev, "is_ready", None)) is not None
+                and not ir()
+                for dev in (rec.head_dev, rec.detail_dev)
+            ):
+                break
+            ripe.extend(self._complete_oldest())
+        if ripe:
+            self._drain_update_retries(ripe)
+            self._persist_and_process(ripe, worker_id)
+        if self._deferred:
+            # deferred membership actions (recorded mid-merge, e.g. a
+            # save-failure eviction during the driver's persist or an
+            # escalation a ripe completion just surfaced) run before
+            # anything new dispatches
+            self._fence()
         updates: List[Tuple] = []
         host_rows: List[Tuple] = []
         batch: List[Tuple] = []
@@ -1096,6 +1486,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 "fast_lane_rows", 0
             ) + n_fast
         self.stats["t_plan_ms"] += int((_time.perf_counter() - _t0) * 1000)
+        launched = False
         if batch or self._pending_live:
             if self._pending_live or any(plan for _, _, _, plan in batch):
                 _t0 = _time.perf_counter()
@@ -1113,7 +1504,8 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self.stats["t_upload_ms"] += (
                     (_time.perf_counter() - _t0) * 1000.0
                 )
-                updates.extend(self._device_step_colocated(batch))
+                self._launch_generation(batch)
+                launched = True
             else:
                 # pure preload: nothing to step and no routed traffic in
                 # flight — skip the launch AND the upload (mass start
@@ -1128,6 +1520,26 @@ class ColocatedVectorEngine(VectorStepEngine):
                 for node, g, si, plan in batch:
                     _tick_bookkeeping(node, si.ticks + si.gc_ticks)
 
+        # ---- pipeline completion ------------------------------------
+        # Depth 1 completes its own generation in-call (the serial
+        # loop).  At depth >= 2 a dispatched generation stays in flight
+        # until the pipe is FULL at the next dispatch (the room check
+        # inside _launch_generation): its readback — requested at
+        # dispatch — then rode the tunnel for a full pipeline's worth
+        # of host work (plan/upload/dispatch of the following
+        # generations), which is what turns the sync floor from a
+        # per-generation cost into a hidden one.  An idle call (nothing
+        # to launch) drains fully so no generation waits on work that
+        # never comes, and a completion that recorded deferred
+        # membership actions forces a full drain — they must run
+        # before the next dispatch.
+        if (not launched) or self._pipeline_depth == 1 or self._deferred:
+            while self._inflight:
+                updates.extend(self._complete_oldest())
+        if self._deferred and not self._inflight:
+            updates.extend(self._run_deferred())
+        self._flush_free_pending()
+
         self._drain_update_retries(updates)
         if updates:
             _t0 = _time.perf_counter()
@@ -1135,6 +1547,31 @@ class ColocatedVectorEngine(VectorStepEngine):
             self.stats["t_persist_ms"] += int(
                 (_time.perf_counter() - _t0) * 1000
             )
+        if self._inflight:
+            # completion guarantee: a dispatched generation must be
+            # merged even if no member ever has work again — poke ONE
+            # live node so some worker calls back in (that call,
+            # finding nothing to launch, drains the pipeline).  One
+            # notify suffices and per-generation fan-out to the whole
+            # batch measurably serialized the 1-core bench.  A
+            # pending-live-only launch has an EMPTY batch (review
+            # finding), so fall back to any alive resident node.
+            poked = False
+            for node, _g, _si, _plan in batch:
+                if not node.stopped and node.notify_work is not None:
+                    node.notify_work()
+                    poked = True
+                    break
+            if not poked:
+                for g in np.nonzero(self._lanes.alive_mask())[0].tolist():
+                    meta = self._meta.get(g)
+                    if (
+                        meta is not None
+                        and not meta.node.stopped
+                        and meta.node.notify_work is not None
+                    ):
+                        meta.node.notify_work()
+                        break
 
     def _sel_cover(self, G, caps, counts, sel_rows, sets):  # hostplane-hot
         """Index-array coverage of the device's single-sync row
@@ -1169,7 +1606,83 @@ class ColocatedVectorEngine(VectorStepEngine):
         return (pos_buf, pos_slot, pos_need, pos_ring, pos_sum,
                 rows_sum[:n_sum])
 
-    def _device_step_colocated(self, batch) -> List[Tuple]:
+    def _early_commit_pass(self, live, flags, pos_sum, pos_buf, pos_slot,
+                           pos_need, vals_np, early_done) -> List[Tuple]:
+        """Complete commit-only rows straight off the head blob.
+
+        Eligible: live rows with a values entry but no append, no
+        host-visible outbox bytes, no proposal slots and no
+        snapshot-needing peer — their whole merge is the scalar sync +
+        commit advance + update construction, none of which touches the
+        detail payload.  Their updates persist immediately, so a
+        proposal appended in an earlier generation whose commit this
+        generation proves completes without waiting for the detail
+        payload or the heavy merge tail.  Marks completed positions in
+        ``early_done`` so the main loop skips them."""
+        if not live:
+            return []
+        gs_all = np.asarray([g for _, g, _ in live], np.int64)
+        sum_k = pos_sum[gs_all]
+        eligible = (
+            (sum_k >= 0)
+            & ((flags[gs_all] & _F_APPEND) == 0)
+            & (pos_buf[gs_all] < 0)
+            & (pos_slot[gs_all] < 0)
+            & (pos_need[gs_all] < 0)
+        )
+        if not eligible.any():
+            return []
+        updates: List[Tuple] = []
+        sum_k_l = sum_k.tolist()
+        for j in np.nonzero(eligible)[0].tolist():
+            node, g, si = live[j]
+            early_done[j] = True
+            if node.stopped or self._meta.get(g) is None:
+                continue
+            r = node.peer.raft
+            base = int(self._base[g])
+            if si is not None:
+                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
+            sv = vals_np[sum_k_l[j]]
+            term, vote, committed, leader, role = (
+                int(sv[_R_TERM]), int(sv[_R_VOTE]), int(sv[_R_COMMIT]),
+                int(sv[_R_LEADER]), int(sv[_R_ROLE]),
+            )
+            committed += base
+            r.term, r.vote, r.leader_id = term, vote, leader
+            r.role = RaftRole(role)
+            if committed > r.log.committed:
+                r.log.commit_to(committed)
+            if (
+                role != int(RaftRole.LEADER)
+                and node.device_reads.has_pending()
+            ):
+                node.drop_device_reads()
+            u = node.peer.get_update(last_applied=node.sm.last_applied)
+            node.dispatch_dropped(u)
+            updates.append((node, u))
+            node._check_leader_change()
+        return updates
+
+    def _launch_generation(self, batch) -> None:  # sync-hot
+        """Assemble, upload and dispatch one generation, request its
+        (head, detail) readback, and push the in-flight record — the
+        merge tail runs later in _complete_generation (behind the
+        device by up to pipeline_depth generations).  Caller holds the
+        core lock."""
+        # room check: the pipe holds up to depth dispatched-unmerged
+        # generations; complete the oldest BEFORE adding a new one so
+        # each readback stays in flight across a full pipeline's worth
+        # of host work — completing right after dispatch (the naive
+        # order) gave every readback only ONE cycle of overlap and
+        # left half the floor exposed on the 1-core bench
+        while len(self._inflight) >= self._pipeline_depth:
+            room_updates = self._complete_oldest()
+            if room_updates:
+                self._drain_update_retries(room_updates)
+                self._persist_and_process(
+                    room_updates, self._last_worker_id
+                )
         G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
         # staging keys in ASSEMBLED coordinates: the routed regions
         # (width P*B) come first, host slots after (see _assemble_inbox)
@@ -1223,9 +1736,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         gen_stopping = getattr(self, "_gen_stopping", None)
         if gen_stopping:
             alive_np[gen_stopping] = False
+        # raftlint: ignore[sync-budget] host-built index arrays, not device readbacks
         batch_gs = np.asarray(
             [g for _, g, _, _ in batch], np.int64
         )
+        # raftlint: ignore[sync-budget] host-built index array, not a device readback
         prop_gs = np.asarray(prop_rows, np.int64)
         combo_np[:, _C_ALIVE] = alive_np
         combo_np[batch_gs, _C_BATCH] = 1
@@ -1265,15 +1780,18 @@ class ColocatedVectorEngine(VectorStepEngine):
             # and could not rebuild it (see the handler below)
             self._pending = self._put_rows(make_inbox(G, P * B, E))
         if _DEBUG_LAUNCH:
-            # debug-only sync: how much PRIOR device work (uploads,
-            # materialize, inbox scatters) is still in flight?
+            # debug-only sync, FUSED into one device_get (each stray
+            # sync is ~100 ms of tunnel time — three separate gets were
+            # three round trips even on the debug path): how much PRIOR
+            # device work (uploads, materialize, scatters) is in flight?
             import sys as _sys
             _td = _time.perf_counter()
-            np.asarray(jax.device_get(old_state.term[:1]))
-            _occ_h = np.asarray(jax.device_get(
-                (host_inbox.mtype != 0).sum(axis=1)))
-            _occ_p = np.asarray(jax.device_get(
-                (self._pending.mtype != 0).sum(axis=1)))
+            # raftlint: ignore[sync-budget] debug-gated pre-launch probe, one fused get
+            _t1g, _occ_h, _occ_p = jax.device_get((
+                old_state.term[:1],
+                (host_inbox.mtype != 0).sum(axis=1),
+                (self._pending.mtype != 0).sum(axis=1),
+            ))
             print(
                 f"[pre ] prior-work wait "
                 f"{(_time.perf_counter() - _td) * 1000:.0f} ms "
@@ -1297,15 +1815,6 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self.stats["t_dev_step_ms"] = self.stats.get(
                     "t_dev_step_ms", 0
                 ) + int((_time.perf_counter() - _t0) * 1000)
-                if _DEBUG_LAUNCH:
-                    import sys as _sys
-                    _td = _time.perf_counter()
-                    np.asarray(jax.device_get(new_state.term[:1]))
-                    print(
-                        f"[asm ] assemble+step exec "
-                        f"{(_time.perf_counter() - _td) * 1000:.0f} ms",
-                        file=_sys.stderr, flush=True,
-                    )
                 _t1 = _time.perf_counter()
                 merged, regions, stats_dev, packed_dev, flags_dev = (
                     _route_step(
@@ -1316,47 +1825,6 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self.stats["t_dev_route_ms"] = self.stats.get(
                     "t_dev_route_ms", 0
                 ) + int((_time.perf_counter() - _t1) * 1000)
-                if _DEBUG_LAUNCH:
-                    import sys as _sys
-                    _td = _time.perf_counter()
-                    np.asarray(jax.device_get(flags_dev[:1]))
-                    print(
-                        f"[chain] step+route exec "
-                        f"{(_time.perf_counter() - _td) * 1000:.0f} ms",
-                        file=_sys.stderr, flush=True,
-                    )
-                _t1 = _time.perf_counter()
-                # the launch's ONE sync round trip: flags + delivered +
-                # stats + device-selected detail/vals rows in one blob
-                # (every separate np.asarray costs ~100 ms of tunnel
-                # latency regardless of size; r5 paid 5 per launch)
-                caps = self._tier_caps(self._sel_tier)
-                blob_dev = _select_and_blob(
-                    merged, out, stats_dev, packed_dev, flags_dev,
-                    combo, CAP_B=caps["b"], CAP_SL=caps["sl"],
-                    CAP_N=caps["n"], CAP_A=caps["a"],
-                    CAP_S=caps["s"], HOST_OFF=P * B,
-                )
-                self.stats["t_dev_sel_ms"] = self.stats.get(
-                    "t_dev_sel_ms", 0
-                ) + int((_time.perf_counter() - _t1) * 1000)
-                _t1 = _time.perf_counter()
-                blob = np.asarray(blob_dev)
-                _blob_ms = int((_time.perf_counter() - _t1) * 1000)
-                self.stats["t_dev_blob_ms"] = self.stats.get(
-                    "t_dev_blob_ms", 0
-                ) + _blob_ms
-                if _DEBUG_LAUNCH:
-                    import sys as _sys
-
-                    print(
-                        f"[launch {self.stats['launches']}] tier="
-                        f"{self._sel_tier} batch={len(batch)} "
-                        f"blob_ms={_blob_ms} bytes={blob.nbytes}",
-                        file=_sys.stderr, flush=True,
-                    )
-                nw = (self.O + 31) // 32
-                flags = blob[:G]
         except BaseException:
             # self._pending was DONATED above; leaving the deleted
             # buffer in place would poison every later generation with
@@ -1374,44 +1842,110 @@ class ColocatedVectorEngine(VectorStepEngine):
             except Exception:  # noqa: BLE001 — next launch rebuilds
                 pass
             raise
-        self._behind = (flags & _F_PEERS_BEHIND) != 0
+        # from here the generation is the new device truth: the next
+        # launch (possibly dispatched before this one merges) chains on
+        # merged/regions.  A failure past this point poisons the chain
+        # and takes the pipeline-reset recovery instead.
+        self._pending = regions
+        self._state = merged
+        try:
+            with annotate("raft-colocated-select"):
+                _t1 = _time.perf_counter()
+                # the launch's one commit-proving readback, requested
+                # NOW and collected at merge time: flags + delivered +
+                # counts + row ids + vals in the head, heavy sections
+                # in the detail (see _select_and_blob) — both D2H
+                # copies ride the tunnel while the host assembles and
+                # dispatches the NEXT generation
+                caps = self._tier_caps(self._sel_tier)
+                head_dev, detail_dev = _select_and_blob(
+                    merged, out, stats_dev, packed_dev, flags_dev,
+                    combo, CAP_B=caps["b"], CAP_SL=caps["sl"],
+                    CAP_N=caps["n"], CAP_A=caps["a"],
+                    CAP_S=caps["s"], HOST_OFF=P * B,
+                )
+                for dev in (head_dev, detail_dev):
+                    fn = getattr(dev, "copy_to_host_async", None)
+                    if fn is not None:
+                        fn()
+                self.stats["t_dev_sel_ms"] = self.stats.get(
+                    "t_dev_sel_ms", 0
+                ) + int((_time.perf_counter() - _t1) * 1000)
+        except BaseException:
+            self._reset_after_pipeline_failure()
+            raise
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
+        self.stats["launches"] += 1
+        self.stats["device_steps"] += 1
+        self.stats["device_rows_stepped"] += len(batch)
+        if _DEBUG_LAUNCH:
+            import sys as _sys
+
+            print(
+                f"[launch {self.stats['launches']}] tier="
+                f"{self._sel_tier} batch={len(batch)} "
+                f"inflight={len(self._inflight) + 1}",
+                file=_sys.stderr, flush=True,
+            )
+        self._inflight.append(_InFlightGen(
+            batch=batch, staging=staging, alive_np=alive_np,
+            batch_gs=batch_gs, prop_gs=prop_gs, caps=caps,
+            merged=merged, out=out, head_dev=head_dev,
+            detail_dev=detail_dev, t_req=_time.monotonic(),
+        ))
+
+    def _complete_generation(self, rec: _InFlightGen) -> List[Tuple]:  # sync-hot
+        """Merge one in-flight generation: collect the head (the
+        earliest commit-proving sync), complete commit-only rows from
+        it immediately, then collect the detail payload (already in
+        flight since dispatch) for the append/message merge tail.
+        Caller holds the core lock; generations complete in dispatch
+        order (_complete_oldest)."""
+        import time as _time
+
+        G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
+        batch, staging, caps = rec.batch, rec.staging, rec.caps
+        alive_np, batch_gs, prop_gs = (
+            rec.alive_np, rec.batch_gs, rec.prop_gs
+        )
+        nw = (self.O + 31) // 32
+        _t0 = _time.perf_counter()
+        _tc = _time.monotonic()
+        head = self._collect_blob(rec.head_dev, rec.t_req)
+        if self._pipeline_depth > 1:
+            # host-side work done between the D2H request (dispatch)
+            # and this collect ran concurrently with the readback —
+            # the double-buffering win, visible without hardware
+            overlap = max(0.0, _tc - rec.t_req)
+            if self._sync_floor_s > 0:
+                overlap = min(overlap, self._sync_floor_s)
+            self.stats["pipeline_overlap_s"] += overlap
+            _metrics.counter("pipeline_overlap_seconds_total").add(overlap)
+        self.stats["t_dev_blob_ms"] = self.stats.get(
+            "t_dev_blob_ms", 0
+        ) + int((_time.perf_counter() - _t0) * 1000)
+        self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
+        flags = head[:G]
+        delivered_bits = (
+            head[G:G + G * nw].view(np.uint32).reshape(G, nw)
+        )  # [G, ceil(O/32)] u32
+        self._behind = (flags & _F_PEERS_BEHIND) != 0
         _parse = [G + G * nw]
 
         def take(n, shape=None):
-            part = blob[_parse[0]:_parse[0] + n]
+            part = head[_parse[0]:_parse[0] + n]
             _parse[0] += n
             return part.reshape(shape) if shape is not None else part
 
         rstats = take(6)
         sel_counts = take(5)
         sel_rows_buf = take(caps["b"])
-        sel_buf = take(
-            caps["b"] * self.O * N_FIELDS_BUF,
-            (caps["b"], self.O, N_FIELDS_BUF),
-        )
         sel_rows_slot = take(caps["sl"])
-        # slot sections carry HOST-region columns only (see
-        # _select_and_blob); the routed-region prefix re-pads below
-        sel_slot_base = take(caps["sl"] * M, (caps["sl"], M))
-        sel_slot_term = take(caps["sl"] * M, (caps["sl"], M))
-        sel_ent_drop = take(caps["sl"] * M * E, (caps["sl"], M, E))
         sel_rows_need = take(caps["n"])
-        sel_need = take(caps["n"] * P, (caps["n"], P))
         sel_rows_append = take(caps["a"])
-        sel_ring_t = take(caps["a"] * self.W, (caps["a"], self.W))
-        sel_ring_c = take(caps["a"] * self.W, (caps["a"], self.W))
         sel_rows_sum = take(caps["s"])
         sel_vals = take(caps["s"] * N_VALS, (caps["s"], N_VALS))
-        delivered_bits = (
-            blob[G:G + G * nw].view(np.uint32).reshape(G, nw)
-        )  # [G, ceil(O/32)] u32
-        self._pending = regions
-        self._state = merged
         self._pending_live = int(rstats[0]) > 0
-        self.stats["launches"] += 1
-        self.stats["device_steps"] += 1
-        self.stats["device_rows_stepped"] += len(batch)
         self.stats["routed_delivered"] += int(rstats[0])
         self.stats["routed_host_carried"] += int(rstats[5])
         self.stats["routed_dropped"] += int(rstats[1] + rstats[2] + rstats[3])
@@ -1444,32 +1978,26 @@ class ColocatedVectorEngine(VectorStepEngine):
                 flags, alive_np, batch_gs, prop_gs, sets, G=G
             )
 
-        # ---- escalations ---------------------------------------------
-        esc_batch = [
-            (batch[i][0], batch[i][1], batch[i][2])
-            for i in sets.esc_batch_pos.tolist()
-        ]
-        # resident rows stepped only by routed traffic can escalate too:
-        # discard their effects (the routed inputs are raft-safe to lose)
-        esc_other = sets.esc_other.tolist()
+        # ---- escalations: DEFERRED to the pipeline drain -------------
+        # The device already restored escalated rows (suppress mask in
+        # _route_step) and suppressed their outboxes, so there are no
+        # gen-N effects to merge; but a LATER in-flight generation may
+        # have re-stepped them from the restored state with delivered
+        # acks, so the recovery (evict + scalar replay of this
+        # generation's inputs) runs only at depth 0 — after every such
+        # generation has merged (see _apply_escalation).
         updates: List[Tuple] = []
-        if esc_batch or esc_other:
-            self.stats["escalations"] += len(esc_batch) + len(esc_other)
-            gs = [g for _, g, _ in esc_batch] + esc_other
-            # merged state already restored these rows (suppress mask in
-            # _route_step); materialize their pre-step state and replay
-            self._materialize_rows(gs, old_state)
-            for g in gs:
+        n_esc = len(sets.esc_batch_pos) + len(sets.esc_other)
+        if n_esc:
+            self.stats["escalations"] += n_esc
+            for i in sets.esc_batch_pos.tolist():
+                node, g, si, _plan = batch[i]
+                self._deferred.append(("esc", node, g, si))
+            for g in sets.esc_other.tolist():
                 meta = self._meta.get(g)
                 if meta is not None:
-                    meta.dirty = True
-                    meta.set_escalation_hold(meta.node.config)
-            for node, g, si in esc_batch:
-                if self._meta.get(g) is None or node.stopped:
-                    continue
-                u = node.step_with_inputs(si)
-                if u is not None:
-                    updates.append((node, u))
+                    # routed-only inputs: discarded (raft-safe to lose)
+                    self._deferred.append(("esc", meta.node, g, None))
 
         # ---- live rows: batch rows + any resident row with effects ----
         esc_keep = np.ones((len(batch),), bool)
@@ -1490,14 +2018,14 @@ class ColocatedVectorEngine(VectorStepEngine):
         need_rows = sets.need_rows
         sum_rows = sets.sum_rows
         _t0 = _time.perf_counter()
-        # device-selected detail (the single-sync fast path): the blob
-        # already carries detail/vals for the rows the DEVICE selected
-        # with the same flag logic; verify the host's sets are covered
-        # and fall back to an exact two-sync gather when not (capacity
-        # overflow, or a row the device's live approximation missed).
-        # Coverage and row->gather-position maps are index arrays
-        # (hostplane.pos_of/covered) — the old per-row dict builds and
-        # `all(g in …)` membership scans were O(rows) Python per launch
+        # device-selected detail (the split-blob fast path): the head
+        # already carries counts/row-ids/vals for the rows the DEVICE
+        # selected with the same flag logic; verify the host's sets are
+        # covered and fall back to an exact two-sync gather when not
+        # (capacity overflow, or a row the device's live approximation
+        # missed).  Coverage and row->gather-position maps are index
+        # arrays (hostplane.pos_of/covered) — the old per-row dict
+        # builds and `all(g in …)` membership scans were O(rows) Python
         n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d = (
             int(x) for x in sel_counts
         )
@@ -1509,33 +2037,87 @@ class ColocatedVectorEngine(VectorStepEngine):
             sets,
         )
         dev_ok = cover is not None
+        early_done = np.zeros((len(batch) + len(sets.live_other),), bool)
         if dev_ok:
             pos_buf, pos_slot, pos_need, pos_ring, pos_sum, sum_src = cover
-        if dev_ok:
             # live rows only: the padded capacity tail is garbage the
             # merge loop never indexes, and converting it cost tens of
             # ms/launch at storm-tier capacities (review finding)
             sel_vals = sel_vals[:n_sum_d]
-            buf_np = sel_buf
-            # re-pad the routed-region prefix the device omitted: those
-            # columns are ALWAYS unused for slot bookkeeping (forwarded
-            # PROPOSE never rides the routed regions)
-            PB = P * B
-            slot_base = np.concatenate([
-                np.full((caps["sl"], PB), SLOT_UNUSED_I, np.int32),
-                sel_slot_base,
-            ], axis=1)
-            slot_term = np.concatenate([
-                np.zeros((caps["sl"], PB), np.int32), sel_slot_term
-            ], axis=1)
-            ent_drop = np.concatenate([
-                np.zeros((caps["sl"], PB, E), np.int32), sel_ent_drop
-            ], axis=1)
-            need_np = sel_need
-            ring_t, ring_c = sel_ring_t, sel_ring_c
             vals_np = sel_vals
+            # ---- EARLY completion: the commit-proving prefix --------
+            # A live row with values but NO append/outbox/slot/need
+            # sections (the common shape: a leader whose routed acks
+            # just advanced commit, a follower applying) needs nothing
+            # from the detail payload — sync its scalars, advance
+            # commit and hand its update to persist/apply NOW, so
+            # proposals complete from the earliest sync that proves
+            # their commit instead of waiting for the detail to land
+            # and the heavy merge tail to run.
+            updates_early = self._early_commit_pass(
+                live, flags, pos_sum, pos_buf, pos_slot, pos_need,
+                vals_np, early_done,
+            )
+            if updates_early:
+                self.stats["early_completions"] += len(updates_early)
+                self._persist_and_process(
+                    updates_early, self._last_worker_id
+                )
+            need_detail = bool(
+                len(buf_rows) or len(append_rows)
+                or len(slot_rows) or len(need_rows)
+            )
+            if need_detail:
+                det = self._collect_blob(rec.detail_dev, rec.t_req)
+                O, W = self.O, self.W
+                _dp = [0]
+
+                def dtake(n, shape):
+                    part = det[_dp[0]:_dp[0] + n]
+                    _dp[0] += n
+                    return part.reshape(shape)
+
+                buf_np = dtake(
+                    caps["b"] * O * N_FIELDS_BUF,
+                    (caps["b"], O, N_FIELDS_BUF),
+                )
+                # slot sections carry HOST-region columns only (see
+                # _select_and_blob); re-pad the routed-region prefix
+                # the device omitted: those columns are ALWAYS unused
+                # for slot bookkeeping (forwarded PROPOSE never rides
+                # the routed regions)
+                sel_slot_base = dtake(caps["sl"] * M, (caps["sl"], M))
+                sel_slot_term = dtake(caps["sl"] * M, (caps["sl"], M))
+                sel_ent_drop = dtake(
+                    caps["sl"] * M * E, (caps["sl"], M, E)
+                )
+                need_np = dtake(caps["n"] * P, (caps["n"], P))
+                ring_t = dtake(caps["a"] * W, (caps["a"], W))
+                ring_c = dtake(caps["a"] * W, (caps["a"], W))
+                PB = P * B
+                slot_base = np.concatenate([
+                    np.full((caps["sl"], PB), SLOT_UNUSED_I, np.int32),
+                    sel_slot_base,
+                ], axis=1)
+                slot_term = np.concatenate([
+                    np.zeros((caps["sl"], PB), np.int32), sel_slot_term
+                ], axis=1)
+                ent_drop = np.concatenate([
+                    np.zeros((caps["sl"], PB, E), np.int32), sel_ent_drop
+                ], axis=1)
+            else:
+                # pure commit/tick generation: the detail payload is
+                # never read — on hardware its bytes still rode the
+                # same round trip, and nothing here waits for them
+                self.stats["detail_skipped"] = self.stats.get(
+                    "detail_skipped", 0
+                ) + 1
+                buf_np = slot_base = slot_term = ent_drop = None
+                need_np = ring_t = ring_c = None
         else:
-            # exact host-side selection (the r5 two-sync path)
+            # exact host-side selection (the r5 two-sync path) — an
+            # extra sync round trip; the floor shim charges it one
+            # fresh floor from request time
             self.stats["sel_fallbacks"] = (
                 self.stats.get("sel_fallbacks", 0) + 1
             )
@@ -1543,12 +2125,14 @@ class ColocatedVectorEngine(VectorStepEngine):
                 buf_rows.tolist(), slot_rows.tolist(),
                 need_rows.tolist(), append_rows.tolist(),
             )
+            _tq = _time.monotonic()
             # the kernel ran on the ASSEMBLED inbox (host slots + routed
             # regions), so the out slot arrays are M + P*B wide
             detail, vals_np = _fetch_detail_vals(
-                merged, out, idx4, sum_rows.tolist(), self._put,
+                rec.merged, rec.out, idx4, sum_rows.tolist(), self._put,
                 self.O, M + P * B, E, P, self.W, allow_fused=False,
             )
+            self._floor_wait(_tq)
             if detail is not None:
                 (buf_np, slot_base, slot_term, ent_drop, need_np, ring_t,
                  ring_c) = detail
@@ -1607,6 +2191,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         # [*, G] arrays; the residual per-row body below only mutates
         # the Python raft objects it must (scalar sync, append merge,
         # update construction) — see ops/hostplane.py.
+        # raftlint: ignore[sync-budget] host-built index array, not a device readback
         gs_m = np.asarray([g for _, g, _ in live], np.int64)
         n_live = len(gs_m)
         if n_live:
@@ -1639,9 +2224,9 @@ class ColocatedVectorEngine(VectorStepEngine):
             # re-seeded at their next upload, so the write is moot)
             in_sum = sum_k >= 0
             if vals_np is not None and in_sum.any():
-                self._mirror[:6, gs_m[in_sum]] = np.asarray(
-                    vals_np
-                )[sum_k[in_sum], :6].T
+                self._mirror[:6, gs_m[in_sum]] = (
+                    vals_np[sum_k[in_sum], :6].T
+                )
         if vals_np is not None and len(sum_src):
             # fast-lane invalidation, batch-wide: rows approaching an
             # int32 lane limit or streaming a snapshot re-run the full
@@ -1651,17 +2236,20 @@ class ColocatedVectorEngine(VectorStepEngine):
             # plan_ok for a row the loop later skips only forces one
             # extra full plan.  (The fallback gather pads vals to a
             # bucket; only the first len(sum_src) rows are real.)
-            v = np.asarray(vals_np)[: len(sum_src)]
+            v = vals_np[: len(sum_src)]
             over = (
                 (v[:, _R_TERM] > _LIM_SOFT) | (v[:, _R_LAST] > _LIM_SOFT)
             )
             if over.any():
+                # raftlint: ignore[sync-budget] host numpy row ids, not a device readback
                 self._lanes.plan_ok[np.asarray(sum_src)[over]] = False
         if len(need_rows):
             self._lanes.plan_ok[need_rows] = False
         # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
         snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
         for j, (node, g, si) in enumerate(live):
+            if early_done[j]:
+                continue  # fully handled by the early commit pass
             # a STOPPING node still merges and persists this launch's
             # results: its device acks were already routed to peers in
             # this very launch, and dropping the corresponding append
@@ -1757,6 +2345,11 @@ class ColocatedVectorEngine(VectorStepEngine):
 
         lanes = [t for t in snapshot_sends if t[2] is not None]
         if lanes:
+            # applied to the CURRENT state handle — possibly one
+            # generation past the one that flagged the need.  Benign:
+            # the need flag re-fires while the condition persists, the
+            # lane write is idempotent, and at most one extra probe
+            # volley reaches a peer already being streamed to
             self._state = _set_remote_snapshot(
                 self._state,
                 self._put(jnp.asarray(_pad_idx([t[0] for t in lanes]))),
@@ -1767,18 +2360,9 @@ class ColocatedVectorEngine(VectorStepEngine):
         if below:
             # the durable snapshot sits below the shard base (see
             # VectorStepEngine._send_snapshots): these rows take a host
-            # excursion until the install resolves; drain their routed
-            # traffic first so the transition loses no messages
-            self._evict_rows_to_host(
-                sorted({t[0] for t in below}), "snapshot_below"
-            )
-            for g, p, _, pid, ss_index in below:
-                meta = self._meta.get(g)
-                if meta is None or meta.node.stopped:
-                    continue
-                rm = meta.node.peer.raft.get_remote(pid)
-                if rm is not None:
-                    rm.become_snapshot(ss_index)
+            # excursion — a membership mutation, so it runs at the next
+            # depth-0 point (_apply_snapshot_below), never mid-merge
+            self._deferred.append(("below", below))
 
         if self._pending_live:
             # in-flight routed traffic: wake every ALIVE resident

@@ -10,6 +10,14 @@ submission, admission control, and CheckQuorum lease reads that skip
 the per-read ReadIndex quorum round trip (docs/GATEWAY.md).  Run:
 
     python examples/kv_gateway.py
+
+When the backing NodeHosts run the colocated device engine, client
+latency also rides the launch pipeline: generations double-buffer by
+default (``DRAGONBOAT_TPU_PIPELINE_DEPTH``, default 2) and the
+TPU-tunnel sync-latency model is reproducible on CPU via
+``DRAGONBOAT_TPU_SYNC_FLOOR_MS`` (e.g. ``=100`` for the measured
+~100 ms floor) — see docs/BENCH_NOTES_r07.md for the serial-vs-
+pipelined ledger.
 """
 from __future__ import annotations
 

@@ -12,6 +12,16 @@ the leader by hand, ``sync_propose``/``sync_read`` per call) to keep
 the SM-tier mechanics in focus.  For the production client path —
 session handles, leader routing, admission control, lease reads — see
 examples/kv_gateway.py and docs/GATEWAY.md.
+
+NOTE on the device launch pipeline: when these NodeHosts share a
+``ColocatedEngineGroup`` (the product device path), generations are
+double-buffered by default — the merge tail runs one generation behind
+the device so a remote link's per-sync latency overlaps the next
+launch.  Two knobs make it reproducible without hardware:
+``DRAGONBOAT_TPU_PIPELINE_DEPTH`` (2 = double-buffered, 1 = the old
+serial loop) and ``DRAGONBOAT_TPU_SYNC_FLOOR_MS`` (simulated-tunnel
+readback latency, e.g. 100 for the measured TPU-tunnel floor) — see
+docs/BENCH_NOTES_r07.md and ``bench.py phase_pipeline``.
 """
 from __future__ import annotations
 

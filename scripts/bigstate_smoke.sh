@@ -137,7 +137,16 @@ try:
         h.transport.snapshot_pacer.throttled_seconds
         for h in live.values() if h.transport.snapshot_pacer is not None
     )
-    assert sbytes >= STATE_MB << 20, (sbytes, STATE_MB << 20)       # (1)
+    # (1) — the full state rode the capped stream.  A killed-then-
+    # resumed transfer legitimately undercounts: the resume cursor
+    # SEEKS past chunks the receiver already persisted, and the killed
+    # attempt's tail may die before its counter fold, so tolerate up
+    # to 2MB of resume-skipped prefix (observed ~1MB deficits under
+    # load; completeness itself is pinned by the stale_read catch-up
+    # assert above — this bound only proves the data moved through
+    # THIS stream, not some other path)
+    floor_b = (STATE_MB << 20) - (2 << 20 if resumes else 0)
+    assert sbytes >= floor_b, (sbytes, floor_b)
     assert throttled > 0, "token bucket never engaged"              # (1)
     eff = sbytes / caught_s
     assert eff <= 1.35 * CAP, f"cap violated: {eff/1e6:.1f}MB/s"    # (2)

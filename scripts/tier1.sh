@@ -6,7 +6,10 @@
 # session proposals, lease reads, routing convergence, overload
 # shedding), the big-state smoke (scripts/bigstate_smoke.sh, ~5s:
 # capped resumable snapshot stream, cap respected, commit p50 held,
-# mid-transfer kill resumes) and the static-analysis gates + analyzer
+# mid-transfer kill resumes), the launch-pipeline smoke
+# (scripts/pipeline_smoke.sh, ~5s: depth-2 double buffering at a 10ms
+# simulated sync floor, overlap counter > 0, all futures complete,
+# parity green) and the static-analysis gates + analyzer
 # self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
 # Prints
 # DOTS_PASSED=<n> and a TIER1_BUDGET runtime line against the 870s
@@ -25,5 +28,6 @@ echo "TIER1_BUDGET: pytest ${total}s of 870s (headroom ${headroom}s)${warn}"
 timeout -k 10 120 bash scripts/obs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/gateway_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/bigstate_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/pipeline_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

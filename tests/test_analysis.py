@@ -564,6 +564,91 @@ def test_host_loop_real_tree_colocated_annotation_is_live():
 
 
 # ---------------------------------------------------------------------------
+# sync-budget (# sync-hot launch-pipeline functions: one readback per
+# generation — docs/BENCH_NOTES_r07.md)
+# ---------------------------------------------------------------------------
+SYNC_BUDGET_SRC = '''
+import numpy as np
+import jax
+
+def _complete(dev, vals):  # sync-hot
+    a = np.asarray(dev)            # bare readback: flagged
+    b = jax.device_get(dev)        # flagged
+    c = dev.item()                 # flagged
+    return a, b, c
+
+def _unmarked(dev):
+    return np.asarray(dev)         # unmarked functions are free
+
+def _sanctioned(dev):  # sync-hot
+    # raftlint: ignore[sync-budget] the launch blob readback
+    head = np.asarray(dev)
+    return head
+'''
+
+
+def test_sync_budget_catches_bare_syncs():
+    fs = lint_source(SYNC_BUDGET_SRC, "dragonboat_tpu/ops/colocated.py")
+    assert rules_of(fs) == {"sync-budget"} and len(fs) == 3, fs
+    flagged = [SYNC_BUDGET_SRC.splitlines()[f.line - 1] for f in fs]
+    assert any("np.asarray(dev)" in ln and "bare" in ln for ln in flagged)
+    assert any("device_get" in ln for ln in flagged), flagged
+    assert any(".item()" in ln for ln in flagged), flagged
+
+
+def test_sync_budget_scoped_to_launch_modules_and_marked_funcs():
+    # other modules are out of scope; unmarked functions may sync
+    assert lint_source(SYNC_BUDGET_SRC, "dragonboat_tpu/obs/trace.py") == []
+    unmarked = SYNC_BUDGET_SRC.replace("  # sync-hot", "")
+    assert lint_source(unmarked, "dragonboat_tpu/ops/colocated.py") == []
+    # engine.py is in scope too (the fallback gather path lives there)
+    fs = lint_source(SYNC_BUDGET_SRC, "dragonboat_tpu/ops/engine.py")
+    assert rules_of(fs) == {"sync-budget"} and len(fs) == 3
+
+
+def test_sync_budget_point_ignore_sanctions_the_blob_readback():
+    # _sanctioned's annotated collect raises nothing; stripping the
+    # annotation must surface it (the ignore is live)
+    stripped = SYNC_BUDGET_SRC.replace(
+        "# raftlint: ignore[sync-budget]", "# nope"
+    )
+    fs = lint_source(stripped, "dragonboat_tpu/ops/colocated.py")
+    assert len(fs) == 4, fs
+
+
+def test_sync_budget_real_tree_annotation_is_live():
+    """The real colocated launch path is marked # sync-hot and lints
+    clean; stripping its point ignores must surface the blob collect —
+    the annotation is load-bearing, not decorative."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/colocated.py")
+    src = open(path).read()
+    assert "# sync-hot" in src
+    assert lint_source(src, "dragonboat_tpu/ops/colocated.py") == []
+    stripped = src.replace("# raftlint: ignore[sync-budget]", "# stripped")
+    fs = lint_source(stripped, "dragonboat_tpu/ops/colocated.py")
+    assert any(f.rule == "sync-budget" for f in fs), (
+        "stripping the sanctioned-readback ignores surfaced nothing"
+    )
+
+
+def test_sync_budget_real_tree_seeded_sync_is_caught():
+    """Seeding a stray device_get into the marked completion path must
+    surface — each stray sync is ~100 ms of tunnel time that defeats
+    the pipeline."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/colocated.py")
+    src = open(path).read()
+    needle = "        flags = head[:G]"
+    assert needle in src
+    seeded = src.replace(
+        needle,
+        "        junk = jax.device_get(rec.head_dev)\n" + needle,
+        1,
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/ops/colocated.py")
+    assert any(f.rule == "sync-budget" for f in fs)
+
+
+# ---------------------------------------------------------------------------
 # hygiene: import-hot, bare-except, thread-discipline
 # ---------------------------------------------------------------------------
 def test_import_hot_flags_function_level_imports_in_hot_modules():
