@@ -102,7 +102,9 @@ def phase_a(jax, GROUPS: int, iters: int) -> float:
         ent_cc=np.zeros((M, E, G), np.int32),
     )
 
-    dev = jax.devices()[0]
+    from dragonboat_tpu.ops.placement import default_device
+
+    dev = default_device(jax)
     # device_put packs the numpy transpose views into contiguous device
     # buffers (host-side copy, paid once)
     st = jax.device_put(
@@ -201,7 +203,9 @@ def phase_b(jax, GROUPS: int, warm_launches: int, timed_launches: int,
         shard_ids=shard_ids, replica_ids=replica_ids, peer_ids=peer_ids,
         election_timeout=10, heartbeat_timeout=2,
     )
-    dev = jax.devices()[0]
+    from dragonboat_tpu.ops.placement import default_device
+
+    dev = default_device(jax)
     st = jax.device_put(st, dev)
     dest = jax.device_put(jnp.asarray(dest), dev)
     rank = jax.device_put(jnp.asarray(rank), dev)
@@ -1377,6 +1381,294 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
     return report
 
 
+def _multichip_worker(n_dev: int, groups: int, rounds: int,
+                      launches: int) -> dict:
+    """One forced-host-device-count mechanism run (executes in a fresh
+    subprocess: the device count latches at first backend init).
+
+    The 1-core container cannot show wall-clock scaling, so this gates
+    on MECHANISM (ISSUE 12): (a) the sharded kernel/round is bit-exact
+    with the single-device one over the same global topology, (b) the
+    per-device group-tick counters balance within 10%, (c) the sharded
+    programs are host-transfer-free (the jaxcheck transfer rule over
+    registry.mesh_entry_points), and (d) cross-device raft traffic
+    really rides the collective lane (delivered > 0 at n_dev > 1,
+    zero lane drops at the xbudget_for sizing).
+    """
+    import time as _time
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — already initialized on cpu
+        pass
+    import functools
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from dragonboat_tpu.analysis import jaxcheck
+    from dragonboat_tpu.ops import registry as REG
+    from dragonboat_tpu.ops import route as R
+    from dragonboat_tpu.ops.kernel import (
+        inbox_to_internal,
+        make_step_sharded,
+        state_to_internal,
+        step_internal,
+    )
+    from dragonboat_tpu.ops.types import (
+        DeviceState,
+        Inbox,
+        MT_TICK,
+        ROLE_LEADER,
+        make_state,
+        make_state_np,
+    )
+
+    devs = [d for d in jax.devices() if d.platform == "cpu"][:n_dev]
+    if len(devs) < n_dev:
+        return {"n_devices": n_dev, "error": "too few host devices"}
+    mesh = Mesh(np.asarray(devs), ("groups",))
+    out: dict = {"n_devices": n_dev}
+
+    REPL = 3
+    G = groups * REPL
+
+    # ---- leg 1: phase-A mechanism (fused ticks, internal layout) -----
+    P, W, M, E, O = 3, 8, 4, 1, 8
+    TPL = 16  # ticks per slot
+    shard_ids = np.repeat(np.arange(1, groups + 1, dtype=np.int32), REPL)
+    replica_ids = np.tile(np.arange(1, REPL + 1, dtype=np.int32), groups)
+    peer_ids = np.broadcast_to(
+        np.arange(1, REPL + 1, dtype=np.int32), (G, P)
+    ).copy()
+    cols = make_state_np(
+        G, P, W,
+        shard_ids=shard_ids, replica_ids=replica_ids, peer_ids=peer_ids,
+        election_timeout=2 * TPL, heartbeat_timeout=2,
+    )
+    st0 = state_to_internal(DeviceState(**cols))
+    st0 = jax.tree.map(np.ascontiguousarray, st0)
+    zm = np.zeros((M, G), np.int32)
+    ib0 = Inbox(
+        mtype=np.full((M, G), MT_TICK, np.int32), from_id=zm, term=zm,
+        log_term=zm, log_index=np.full((M, G), TPL, np.int32), commit=zm,
+        reject=zm, hint=zm, hint_high=zm, n_entries=zm,
+        ent_term=np.zeros((M, E, G), np.int32),
+        ent_cc=np.zeros((M, E, G), np.int32),
+    )
+    step_single = jax.jit(
+        functools.partial(step_internal, out_capacity=O)
+    )
+    step_shard = make_step_sharded(
+        mesh, st0, ib0, out_capacity=O, internal=True
+    )
+    st_a, st_b = st0, st0
+    esc_dev = np.zeros((n_dev,), np.int64)
+    t0 = _time.perf_counter()
+    for _ in range(launches):
+        st_a, out_a = step_single(st_a, ib0)
+        st_b, out_b = step_shard(st_b, ib0)
+        esc_dev += np.asarray(out_b.escalate).reshape(n_dev, -1).sum(1)
+    jax.block_until_ready(st_b)
+    dt = _time.perf_counter() - t0
+    a_ok = all(
+        np.array_equal(np.asarray(getattr(st_a, f)),
+                       np.asarray(getattr(st_b, f)))
+        for f in st_a._fields
+    )
+    gl = G // n_dev
+    ticks_dev = (gl // REPL) * launches * M * TPL - esc_dev // REPL * M * TPL
+    out["phase_a"] = {
+        "parity_ok": bool(a_ok),
+        "launches": launches,
+        "group_ticks_per_sec": round(groups * launches * M * TPL / dt, 1),
+        "per_device_group_ticks": [int(x) for x in ticks_dev],
+        "balance_ratio": round(
+            float(ticks_dev.max() / max(1, ticks_dev.min())), 4
+        ),
+    }
+
+    # ---- leg 2: routed commit loop with the collective lane ----------
+    # REPLICA-MAJOR layout: group i's replicas live at rows
+    # {i, groups+i, 2*groups+i} — at n_dev > 1 every group straddles
+    # device blocks, so ALL raft traffic crosses the lane (the maximal
+    # mechanism stress; production placement colocates — this is the
+    # proof the lane carries real elections/commits, not the layout
+    # recommendation)
+    P2, W2, E2, O2, BUD, BASE = 3, 16, 2, 16, 4, 2
+    M2 = BASE + P2 * BUD
+    sh2 = np.tile(np.arange(1, groups + 1, dtype=np.int32), REPL)
+    rp2 = np.repeat(np.arange(1, REPL + 1, dtype=np.int32), groups)
+    pe2 = np.broadcast_to(
+        np.arange(1, REPL + 1, dtype=np.int32), (G, P2)
+    ).copy()
+    tabs = R.build_route_tables_mesh(sh2, rp2, pe2, n_dev)
+    XB = R.xbudget_for(tabs, BUD, n_dev)
+    dest, rank = R.build_route_tables(sh2, rp2, pe2)
+    st = make_state(
+        G, P2, W2, shard_ids=sh2, replica_ids=rp2, peer_ids=pe2,
+        election_timeout=10, heartbeat_timeout=2,
+    )
+    ib = R.make_prefill(st, M2, E2)
+    round_single = jax.jit(functools.partial(
+        R.routed_round, out_capacity=O2, budget=BUD, base=BASE,
+        propose_leaders=True,
+    ))
+    round_shard = R.make_sharded_round(
+        mesh, M=M2, E=E2, out_capacity=O2, budget=BUD, xbudget=XB,
+        base=BASE, propose_leaders=True,
+    )
+    dl, dd, rk = (jnp.asarray(tabs.dest_local), jnp.asarray(tabs.dest_dev),
+                  jnp.asarray(tabs.rank_in_dest))
+    dj, rj = jnp.asarray(dest), jnp.asarray(rank)
+    st_r, ib_r = st, ib
+    st_s, ib_s = st, ib
+    lane_dev = np.zeros((n_dev, 7), np.int64)
+    t0 = _time.perf_counter()
+    for _ in range(rounds):
+        st_r, ib_r, _stats, _nesc = round_single(st_r, ib_r, dj, rj)
+        st_s, ib_s, _sstats, lane = round_shard(st_s, ib_s, dl, dd, rk)
+        lane_dev += np.asarray(lane, np.int64)
+    jax.block_until_ready(st_s)
+    dt = _time.perf_counter() - t0
+    r_ok = all(
+        np.array_equal(np.asarray(getattr(st_r, f)),
+                       np.asarray(getattr(st_s, f)))
+        for f in st._fields
+    ) and all(
+        np.array_equal(np.asarray(getattr(ib_r, f)),
+                       np.asarray(getattr(ib_s, f)))
+        for f in ib._fields
+    )
+    commits = np.asarray(st_s.committed).reshape(REPL, groups).max(0)
+    commit_dev = (
+        np.asarray(st_s.committed).reshape(n_dev, gl).sum(1)
+    )
+    rows_live = lane_dev[:, 6]
+    out["routed"] = {
+        "parity_ok": bool(r_ok),
+        "rounds": rounds,
+        "xbudget": XB,
+        "leaders": int((np.asarray(st_s.role) == ROLE_LEADER).sum()),
+        "groups_committing": int((commits > 0).sum()),
+        "cross_delivered": int(lane_dev[:, 1].sum()),
+        "cross_dropped_xlane": int(lane_dev[:, 3].sum()),
+        "cross_dropped_ring": int(lane_dev[:, 4].sum()),
+        "escalations": int(lane_dev[:, 5].sum()),
+        "per_device_commit_sum": [int(x) for x in commit_dev],
+        "per_device_rows_live": [int(x) for x in rows_live],
+        "balance_ratio": round(
+            float(rows_live.max() / max(1, rows_live.min())), 4
+        ),
+        "rounds_per_sec": round(rounds / dt, 2),
+    }
+
+    # ---- leg 3: transfer-free gate over the sharded entry points -----
+    findings = jaxcheck.audit(entries=REG.mesh_entry_points(mesh))
+    out["jaxcheck"] = {
+        "transfer_findings": sum(
+            1 for f in findings if f.rule == "transfer"
+        ),
+        "total_findings": len(findings),
+        "detail": [f.render() for f in findings][:8],
+    }
+    out["ok"] = bool(
+        a_ok
+        and r_ok
+        and out["phase_a"]["balance_ratio"] <= 1.1
+        and out["routed"]["balance_ratio"] <= 1.1
+        and out["jaxcheck"]["transfer_findings"] == 0
+        and out["routed"]["cross_dropped_xlane"] == 0
+        and (n_dev == 1 or out["routed"]["cross_delivered"] > 0)
+        and out["routed"]["groups_committing"] == groups
+    )
+    return out
+
+
+def phase_multichip(jax=None) -> dict:
+    """Multi-chip device-plane mechanism bench (ISSUE 12 / ROADMAP 3).
+
+    Runs the sharded launch path at 1-8 FORCED HOST DEVICES
+    (``--xla_force_host_platform_device_count``, the mechanism the
+    MULTICHIP_r0*.json harness proves) — each count in a fresh
+    subprocess because the device count latches at first backend init.
+    Gates on mechanism, not wall-clock (1-core container): bit-exact
+    sharded/single-device parity for both the fused-tick phase-A loop
+    and the routed commit loop, per-device group-tick balance within
+    10%, transfer-free sharded programs (jaxcheck), and live
+    cross-device traffic on the collective lane.  The ~8e9 aggregate
+    group-ticks/sec and 1M-group election numbers remain the recorded
+    first-hardware targets (docs/MULTICHIP.md checklist).
+
+    Env: BENCH_MULTICHIP_DEVICES (default "1,2,4,8"),
+    BENCH_MULTICHIP_GROUPS (default 64; must divide by 8*... the row
+    count 3*groups must divide every device count),
+    BENCH_MULTICHIP_ROUNDS (default 64), BENCH_MULTICHIP_LAUNCHES
+    (default 6), BENCH_MULTICHIP_TIMEOUT per count (default 420s).
+    """
+    import json as _json
+    import subprocess
+    import sys
+
+    counts = [
+        int(x)
+        for x in os.environ.get(
+            "BENCH_MULTICHIP_DEVICES", "1,2,4,8"
+        ).split(",")
+        if x.strip()
+    ]
+    groups = int(os.environ.get("BENCH_MULTICHIP_GROUPS", "64"))
+    rounds = int(os.environ.get("BENCH_MULTICHIP_ROUNDS", "64"))
+    launches = int(os.environ.get("BENCH_MULTICHIP_LAUNCHES", "6"))
+    timeout = int(os.environ.get("BENCH_MULTICHIP_TIMEOUT", "420"))
+    results = []
+    for n in counts:
+        env = dict(os.environ)
+        kept = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            kept + [f"--xla_force_host_platform_device_count={max(n, 1)}"]
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        code = (
+            "import json, bench;"
+            f"print('MCW ' + json.dumps(bench._multichip_worker("
+            f"{n}, {groups}, {rounds}, {launches})))"
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout,
+                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            row = None
+            for line in (proc.stdout or "").splitlines():
+                if line.startswith("MCW "):
+                    row = _json.loads(line[4:])
+            if row is None:
+                row = {
+                    "n_devices": n,
+                    "error": (proc.stderr or "no output")[-800:],
+                }
+        except subprocess.TimeoutExpired:
+            row = {"n_devices": n, "error": f"timeout {timeout}s"}
+        results.append(row)
+    return {
+        "mechanism_gate": all(r.get("ok") for r in results),
+        "by_devices": results,
+        # first-hardware targets recorded, not measured here (1-core
+        # container; docs/MULTICHIP.md "Hardware-run checklist")
+        "hardware_targets": {
+            "aggregate_group_ticks_per_sec": 8e9,
+            "election_groups_one_host": 1_000_000,
+        },
+    }
+
+
 def phase_balance(
     shards: int = 16,
     hosts: int = 4,
@@ -1927,7 +2219,7 @@ def main() -> None:
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
              gateway=None, bigstate=None, hostplane=None,
-             pipeline=None) -> None:
+             pipeline=None, multichip=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -1980,6 +2272,10 @@ def main() -> None:
                     # serial-vs-depth-2 committed/sec + probe p50 at
                     # simulated sync floors — docs/BENCH_NOTES_r07.md)
                     "pipeline": pipeline,
+                    # r14 schema addition: multi-chip mechanism guard
+                    # (shard_map G-sharding + collective exchange lane
+                    # at 1-8 forced host devices — docs/MULTICHIP.md)
+                    "multichip": multichip,
                 }
             ),
             flush=True,
@@ -2222,6 +2518,19 @@ def main() -> None:
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb, hpb, ppb)
 
+    # Multi-chip mechanism guard: sharded kernel/round parity + balance
+    # + transfer-free gates at forced host device counts (BENCH_MULTICHIP
+    # gate; the phase spawns its OWN per-count subprocesses, so it runs
+    # in-process here rather than through run_sub)
+    mcb = None
+    if bool(int(os.environ.get("BENCH_MULTICHIP", "1"))) and remaining() > 200:
+        try:
+            mcb = phase_multichip()
+        except Exception as e:  # noqa: BLE001 — the guard must not kill main
+            mcb = {"error": str(e)[-400:]}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb, ppb, mcb)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -2254,4 +2563,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if "phase_multichip" in _sys.argv[1:]:
+        # standalone mechanism run: `python bench.py phase_multichip`
+        # (spawns its own per-device-count subprocesses; no backend is
+        # initialized in THIS process, so the forced counts latch)
+        print("BENCHMC " + json.dumps(phase_multichip()), flush=True)
+    else:
+        main()

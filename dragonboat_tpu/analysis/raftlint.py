@@ -76,6 +76,16 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     the documented scalar fallbacks and parity oracles (``*_scalar``
     twins in ops/hostplane.py).
 
+``mesh-loop``
+    The multi-chip launch path (functions marked ``# mesh-hot`` in the
+    ops/ modules — the shard_map wrappers and their callers,
+    docs/MULTICHIP.md) must stay free of per-device host work: the
+    whole point of the sharded entry points is ONE dispatch for all
+    chips, so a Python loop over ``jax.devices()``/``mesh.devices``
+    or a ``jax.device_put``/``jax.device_get`` inside them re-opens
+    the per-device host hop the collective lane exists to remove.
+    Trace-time loops over static ranges (ring-shift unrolls) are fine.
+
 ``sync-budget``
     In the colocated launch path (``ops/colocated.py``,
     ``ops/engine.py``), a function whose ``def`` line carries a
@@ -190,6 +200,16 @@ SYNC_BUDGET_MODULES = (
 )
 SYNC_HOT_RE = re.compile(r"#\s*sync-hot\b")
 
+# the multi-chip launch path: `# mesh-hot` functions dispatch ONE
+# program for every chip — no per-device Python (docs/MULTICHIP.md)
+MESH_MODULES = (
+    "dragonboat_tpu/ops/kernel.py",
+    "dragonboat_tpu/ops/route.py",
+    "dragonboat_tpu/ops/engine.py",
+    "dragonboat_tpu/ops/colocated.py",
+)
+MESH_HOT_RE = re.compile(r"#\s*mesh-hot\b")
+
 # attributes whose read is a static (trace-time, host-free) fact
 _STATIC_FACT_ATTRS = {"shape", "ndim", "size", "dtype"}
 _NUMPY_ALIASES = {"np", "numpy", "_np"}
@@ -299,12 +319,14 @@ class _Linter(ast.NodeVisitor):
         self.check_sync_budget = _module_matches(
             self.relpath, SYNC_BUDGET_MODULES
         )
+        self.check_mesh = _module_matches(self.relpath, MESH_MODULES)
         # count of enclosing `# gateway-hot` / `# hostplane-hot` /
         # `# sync-hot` functions (nested defs inside a hot function
         # inherit the discipline)
         self._hot_depth = 0
         self._hp_depth = 0
         self._sync_depth = 0
+        self._mesh_depth = 0
         # file-wide guarded fields: attr -> (lock attr, defining func node)
         self.guarded: Dict[str, Tuple[str, Optional[ast.AST]]] = {}
         # module-level struct.Struct assignments: name -> Q slot indices
@@ -456,6 +478,11 @@ class _Linter(ast.NodeVisitor):
         )
         if sh:
             self._sync_depth += 1
+        mh = self.check_mesh and bool(
+            MESH_HOT_RE.search(self._line(node.lineno))
+        )
+        if mh:
+            self._mesh_depth += 1
         self._func_stack.append(node)
         try:
             self.generic_visit(node)
@@ -469,6 +496,8 @@ class _Linter(ast.NodeVisitor):
                 self._hp_depth -= 1
             if sh:
                 self._sync_depth -= 1
+            if mh:
+                self._mesh_depth -= 1
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_func(node)
@@ -577,6 +606,8 @@ class _Linter(ast.NodeVisitor):
             self._check_stream_read(node)
         if self._sync_depth:
             self._check_sync_budget(node)
+        if self._mesh_depth:
+            self._check_mesh_call(node)
         self._check_thread(node)
         self.generic_visit(node)
 
@@ -834,6 +865,7 @@ class _Linter(ast.NodeVisitor):
 
     def visit_For(self, node: ast.For) -> None:
         self._check_host_loop(node, "`for` loop")
+        self._check_mesh_loop(node)
         self.generic_visit(node)
 
     def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
@@ -855,6 +887,51 @@ class _Linter(ast.NodeVisitor):
     def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
         self._check_host_loop(node, "generator expression")
         self.generic_visit(node)
+
+    # ---- mesh-loop (per-device host work in # mesh-hot functions) -------
+
+    @staticmethod
+    def _mentions_devices(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                "devices", "local_devices", "device_set",
+            ):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in (
+                "devices", "local_devices",
+            ):
+                return True
+        return False
+
+    def _check_mesh_loop(self, node) -> None:
+        if not self._mesh_depth or self._func_exempt("mesh-loop"):
+            return
+        if self._mentions_devices(node.iter):
+            self._emit(
+                "mesh-loop",
+                node.lineno,
+                "Python iteration over devices inside a # mesh-hot "
+                "function — the sharded launch path dispatches ONE "
+                "program for every chip (docs/MULTICHIP.md); per-device "
+                "host loops re-open the host hop the collective lane "
+                "removes",
+            )
+
+    def _check_mesh_call(self, node: ast.Call) -> None:
+        if not self._mesh_depth or self._func_exempt("mesh-loop"):
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in (
+            "device_put", "device_get",
+        ):
+            self._emit(
+                "mesh-loop",
+                node.lineno,
+                f"`{f.attr}` inside a # mesh-hot function — host<->device "
+                "transfers belong outside the sharded launch path "
+                "(docs/MULTICHIP.md; the transfer-free gate is also "
+                "machine-checked by jaxcheck over the mesh entries)",
+            )
 
     # ---- hygiene --------------------------------------------------------
 

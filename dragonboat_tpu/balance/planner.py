@@ -95,14 +95,30 @@ class Planner:
     # -- helpers ---------------------------------------------------------
     @staticmethod
     def _pick_least_loaded(
-        counts: Dict[str, int], exclude, rng: Random
+        counts: Dict[str, int], exclude, rng: Random,
+        chips: Optional[Dict[str, int]] = None,
     ) -> Optional[str]:
         """Least-loaded candidate host; ties broken by sorted key, the
         rng only shuffles among EXACT ties to avoid always hammering
         the lexically-first host (deterministic: same seed, same draw
-        sequence)."""
+        sequence).
+
+        ``chips`` weights load by per-host chip capacity (the
+        multi-chip placement dimension, ROADMAP 3): an 8-chip host
+        should carry ~8x a 1-chip host's replicas, so candidates rank
+        by count/chips — compared exactly via cross-multiplication
+        against the chip LCM-free integer key count*K/chips where K is
+        the product-free common scale (count * prod(other chips) is
+        overkill; count * SCALE // chips with SCALE = lcm-ish 10^6 is
+        ample for integral determinism at any real fleet size)."""
+        if chips:
+            def key(h, c):
+                return (c * 1_000_000) // max(1, chips.get(h, 1))
+        else:
+            def key(h, c):
+                return c
         cands = sorted(
-            (c, h) for h, c in counts.items() if h not in exclude
+            (key(h, c), h) for h, c in counts.items() if h not in exclude
         )
         if not cands:
             return None
@@ -122,6 +138,12 @@ class Planner:
         moves: List[Move] = []
         if not targets:
             return MovePlan(moves)
+        # per-host chip capacities (all 1 on single-chip fleets, where
+        # every decision below is byte-identical to the unweighted
+        # planner); None disables the weighting entirely
+        chips = {h: view.chips_of(h) for h in targets}
+        if all(n <= 1 for n in chips.values()):
+            chips = None
         draining = set(view.draining)
         alive = set(view.hosts)
         counts = {h: 0 for h in targets}
@@ -186,7 +208,7 @@ class Planner:
                     if leader_at[s.shard_id] == src_host:
                         leader_at[s.shard_id] = ""  # raft re-elects
                     continue
-                dst = self._pick_least_loaded(counts, set(pl), rng)
+                dst = self._pick_least_loaded(counts, set(pl), rng, chips)
                 if dst is None:
                     # every target already holds the shard (fewer
                     # survivors than the factor): the drain invariant
@@ -210,7 +232,7 @@ class Planner:
                 self.replication_factor, len(targets)
             ):
                 pl = placement[s.shard_id]
-                dst = self._pick_least_loaded(counts, set(pl), rng)
+                dst = self._pick_least_loaded(counts, set(pl), rng, chips)
                 if dst is None:
                     break
                 new_rid = next_id[s.shard_id]
@@ -260,10 +282,22 @@ class Planner:
 
         # -- 3. spread: member counts within ±1 across targets ----------
         if self.balance_replicas and len(counts) > 1:
+            # per-chip load when chip capacities differ: hi/lo rank by
+            # count/chips (exact integer key), and a move happens only
+            # while it cannot overshoot — the donor's per-chip load
+            # AFTER the move stays >= the recipient's (exact
+            # cross-multiplication).  With all chips equal (any value,
+            # not just 1) this is bit-for-bit the old count diff <= 1
+            ch = chips or {}
+
+            def _load(h):
+                return (counts[h] * 1_000_000) // max(1, ch.get(h, 1))
+
             for _ in range(len(view.shards) * len(targets)):
-                hi = max(sorted(counts), key=lambda h: counts[h])
-                lo = min(sorted(counts), key=lambda h: counts[h])
-                if counts[hi] - counts[lo] <= 1:
+                hi = max(sorted(counts), key=_load)
+                lo = min(sorted(counts), key=_load)
+                c_hi, c_lo = max(1, ch.get(hi, 1)), max(1, ch.get(lo, 1))
+                if (counts[hi] - 1) * c_lo < (counts[lo] + 1) * c_hi:
                     break
                 # move a shard from hi to lo; prefer non-leader replicas
                 # (cheaper move: no transfer leg)

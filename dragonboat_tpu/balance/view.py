@@ -34,6 +34,9 @@ class ReplicaView:
     host: str          # host key (raft address)
     applied: int = 0
     is_leader: bool = False
+    # chip coordinate of the replica's engine row on its host (-1:
+    # host path / single device) — docs/MULTICHIP.md "Placement"
+    device: int = -1
 
 
 @dataclass(frozen=True)
@@ -91,6 +94,17 @@ class ClusterView:
     hosts: Tuple[str, ...]             # alive hosts, sorted
     draining: Tuple[str, ...]          # sorted subset being drained
     shards: Tuple[ShardView, ...]      # sorted by shard_id
+    # per-host chip count (sorted (host, chips) pairs; hosts absent
+    # here count as 1 chip) — the planner's capacity weights for the
+    # multi-chip placement dimension (docs/MULTICHIP.md "Placement").
+    # Default empty keeps single-chip fleets byte-identical.
+    chips: Tuple[Tuple[str, int], ...] = ()
+
+    def chips_of(self, host: str) -> int:
+        for h, n in self.chips:
+            if h == host:
+                return max(1, n)
+        return 1
 
     def target_hosts(self) -> Tuple[str, ...]:
         """Hosts moves may land on: alive and not draining."""
@@ -133,8 +147,15 @@ class ClusterView:
         }
 
     def describe(self) -> str:
+        # chips appear in the canonical byte-form only when some host
+        # is genuinely multi-chip: single-chip fleets keep the exact
+        # pre-mesh describe() bytes (determinism baselines)
+        chips = ""
+        if any(n > 1 for _, n in self.chips):
+            chips = f" chips={sorted(self.chips)!r}"
         return (
-            f"hosts={list(self.hosts)!r} draining={list(self.draining)!r}\n"
+            f"hosts={list(self.hosts)!r} draining={list(self.draining)!r}"
+            f"{chips}\n"
             + "\n".join(s.describe() for s in self.shards)
         )
 
@@ -211,6 +232,7 @@ class Collector:
                         applied=row["applied"],
                         is_leader=(row["leader_id"] == row["replica_id"]
                                    and row["leader_id"] != 0),
+                        device=row.get("device", -1),
                     )
                     for key, row in rows
                 ),
@@ -267,8 +289,20 @@ class Collector:
                     proposal_rate=max(0, total - prev),
                 )
             )
+        chips = []
+        for key in alive:
+            fn = getattr(hosts.get(key), "device_chip_count", None)
+            if fn is None:
+                continue
+            try:
+                n = int(fn())
+            except Exception:  # noqa: BLE001 — host closing mid-collect
+                n = 1
+            if n > 1:
+                chips.append((key, n))
         return ClusterView(
             hosts=tuple(alive),
             draining=tuple(sorted(set(draining))),
             shards=tuple(shard_views),
+            chips=tuple(sorted(chips)),
         )

@@ -94,6 +94,18 @@ class IStepEngine(abc.ABC):
         for s in shard_ids:
             self.detach(s)
 
+    def device_coordinate(self, shard_id: int):
+        """Device/chip coordinate hosting this shard's engine row, or
+        None when unknown (host path, no mesh).  Mesh-capable engines
+        override (VectorStepEngine); the balance plane reads it through
+        ExecEngine so chip placement becomes a planner dimension
+        (ROADMAP 3 / docs/MULTICHIP.md "Placement")."""
+        return None
+
+    def device_chip_count(self) -> int:
+        """Chips this engine spreads rows over (1 = single device)."""
+        return 1
+
 
 class HostStepEngine(IStepEngine):
     """Default serial step loop with cross-shard batched WAL writes."""
@@ -214,6 +226,13 @@ class ExecEngine:
 
     def notify(self, shard_id: int) -> None:
         self.step_ready.notify(shard_id)
+
+    # -- placement -> device coordinate (the balance plane's chip axis) --
+    def device_coordinate(self, shard_id: int):
+        return self.step_engine.device_coordinate(shard_id)
+
+    def device_chip_count(self) -> int:
+        return self.step_engine.device_chip_count()
 
     def notify_many(self, shard_ids) -> None:
         self.step_ready.notify_all(shard_ids)

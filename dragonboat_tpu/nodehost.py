@@ -1046,6 +1046,7 @@ class NodeHost:
         for n in nodes:
             if n.stopped or n.stopping:
                 continue
+            dev = self.engine.device_coordinate(n.shard_id)
             out.append(
                 {
                     "shard_id": n.shard_id,
@@ -1055,9 +1056,18 @@ class NodeHost:
                     "applied": n.sm.last_applied,
                     "proposals": n.proposal_count,
                     "membership": n.get_membership(),
+                    # chip coordinate of the engine row (None: host
+                    # path / no mesh) — the balance plane's new
+                    # placement dimension (docs/MULTICHIP.md)
+                    "device": -1 if dev is None else dev,
                 }
             )
         return out
+
+    def device_chip_count(self) -> int:
+        """Chips this host's step engine spreads rows over (collector
+        input for the per-chip-capacity balance dimension)."""
+        return self.engine.device_chip_count()
 
     def raft_address(self) -> str:
         return self.config.raft_address

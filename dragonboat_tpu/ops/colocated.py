@@ -438,11 +438,12 @@ class _InFlightGen:
 
     __slots__ = (
         "batch", "staging", "alive_np", "batch_gs", "prop_gs", "caps",
-        "merged", "out", "head_dev", "detail_dev", "t_req",
+        "merged", "out", "head_dev", "detail_dev", "t_req", "tick_fed",
     )
 
     def __init__(self, *, batch, staging, alive_np, batch_gs, prop_gs,
-                 caps, merged, out, head_dev, detail_dev, t_req):
+                 caps, merged, out, head_dev, detail_dev, t_req,
+                 tick_fed=None):
         self.batch = batch
         self.staging = staging
         self.alive_np = alive_np
@@ -454,6 +455,7 @@ class _InFlightGen:
         self.head_dev = head_dev
         self.detail_dev = detail_dev
         self.t_req = t_req
+        self.tick_fed = tick_fed or {}
 
 
 class ColocatedVectorEngine(VectorStepEngine):
@@ -587,6 +589,74 @@ class ColocatedVectorEngine(VectorStepEngine):
     def _compute_base(self, r) -> int:
         # the SHARD's shared base, not a per-row quantity — see __init__
         return self._shard_base.get(r.shard_id, 0)
+
+    def _lease_pass(self, live, flags, vals_np, pos_sum,
+                    tick_fed) -> None:
+        """Per-generation device-lease evidence pass (ROADMAP 4b): see
+        hostplane.LeaseLanes.  Runs before the bulk mirror write (role
+        transitions read the OLD mirror) and before per-row tick
+        bookkeeping (window starts stamp the pre-launch clock — the
+        conservative side)."""
+        for node, g, si in live:
+            if node.stopped or self._meta.get(g) is None:
+                continue
+            r = node.peer.raft
+            if vals_np is not None:
+                k = int(pos_sum[g])
+                if k >= 0:
+                    role = int(vals_np[k, _R_ROLE])
+                    if role != int(self._mirror[_R_ROLE, g]):
+                        if (
+                            role == int(RaftRole.LEADER)
+                            and r.check_quorum
+                        ):
+                            self._lease.arm(g, r.election_timeout, 0)
+                        else:
+                            self._lease.disarm(g)
+            a = self._lease.row_step(
+                g, tick_fed.get(g, 0), node.tick_count, int(flags[g])
+            )
+            if a >= 0:
+                r.anchor_quorum_evidence(a)
+
+    def device_coordinate(self, shard_id: int, replica_id=None):
+        if self._mesh is None:
+            return None
+        if replica_id is None:
+            gs = [
+                g for (s, _r), g in self._row_of.items() if s == shard_id
+            ]
+            g = min(gs) if gs else None
+        else:
+            g = self._row_of.get((shard_id, replica_id))
+        if g is None:
+            return None
+        return g // (self.capacity // self._mesh.size)
+
+    def _pick_row(self, node) -> int:
+        """Mesh-mode shard affinity: place a shard's replicas on the
+        device block already hosting the shard, so a shard's commit
+        rounds route intra-device and only cross-SHARD load spreads
+        over the mesh (docs/MULTICHIP.md "Placement").  The scan is
+        bounded to the free-list tail — with the striped base order the
+        tail alternates blocks, so the preferred block is almost always
+        within a few slots; after heavy churn it degrades gracefully to
+        the plain pop."""
+        if self._mesh is None:
+            return self._free.pop()
+        per = self.capacity // self._mesh.size
+        want = None
+        for (s, _r), g0 in self._row_of.items():
+            if s == node.shard_id:
+                want = g0 // per
+                break
+        if want is None:
+            return self._free.pop()
+        lo = max(0, len(self._free) - 4 * self._mesh.size)
+        for i in range(len(self._free) - 1, lo - 1, -1):
+            if self._free[i] // per == want:
+                return self._free.pop(i)
+        return self._free.pop()
 
     def _tier_caps(self, t: int) -> Dict[str, int]:
         return {k: min(self.capacity, v) for k, v in _SEL_TIERS[t].items()}
@@ -1686,7 +1756,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
         # staging keys in ASSEMBLED coordinates: the routed regions
         # (width P*B) come first, host slots after (see _assemble_inbox)
-        msg_rows, staging, prop_rows = self._encode_batch(
+        msg_rows, staging, prop_rows, tick_fed = self._encode_batch(
             batch, slot_offset=P * B
         )
         # compact host-inbox upload: tick-only rows (the overwhelming
@@ -1892,6 +1962,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             batch_gs=batch_gs, prop_gs=prop_gs, caps=caps,
             merged=merged, out=out, head_dev=head_dev,
             detail_dev=detail_dev, t_req=_time.monotonic(),
+            tick_fed=tick_fed,
         ))
 
     def _complete_generation(self, rec: _InFlightGen) -> List[Tuple]:  # sync-hot
@@ -2038,6 +2109,7 @@ class ColocatedVectorEngine(VectorStepEngine):
         )
         dev_ok = cover is not None
         early_done = np.zeros((len(batch) + len(sets.live_other),), bool)
+        lease_done = False
         if dev_ok:
             pos_buf, pos_slot, pos_need, pos_ring, pos_sum, sum_src = cover
             # live rows only: the padded capacity tail is garbage the
@@ -2045,6 +2117,11 @@ class ColocatedVectorEngine(VectorStepEngine):
             # ms/launch at storm-tier capacities (review finding)
             sel_vals = sel_vals[:n_sum_d]
             vals_np = sel_vals
+            # lease pass BEFORE the early pass: early rows run their
+            # tick bookkeeping inside _early_commit_pass, and window
+            # starts must stamp the PRE-launch clock (see _lease_pass)
+            self._lease_pass(live, flags, vals_np, pos_sum, rec.tick_fed)
+            lease_done = True
             # ---- EARLY completion: the commit-proving prefix --------
             # A live row with values but NO append/outbox/slot/need
             # sections (the common shape: a leader whose routed acks
@@ -2177,6 +2254,15 @@ class ColocatedVectorEngine(VectorStepEngine):
         self.stats["t_detail_ms"] += int(
             (_time.perf_counter() - _t0) * 1000
         )
+        # device-plane lease evidence (ROADMAP 4b): advance each batch
+        # row's CheckQuorum window mirror and anchor the scalar voting
+        # remotes when the quorum-active flag holds — BEFORE the bulk
+        # mirror write below so role transitions are still observable.
+        # The dev_ok path already ran this pass (pre-early-commit, so
+        # window starts stamp the pre-launch clock); running it again
+        # would feed tick_fed twice and halve the modeled window period.
+        if not lease_done:
+            self._lease_pass(live, flags, vals_np, pos_sum, rec.tick_fed)
         # one C-level conversion for the merge loop's 10-ints-per-row
         # reads (numpy scalar -> int costs ~100 ns each)
         vals_l = vals_np.tolist() if vals_np is not None else None
@@ -2393,6 +2479,14 @@ class _ColocatedFacade(IStepEngine):
         for n in nodes:
             self._replica_of[n.shard_id] = n.replica_id
         self.core.step_shards(nodes, worker_id)
+
+    def device_coordinate(self, shard_id: int):
+        return self.core.device_coordinate(
+            shard_id, self._replica_of.get(shard_id)
+        )
+
+    def device_chip_count(self) -> int:
+        return self.core.device_chip_count()
 
     def detach(self, shard_id: int) -> None:
         rid = self._replica_of.pop(shard_id, None)

@@ -62,10 +62,13 @@ from .types import (
     F_LOG_INDEX,
     F_MTYPE,
     F_N_ENTRIES,
+    F_QUORUM_ACTIVE,
     F_SRC_SLOT,
     F_TO,
     HOT_TYPES,
     I32,
+    KIND_VOTER,
+    KIND_WITNESS,
     RS_SNAPSHOT,
     SLOT_DROPPED,
     DeviceState,
@@ -186,6 +189,34 @@ def _summarize_flags(old: DeviceState, new: DeviceState, out) -> jnp.ndarray:
         peer_lane & (new.match < new.last_index[:, None]), axis=1
     )
     f = f | jnp.where(behind, _F_PEERS_BEHIND, 0)
+    # device-plane lease evidence (ROADMAP 4b): a CheckQuorum leader
+    # whose current activity window already holds a quorum of active
+    # voter lanes.  Mirrors kernel._check_quorum's count (self implicit
+    # + active non-self voters vs voting-member quorum); self must
+    # currently be a VOTER slot — witness/removed leaders serve no
+    # reads, matching Raft.quorum_responded_tick's membership gate.
+    voters = (new.peer_id != 0) & (
+        (new.peer_kind == KIND_VOTER) | (new.peer_kind == KIND_WITNESS)
+    )
+    n_voters = jnp.sum(voters, axis=1).astype(I32)
+    quorum = n_voters // 2 + 1
+    self_lane = (
+        jnp.arange(new.peer_id.shape[1])[None, :] == new.self_slot[:, None]
+    )
+    self_is_voter = jnp.any(
+        self_lane & (new.peer_id != 0) & (new.peer_kind == KIND_VOTER),
+        axis=1,
+    )
+    n_active = 1 + jnp.sum(
+        voters & ~self_lane & (new.active == 1), axis=1
+    ).astype(I32)
+    q_active = (
+        (new.role == ROLE_LEADER_I)
+        & (new.check_quorum == 1)
+        & self_is_voter
+        & (n_active >= quorum)
+    )
+    f = f | jnp.where(q_active, F_QUORUM_ACTIVE, 0)
     return f.astype(I32)
 
 
@@ -391,6 +422,16 @@ def _tick_bookkeeping(node, ticks: int) -> None:
     if not ticks:
         return
     node.tick_count += ticks
+    # the SCALAR raft's logical clock advances too: device-resident
+    # rows never call Raft.tick(), and a frozen r.tick_count poisons
+    # every wall-clock comparison made while resident — the CheckQuorum
+    # grace rate limit, the boot-lease grace, and (ROADMAP 4b) the
+    # lease math, where a device-window anchor stamped on the live node
+    # clock against a frozen raft clock OVERSTATES the lease by the
+    # whole residency.  The scalar path keeps the two clocks in
+    # lockstep (step_with_inputs ticks the raft, then advances the node
+    # clock by the same count); this is the device path's mirror.
+    node.peer.raft.tick_count += ticks
     node.pending_proposal.gc(node.tick_count)
     node.pending_read_index.gc(node.tick_count)
     node.pending_config_change.gc(node.tick_count)
@@ -516,7 +557,14 @@ class VectorStepEngine(IStepEngine):
             self._device = None
         else:
             self._mesh = None
-            self._device = device if device is not None else jax.devices()[0]
+            # mesh-aware selection helper (env-overridable; defaults to
+            # device 0 — the old hardcoded jax.devices()[0])
+            from . import placement
+
+            self._device = (
+                device if device is not None
+                else placement.default_device(jax)
+            )
         # inert rows: no peers, empty inbox -> the kernel never touches them
         self._state = self._put_rows(
             make_state(capacity, P, W, replica_ids=np.zeros(capacity))
@@ -527,7 +575,26 @@ class VectorStepEngine(IStepEngine):
         # vectorized plan classifier and merge stage read these lanes
         # array-at-once instead of probing per-row attributes
         self._lanes = hostplane.RowLanes(capacity)
-        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        # device-plane lease evidence lanes (ROADMAP 4b): the host's
+        # model of each resident leader's CheckQuorum activity window,
+        # anchored from the F_QUORUM_ACTIVE flag bit — see
+        # hostplane.LeaseLanes and _lease_row_step
+        self._lease = hostplane.LeaseLanes(capacity)
+        if self._mesh is not None:
+            # STRIPED free order: consecutive attaches land on distinct
+            # device blocks, so resident rows (and their group-tick
+            # load) balance across the mesh instead of filling chip 0
+            # first (ISSUE 12: per-device counters within 10%).  Pops
+            # come from the END of the list, so build the stripe
+            # reversed.  The row-block contract is ops/placement.py's.
+            blocks = self._mesh.size
+            per = capacity // blocks
+            order = [
+                b * per + i for i in range(per) for b in range(blocks)
+            ]
+            self._free: List[int] = list(reversed(order))
+        else:
+            self._free = list(range(capacity - 1, -1, -1))
         # per-row index base (the 64-bit story): the host log is 64-bit
         # throughout; device rows hold indexes REBASED by a per-row
         # multiple of W so the int32 lanes never overflow.  Recomputed at
@@ -747,10 +814,31 @@ class VectorStepEngine(IStepEngine):
                     self.capacity,
                 )
             return None
-        g = self._free.pop()
+        g = self._pick_row(node)
         self._row_of[self._row_key(node)] = g
         self._meta[g] = _RowMeta(node, self._lanes, g)
         return g
+
+    def _pick_row(self, node) -> int:
+        """Pop a free row slot.  The base policy is the free-list order
+        (striped across device blocks in mesh mode); the colocated
+        engine overrides with shard affinity — see its _pick_row."""
+        return self._free.pop()
+
+    def device_coordinate(self, shard_id: int):
+        """Device block hosting this shard's row under the placement
+        contract (ops/placement.py), or None when unknown / no mesh —
+        the balance plane's new chip-placement dimension (ROADMAP 3)."""
+        if self._mesh is None:
+            return None
+        g = self._row_of.get(shard_id)
+        if g is None:
+            return None
+        return g // (self.capacity // self._mesh.size)
+
+    def device_chip_count(self) -> int:
+        """Chips this engine spreads rows over (1 = single device)."""
+        return self._mesh.size if self._mesh is not None else 1
 
     # ------------------------------------------------------------------
     # classification
@@ -1009,6 +1097,11 @@ class VectorStepEngine(IStepEngine):
             self._mirror[_R_LEADER, g] = r.leader_id
             self._mirror[_R_ROLE, g] = int(r.role)
             self._mirror[_R_LAST, g] = r.log.last_index() - self._base[g]
+            # lease evidence lanes follow device residency (ROADMAP 4b)
+            if r.role == RaftRole.LEADER and r.check_quorum:
+                self._lease.arm(g, r.election_timeout, r.election_tick)
+            else:
+                self._lease.disarm(g)
             self._meta[g].dirty = False
             # the scalar excursion may have changed the static plan
             # facts (term, log span, remotes); require a fresh full
@@ -1030,6 +1123,7 @@ class VectorStepEngine(IStepEngine):
         idx = self._put(jnp.asarray(_pad_idx(gs)))
         sub = jax.tree.map(np.asarray, _gather_rows(st, idx))
         for k, g in enumerate(gs):
+            self._lease.disarm(g)  # scalar path re-arms at next upload
             node = self._meta[g].node
             base = int(self._base[g])
             if node.device_reads.has_pending():
@@ -1285,10 +1379,15 @@ class VectorStepEngine(IStepEngine):
         ``slot_offset`` shifts staging keys to ASSEMBLED slot indices:
         the colocated engine prepends its routed regions (width P*B)
         before the host slots, and the kernel reports slot_base/
-        ent_drop/src_slot in assembled coordinates."""
+        ent_drop/src_slot in assembled coordinates.
+
+        ``tick_fed`` (4th return, row -> fused tick count) is the
+        device-window mirror input for the lease evidence lanes
+        (hostplane.LeaseLanes.row_step)."""
         msg_rows: List[List[Message]] = [[] for _ in range(self.capacity)]
         staging: Dict[int, Dict[int, List[Entry]]] = {}
         prop_rows: List[int] = []
+        tick_fed: Dict[int, int] = {}
         for node, g, si, plan in batch:
             row_msgs = msg_rows[g]
             stage: Dict[int, List[Entry]] = {}
@@ -1319,6 +1418,7 @@ class VectorStepEngine(IStepEngine):
                 else:  # tick — log_index carries the fused count; hint
                     # lanes carry the latest pending read ctx so lost
                     # confirmations retry on the heartbeat cadence
+                    tick_fed[g] = payload
                     pc = node.device_reads.peek_ctx()
                     row_msgs.append(
                         Message(
@@ -1335,11 +1435,11 @@ class VectorStepEngine(IStepEngine):
                 for k, p in plan
             ):
                 prop_rows.append(g)
-        return msg_rows, staging, prop_rows
+        return msg_rows, staging, prop_rows, tick_fed
 
     def _device_step(self, batch) -> List[Tuple]:
         G, M, E = self.capacity, self.M, self.E
-        msg_rows, staging, prop_rows = self._encode_batch(batch)
+        msg_rows, staging, prop_rows, tick_fed = self._encode_batch(batch)
         inbox, overflow = S.encode_inbox(msg_rows, M, E)
         assert not overflow, f"planner let oversized rows through: {overflow}"
         inbox = self._put_rows(inbox)
@@ -1441,10 +1541,23 @@ class VectorStepEngine(IStepEngine):
         for node, g, si in live:
             r = node.peer.raft
             base = int(self._base[g])
+            # PRE-launch clock for lease window starts: stamping after
+            # bookkeeping would date a window up to half an election
+            # window late (the fused tick count) and overstate the
+            # lease by the same amount — the colocated _lease_pass
+            # follows the same pre-bookkeeping contract
+            now0 = node.tick_count
             # tick bookkeeping (mirrors Node.step_with_inputs)
             _tick_bookkeeping(node, si.ticks + si.gc_ticks)
             if g not in sum_at:
-                # no flags, no slots: the row only ticked
+                # no flags, no slots: the row only ticked — but an
+                # armed leader's window mirror still advances, and the
+                # quorum-active flag may anchor the lease (ROADMAP 4b)
+                a = self._lease.row_step(
+                    g, tick_fed.get(g, 0), now0, int(flags[g])
+                )
+                if a >= 0:
+                    r.anchor_quorum_evidence(a)
                 continue
             sv = vals_np[sum_at[g]]
             term, vote, committed, leader, role, last = (
@@ -1452,6 +1565,18 @@ class VectorStepEngine(IStepEngine):
             )
             committed += base
             last += base
+            # lease lanes track role transitions observed at merge: an
+            # on-device election win arms a FRESH window model
+            # (election_tick reset to 0 by the kernel's _reset), any
+            # other transition disarms
+            if role != int(self._mirror[_R_ROLE, g]):
+                if role == int(RaftRole.LEADER) and r.check_quorum:
+                    self._lease.arm(g, r.election_timeout, 0)
+                else:
+                    self._lease.disarm(g)
+            a = self._lease.row_step(
+                g, tick_fed.get(g, 0), now0, int(flags[g])
+            )
             appended = bool(flags[g] & _F_APPEND)
             # 1. append reconstruction
             if appended:
@@ -1472,6 +1597,8 @@ class VectorStepEngine(IStepEngine):
             # 2. protocol scalar sync
             r.term, r.vote, r.leader_id = term, vote, leader
             r.role = RaftRole(role)
+            if a >= 0:
+                r.anchor_quorum_evidence(a)  # post-sync: role is fresh
             if committed > r.log.committed:
                 r.log.commit_to(committed)
             if (

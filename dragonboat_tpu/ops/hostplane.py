@@ -48,7 +48,14 @@ from typing import List, NamedTuple, Sequence
 
 import numpy as np
 
-from .types import F_ANY_LIVE, F_APPEND, F_COUNT, F_ESC, F_NEED_SS
+from .types import (
+    F_ANY_LIVE,
+    F_APPEND,
+    F_COUNT,
+    F_ESC,
+    F_NEED_SS,
+    F_QUORUM_ACTIVE,
+)
 
 # parity mode: run the scalar twins beside every vectorized pass and
 # assert identical outputs (tests flip the module attribute directly;
@@ -108,9 +115,94 @@ class RowLanes:
         return self.attached & ~self.dirty
 
 
+class LeaseLanes:
+    """Host model of resident CheckQuorum leaders' activity windows —
+    the device-plane lease evidence plumbing (ROADMAP 4b).
+
+    The device SoA tracks ``check_quorum``/``active`` per row but never
+    drove the scalar remotes' ``last_resp_tick``, so lease reads on
+    device-hosted shards always fell back to ReadIndex.  The wiring:
+
+    * the kernel's flags word gains ``F_QUORUM_ACTIVE`` — a CheckQuorum
+      leader whose CURRENT activity window already holds a quorum of
+      active voter lanes (engine._summarize_flags; rides the existing
+      per-launch readback for free);
+    * the host mirrors each armed row's device ``election_tick`` from
+      the ticks it feeds (``row_step``), so it knows when the device's
+      CheckQuorum sweep cleared the lanes — the WINDOW START, recorded
+      on the row's own node clock;
+    * when the flag is up mid-window, the scalar voting remotes are
+      anchored at that window start (``Raft.anchor_quorum_evidence``),
+      and ``quorum_responded_tick``/``lease_remaining_ticks`` work
+      unchanged — the ~0.006 ms lease read stays on the engines that
+      host the most shards.
+
+    SAFETY SHAPE: an ``active`` lane proves its peer responded AFTER
+    the sweep observed it cleared, so the quorum's election clocks
+    reset no earlier than (window start - one in-flight probe delay).
+    Window-start anchoring is therefore the classic clock-based
+    CheckQuorum lease (etcd's leader lease), one notch weaker than the
+    scalar path's probe-send FIFO anchoring; the margin lease callers
+    already keep (NodeHost.try_lease_read) absorbs the in-flight skew.
+    The leader's own FIRST window is never anchored (window_start
+    starts at -1): become_leader fabricates a full activity window
+    (kernel._become_leader), and only a window that began with a real
+    on-device sweep counts as evidence.
+
+    All writes run under the engine's core lock, like RowLanes.
+    """
+
+    __slots__ = ("window_start", "dev_el", "et")
+
+    def __init__(self, capacity: int):
+        self.window_start = np.full((capacity,), -1, np.int64)
+        self.dev_el = np.zeros((capacity,), np.int64)
+        self.et = np.zeros((capacity,), np.int64)  # 0 = disarmed
+
+    def disarm(self, g: int) -> None:
+        self.et[g] = 0
+        self.dev_el[g] = 0
+        self.window_start[g] = -1
+
+    def arm(self, g: int, election_timeout: int, election_tick: int) -> None:
+        """Arm a row entering device residency (or winning an election
+        on-device) as a CheckQuorum leader.  ``election_tick`` seeds
+        the device-window mirror (uploads carry the scalar's tick; an
+        on-device win resets it to 0)."""
+        self.et[g] = election_timeout
+        self.dev_el[g] = election_tick
+        self.window_start[g] = -1  # first window: fabricated actives
+
+    def row_step(self, g: int, fed_ticks: int, now: int,
+                 flags_word: int) -> int:
+        """Advance one armed row by the ticks its launch fed and return
+        the anchor tick (>= 0) when the quorum-active flag holds inside
+        an observed window, else -1.  Crossings mirror kernel._tick's
+        leader leg exactly: el += n, fired at el >= et, reset to 0 (the
+        planner's half-window tick cap guarantees at most one crossing
+        per launch)."""
+        et = self.et[g]
+        if et <= 0:
+            return -1
+        el = self.dev_el[g] + fed_ticks
+        if el >= et:
+            # the device's CheckQuorum sweep ran this launch: actives
+            # cleared, a fresh window starts on this row's clock NOW
+            self.dev_el[g] = 0
+            self.window_start[g] = now
+            return -1
+        self.dev_el[g] = el
+        ws = self.window_start[g]
+        if ws >= 0 and (flags_word & F_QUORUM_ACTIVE):
+            return int(ws)
+        return -1
+
+
 # ---------------------------------------------------------------------------
 # the batched plan classifier (static-eligibility prefilter)
 # ---------------------------------------------------------------------------
+
+
 def classify_static(lanes: RowLanes, gs: np.ndarray) -> np.ndarray:  # hostplane-hot
     """[n] bool: rows whose last full-plan proof still stands.
 

@@ -1691,3 +1691,75 @@ def state_to_internal(st: DeviceState) -> DeviceState:
     its own inverse; internal-layout Inbox/DeviceOut construction stays
     module-private until a second consumer exists."""
     return _state_to_internal(st)
+
+
+def inbox_to_internal(ib: Inbox) -> Inbox:
+    """Public [G, M]/[G, M, E] -> internal (G-last) inbox layout — the
+    companion of :func:`state_to_internal` for callers (bench phase A
+    sharded, tests) that build internal-layout launches host-side."""
+    return _inbox_to_internal(ib)
+
+
+def make_step_sharded(  # mesh-hot
+    mesh, state: DeviceState, inbox: Inbox, *, out_capacity: int,
+    internal: bool = False,
+):
+    """Build the shard_map'd step over a 1-D groups mesh (ROADMAP 3).
+
+    Returns a jitted ``(state, inbox) -> (state', out)`` whose program
+    runs PER DEVICE on that device's G-slice: the step body is
+    row-local (every reduction is over the P/W/M/O axes, never G), so
+    the compiled per-shard program contains ZERO collectives and is
+    bit-identical to the single-device ``step`` on the concatenation of
+    the slices (pinned by tests/test_multichip.py).  The only
+    shard-local quantity is the slot-compaction trip count ``n_occ``
+    (a per-shard max): a shard with emptier inboxes runs fewer slot
+    passes, which is exactly the empty-slot no-op contract.
+
+    ``state``/``inbox`` are EXAMPLE operands (shape/ndim only) used to
+    derive per-leaf partition specs; ``internal=True`` expects the
+    G-last layout (``state_to_internal``/``inbox_to_internal``) and
+    shards the TRAILING axis of every leaf, so phase-A-style loops keep
+    the packed-lane layout across launches with no boundary transposes.
+    """
+    import jax as _jax
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - older jax spelling
+        from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as _PS
+
+    if len(mesh.axis_names) != 1:
+        raise ValueError("groups mesh must be one-dimensional")
+    axis = mesh.axis_names[0]
+    fn = step_internal if internal else step
+
+    def _local(st, ib):
+        return fn(st, ib, out_capacity=out_capacity)
+
+    if internal:
+        # G trails every leaf: build per-leaf specs by ndim
+        def spec_of(a):
+            return _PS(*([None] * (a.ndim - 1) + [axis]))
+
+        out_shapes = _jax.eval_shape(_local, state, inbox)
+        in_specs = (
+            _jax.tree.map(spec_of, state),
+            _jax.tree.map(spec_of, inbox),
+        )
+        out_specs = _jax.tree.map(spec_of, out_shapes)
+    else:
+        # G leads every leaf: a single prefix spec covers each pytree
+        in_specs = (_PS(axis), _PS(axis))
+        out_specs = (_PS(axis), _PS(axis))
+    return _jax.jit(
+        _shard_map(
+            _local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            # the step body carries a lax.while_loop (slot
+            # compaction); jax 0.4.x has no replication rule for
+            # while under shard_map's rep checker — the specs
+            # here are all-sharded, so the check is vacuous
+            check_rep=False,
+        )
+    )

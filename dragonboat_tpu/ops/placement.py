@@ -1,0 +1,85 @@
+"""Device placement for the ops plane: one mesh-aware selection helper.
+
+Before this module every launch site hardcoded ``jax.devices()[0]``
+(``ops/engine.py``, both bench phases), which is exactly the
+single-chip assumption ROADMAP item 3 calls the missing multiplier.
+All device/mesh selection now routes through here:
+
+* :func:`default_device` — the single-device engine's home chip.
+  Env-overridable (``DRAGONBOAT_TPU_DEVICE=<index>``); defaults to
+  device 0, i.e. exactly the old behavior.
+* :func:`groups_mesh` — a 1-D ``jax.sharding.Mesh`` over the first N
+  devices with the canonical ``"groups"`` axis name (SURVEY §2: the
+  groups axis is the ONLY parallel axis).  ``DRAGONBOAT_TPU_MESH_DEVICES``
+  selects N; unset/0/1 returns None (single-device mode).
+* :func:`device_of_row` / :func:`rows_per_device` — the row-block
+  placement contract shared by the sharded route tables
+  (``route.build_route_tables_mesh``), the engine's striped row
+  allocator and the balance plane's device coordinates: device ``d``
+  owns the contiguous row block ``[d*Gl, (d+1)*Gl)``.
+
+Keeping the block contract in ONE module matters: the shard_map'd
+launch slices state by block, the route tables classify device
+boundaries by block, and the engine reports ``device_coordinate`` by
+block — three layers that silently corrupt cross-chip traffic if they
+ever disagree.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def default_device(jax_module=None):
+    """The engine/bench home device.  ``DRAGONBOAT_TPU_DEVICE=<i>``
+    overrides the index; the default (0) is byte-for-byte the old
+    hardcoded ``jax.devices()[0]`` behavior."""
+    if jax_module is None:
+        import jax as jax_module
+    devs = jax_module.devices()
+    idx = int(os.environ.get("DRAGONBOAT_TPU_DEVICE", "0") or 0)
+    if not 0 <= idx < len(devs):
+        raise ValueError(
+            f"DRAGONBOAT_TPU_DEVICE={idx} out of range: "
+            f"{len(devs)} device(s) visible"
+        )
+    return devs[idx]
+
+
+def groups_mesh(n_devices: Optional[int] = None, jax_module=None):
+    """A 1-D mesh over the groups axis, or None for single-device mode.
+
+    ``n_devices`` defaults to ``DRAGONBOAT_TPU_MESH_DEVICES`` (unset,
+    0 or 1 → None, preserving current single-device behavior).
+    """
+    if jax_module is None:
+        import jax as jax_module
+    if n_devices is None:
+        n_devices = int(
+            os.environ.get("DRAGONBOAT_TPU_MESH_DEVICES", "0") or 0
+        )
+    if n_devices <= 1:
+        return None
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax_module.devices()
+    if len(devs) < n_devices:
+        raise ValueError(
+            f"mesh wants {n_devices} devices, only {len(devs)} visible"
+        )
+    return Mesh(np.asarray(devs[:n_devices]), ("groups",))
+
+
+def rows_per_device(capacity: int, n_devices: int) -> int:
+    """Block size of the row-block placement; capacity must divide."""
+    if n_devices <= 0 or capacity % n_devices:
+        raise ValueError(
+            f"capacity {capacity} must divide over {n_devices} devices"
+        )
+    return capacity // n_devices
+
+
+def device_of_row(g: int, capacity: int, n_devices: int) -> int:
+    """Device coordinate hosting row ``g`` under the block contract."""
+    return g // rows_per_device(capacity, n_devices)

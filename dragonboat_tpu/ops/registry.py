@@ -283,3 +283,56 @@ ENTRY_POINTS: Tuple[EntryPoint, ...] = (
 def runtime_entry_points():
     """(name, jitted fn) pairs the recompile sentry watches."""
     return [(ep.name, ep.fn) for ep in ENTRY_POINTS if ep.runtime]
+
+
+def mesh_entry_points(mesh) -> Tuple[EntryPoint, ...]:
+    """Audit entries for the SHARDED launch path over ``mesh`` — the
+    jaxcheck transfer/dtype rules extended to the multi-chip programs
+    (docs/MULTICHIP.md; the ISSUE-12 "zero cross-device host hops"
+    gate).  Not part of the static ENTRY_POINTS tuple because a mesh
+    needs visible devices: bench.phase_multichip, the multichip smoke
+    and tests/test_multichip.py audit these explicitly under forced
+    host devices.  CANON['G'] must divide the mesh (64 covers 1-8)."""
+    import numpy as np
+
+    G = CANON["G"]
+    if G % mesh.size:
+        raise ValueError(f"CANON G={G} must divide mesh size {mesh.size}")
+
+    step_sharded = K.make_step_sharded(
+        mesh, _state(), _inbox(CANON["M"]), out_capacity=CANON["O"]
+    )
+    round_sharded = R.make_sharded_round(
+        mesh, M=_M_ROUTE, E=CANON["E"], out_capacity=CANON["O"],
+        budget=CANON["budget"], xbudget=4, base=_BASE_ROUTE,
+        propose_leaders=True,
+    )
+
+    def _b_step_sharded():
+        return (_state(), _inbox(CANON["M"])), {}
+
+    def _b_round_sharded():
+        # strided tables so every device has genuine cross-device edges
+        # in the traced program (an all-local trace would never reach
+        # the collective lane)
+        dl = jnp.asarray(
+            np.zeros((G, CANON["P"]), np.int32)
+        )
+        dd = jnp.asarray(
+            (np.arange(G)[:, None] % mesh.size * np.ones(
+                (1, CANON["P"]), np.int64
+            )).astype(np.int32)
+        )
+        rank = jnp.zeros((G, CANON["P"]), I32)
+        return (_state(), _inbox(_M_ROUTE), dl, dd, rank), {}
+
+    return (
+        EntryPoint(
+            "kernel.step_sharded", step_sharded, _b_step_sharded,
+            runtime=False,
+        ),
+        EntryPoint(
+            "route.sharded_round", round_sharded, _b_round_sharded,
+            runtime=False,
+        ),
+    )

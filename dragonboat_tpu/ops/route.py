@@ -495,3 +495,386 @@ def routed_round(
         M=M, E=E, budget=budget, base=base,
         propose_leaders=propose_leaders, propose_n=propose_n,
     )
+
+
+# ---------------------------------------------------------------------------
+# multi-chip device plane: sharded tables + the collective exchange lane
+# (ROADMAP item 3 / docs/MULTICHIP.md)
+# ---------------------------------------------------------------------------
+class MeshTables(NamedTuple):
+    """Static route tables for a G-sharded mesh (row-block placement:
+    device ``d`` owns global rows [d*Gl, (d+1)*Gl) — ops/placement.py).
+
+    All three are [G, P] (sharded over G like the state), describing the
+    peer in each slot of each row:
+
+      dest_dev[g, p]    device hosting that replica (-1: not placed)
+      dest_local[g, p]  its LOCAL row index on that device
+      rank_in_dest[g, p] the slot index row g's replica occupies in THAT
+                        row's peer table (identical to the single-device
+                        table — region selection is device-agnostic)
+    """
+
+    dest_local: np.ndarray
+    dest_dev: np.ndarray
+    rank_in_dest: np.ndarray
+
+
+class CrossStats(NamedTuple):
+    """Per-call collective-lane counters (all scalars, per shard)."""
+
+    sent: jnp.ndarray            # messages packed onto the lane
+    delivered: jnp.ndarray       # received messages scattered into slots
+    dropped_budget: jnp.ndarray  # per-sender region rank >= budget
+    dropped_xlane: jnp.ndarray   # per-edge lane slots exhausted (>= XB)
+    dropped_ring: jnp.ndarray    # REPLICATE no longer ring-resident
+
+
+def build_route_tables_mesh(  # raftlint: ignore[host-sync] host-side numpy precompute of static tables
+    shard_ids: np.ndarray,
+    replica_ids: np.ndarray,
+    peer_ids: np.ndarray,
+    n_devices: int,
+) -> MeshTables:
+    """Device-boundary classification of the route tables: the global
+    ``build_route_tables`` output split by the row-block placement into
+    (device, local-row) coordinates.  A peer on the SAME device routes
+    through the ordinary intra-device ``route``; a peer on another
+    device rides the collective exchange lane (``cross_exchange``)."""
+    G = peer_ids.shape[0]
+    if n_devices <= 0 or G % n_devices:
+        raise ValueError(f"G={G} must divide over {n_devices} devices")
+    gl = G // n_devices
+    dest, rank = build_route_tables(shard_ids, replica_ids, peer_ids)
+    placed = dest >= 0
+    dest_dev = np.where(placed, dest // gl, -1).astype(np.int32)
+    dest_local = np.where(placed, dest % gl, -1).astype(np.int32)
+    return MeshTables(dest_local, dest_dev, rank)
+
+
+def xbudget_for(  # raftlint: ignore[host-sync] host-side numpy sizing of a static lane budget
+    tables: MeshTables, budget: int, n_devices: int
+) -> int:
+    """Worst-case per-edge lane volume for ``tables``: for each
+    (src device, dst device) edge, every local row can emit up to
+    ``budget`` messages toward each of its peer slots on that edge.
+    Sizing ``xbudget`` here makes ``dropped_xlane`` structurally zero —
+    the precondition for the bit-exact sharded/single-device parity
+    gate (a lane drop has no single-device analogue).  Topologies that
+    accept lossy cross traffic (raft-safe) may pass less."""
+    G = tables.dest_dev.shape[0]
+    gl = G // n_devices
+    worst = 1
+    blocks = tables.dest_dev.reshape(n_devices, gl, -1)
+    for s in range(n_devices):
+        for d in range(n_devices):
+            if d == s:
+                continue
+            worst = max(worst, int((blocks[s] == d).sum()) * budget)
+    return worst
+
+
+# packed cross-lane row layout (single source of truth for pack/unpack):
+# the 9 wire columns, then sender replica id, destination local row,
+# destination region rank, region slot b, found flag, then E entry
+# terms and E entry cc bits.
+_X_WIRE = (
+    F_MTYPE, F_TERM, F_LOG_TERM, F_LOG_INDEX, F_COMMIT,
+    F_REJECT, F_HINT, F_HINT_HIGH, F_N_ENTRIES,
+)
+_XI_FROM = len(_X_WIRE)
+_XI_LOC = _XI_FROM + 1
+_XI_RANK = _XI_FROM + 2
+_XI_B = _XI_FROM + 3
+_XI_FOUND = _XI_FROM + 4
+_X_KF = _XI_FROM + 5  # ent_term starts here; width = _X_KF + 2*E
+
+
+def cross_exchange(
+    state: DeviceState,
+    out: DeviceOut,
+    inbox: Inbox,
+    dest_local: jnp.ndarray,
+    dest_dev: jnp.ndarray,
+    rank_in_dest: jnp.ndarray,
+    *,
+    axis: str,
+    n_dev: int,
+    budget: int,
+    xbudget: int,
+    base: int,
+    suppress: Optional[jnp.ndarray] = None,
+) -> Tuple[Inbox, CrossStats]:
+    """The device-to-device collective lane (runs INSIDE shard_map).
+
+    Messages whose destination replica lives on another device are
+    packed into a fixed per-edge buffer ([n_dev, xbudget, KT] int32 —
+    the same fixed-budget discipline as the routed regions), exchanged
+    with ``lax.ppermute`` (one hop per ring shift; n_dev-1 permutes of a
+    tiny buffer), and scattered into the SAME inbox region slots the
+    intra-device router would have used — ``base + rank*budget + b`` —
+    so a sharded round's assembled inbox is bit-identical to the
+    single-device router's (the parity contract of
+    tests/test_multichip.py).  Region-slot identity is safe because a
+    (dest row, rank) region has exactly ONE sender, and that sender is
+    on exactly one device: a region is local-fed XOR lane-fed.
+
+    Overflow (per-sender rank >= budget, per-edge slot >= xbudget) is
+    DROPPED and counted — raft tolerates arbitrary message loss, same
+    contract as the intra-device router.  Zero host transfers: pure
+    int32 device math + ppermute.
+    """
+    G, O, _ = out.buf.shape
+    P, W, B, E = state.P, state.W, budget, inbox.E
+    M = inbox.M
+    D, XB = n_dev, xbudget
+    if D <= 1:
+        zero = jnp.zeros((), I32)
+        return inbox, CrossStats(zero, zero, zero, zero, zero)
+    me = jax.lax.axis_index(axis)
+
+    buf = out.buf
+    mtype = buf[:, :, F_MTYPE]
+    to = buf[:, :, F_TO]
+    n_ent = buf[:, :, F_N_ENTRIES]
+    log_index = buf[:, :, F_LOG_INDEX]
+    log_term = buf[:, :, F_LOG_TERM]
+    valid = jnp.arange(O)[None, :] < out.count[:, None]
+    if suppress is not None:
+        valid = valid & ~suppress[:, None]
+    hits = (
+        (state.peer_id[:, None, :] == to[:, :, None])
+        & (to[:, :, None] != 0)
+        & (state.peer_id[:, None, :] != 0)
+    )  # [G, O, P]
+    found = jnp.any(hits, axis=2)
+
+    def at_pstar(tab):  # [G, P] table value at the hit slot, [G, O]
+        return jnp.sum(jnp.where(hits, tab[:, None, :], 0), axis=2)
+
+    xdev = at_pstar(dest_dev)
+    xloc = at_pstar(dest_local)
+    xrank = at_pstar(rank_in_dest)
+    # deliverability mirrors route(): REPLICATE payload must be ring-
+    # resident on the sender (below-ring HOST-FIXUP markers excluded),
+    # forwarded PROPOSE never rides the device (payload is host-only)
+    is_repl = mtype == MT_REPLICATE
+    carries = is_repl & (n_ent > 0)
+    win_lo = jnp.maximum(state.first_index, state.last_index - (W - 1))
+    marker = is_repl & (log_index > 0) & (log_term == 0)
+    ring_ok = ~carries | (
+        (log_index + 1 >= win_lo[:, None])
+        & (log_index + n_ent <= state.last_index[:, None])
+        & ~marker
+    )
+    remote = found & (xdev >= 0) & (xdev != me)
+    routable = valid & remote & (mtype != MT_PROPOSE)
+    deliverable = routable & ring_ok
+    # per-(sender, peer-slot) region rank b — the SAME counting the
+    # single-device router applies (all of a (g, p) pair's messages go
+    # to one destination device, so the two counts can never interleave)
+    oh = (hits & deliverable[:, :, None]).astype(I32)
+    k_excl = jnp.cumsum(oh, axis=1) - oh
+    b_of = jnp.sum(jnp.where(hits, k_excl, 0), axis=2)  # [G, O]
+    in_b = b_of < B
+    sendable = deliverable & in_b
+    # per-edge lane slot q (fixed budget XB per destination device)
+    N = G * O
+    edge = (
+        (xdev[:, :, None] == jnp.arange(D)[None, None, :])
+        & sendable[:, :, None]
+    ).reshape(N, D)
+    q_excl = jnp.cumsum(edge.astype(I32), axis=0) - edge
+    in_q = edge & (q_excl < XB)
+    # pack one [KT] row per message: wire fields + lane metadata + the
+    # REPLICATE payload (terms/cc) reconstructed from the sender's ring
+    wm = W - 1
+    ents_t = []
+    ents_c = []
+    for e in range(E):
+        pos = jnp.clip(log_index + 1 + e, 0, None) & wm  # [G, O]
+        selw = pos[:, :, None] == jnp.arange(W)[None, None, :]
+        has_e = carries & (e < n_ent)
+        et = jnp.sum(
+            jnp.where(selw, state.ring_term[:, None, :], 0), axis=2
+        )
+        ec = jnp.sum(jnp.where(selw, state.ring_cc[:, None, :], 0), axis=2)
+        ents_t.append(jnp.where(has_e, et, 0))
+        ents_c.append(jnp.where(has_e, ec, 0))
+    from_g = jnp.broadcast_to(state.replica_id[:, None], (G, O))
+    fields = jnp.stack(
+        [buf[:, :, c] for c in _X_WIRE]
+        + [from_g, xloc, xrank, b_of, sendable.astype(I32)]
+        + ents_t + ents_c,
+        axis=2,
+    ).reshape(N, -1)  # [N, KT]
+    KT = fields.shape[1]
+    # xbuf[d, xb] = the message holding lane slot xb of edge me->d
+    sel = (
+        in_q[:, :, None] & (q_excl[:, :, None] == jnp.arange(XB))
+    )  # [N, D, XB]
+    xbuf = jnp.matmul(
+        sel.astype(I32).transpose(1, 2, 0).reshape(D * XB, N), fields
+    ).reshape(D, XB, KT)
+    # ring exchange: shift s hands each device the buffer its neighbor
+    # s hops back packed for it — D-1 ppermutes of [XB, KT] int32
+    recv_parts = []
+    for shift in range(1, D):
+        dst_slice = jax.lax.dynamic_index_in_dim(
+            xbuf, (me + shift) % D, axis=0, keepdims=False
+        )
+        perm = [(i, (i + shift) % D) for i in range(D)]
+        recv_parts.append(jax.lax.ppermute(dst_slice, axis, perm=perm))
+    recv = jnp.concatenate(recv_parts, axis=0)  # [(D-1)*XB, KT]
+    R = recv.shape[0]
+    ok = recv[:, _XI_FOUND] != 0
+    row = recv[:, _XI_LOC]
+    slot = base + recv[:, _XI_RANK] * B + recv[:, _XI_B]
+    # one-hot scatter into the (guaranteed-empty) region slots: no two
+    # received messages share (row, slot) — single sender per region,
+    # distinct b per sender — so the adds never collide, and the local
+    # router left lane-fed regions zero (their dest_row is -1 locally)
+    selr = (
+        ok[:, None, None]
+        & (row[:, None, None] == jnp.arange(G)[None, :, None])
+        & (slot[:, None, None] == jnp.arange(M)[None, None, :])
+    )  # [R, G, M]
+
+    def put(col):
+        return jnp.sum(
+            jnp.where(selr, recv[:, col][:, None, None], 0), axis=0
+        ).astype(I32)
+
+    wire_at = {c: i for i, c in enumerate(_X_WIRE)}
+    ent_t = jnp.sum(
+        jnp.where(
+            selr[:, :, :, None],
+            recv[:, None, None, _X_KF:_X_KF + E],
+            0,
+        ),
+        axis=0,
+    ).astype(I32)
+    ent_c = jnp.sum(
+        jnp.where(
+            selr[:, :, :, None],
+            recv[:, None, None, _X_KF + E:_X_KF + 2 * E],
+            0,
+        ),
+        axis=0,
+    ).astype(I32)
+    inbox = Inbox(
+        mtype=inbox.mtype + put(wire_at[F_MTYPE]),
+        from_id=inbox.from_id + put(_XI_FROM),
+        term=inbox.term + put(wire_at[F_TERM]),
+        log_term=inbox.log_term + put(wire_at[F_LOG_TERM]),
+        log_index=inbox.log_index + put(wire_at[F_LOG_INDEX]),
+        commit=inbox.commit + put(wire_at[F_COMMIT]),
+        reject=inbox.reject + put(wire_at[F_REJECT]),
+        hint=inbox.hint + put(wire_at[F_HINT]),
+        hint_high=inbox.hint_high + put(wire_at[F_HINT_HIGH]),
+        n_entries=inbox.n_entries + put(wire_at[F_N_ENTRIES]),
+        ent_term=inbox.ent_term + ent_t,
+        ent_cc=inbox.ent_cc + ent_c,
+    )
+    stats = CrossStats(
+        sent=jnp.sum(in_q, dtype=I32),
+        delivered=jnp.sum(ok, dtype=I32),
+        dropped_budget=jnp.sum(deliverable & ~in_b, dtype=I32),
+        dropped_xlane=jnp.sum(
+            sendable & ~jnp.any(in_q.reshape(G, O, D), axis=2), dtype=I32
+        ),
+        dropped_ring=jnp.sum(routable & ~ring_ok, dtype=I32),
+    )
+    return inbox, stats
+
+
+def make_sharded_round(  # mesh-hot
+    mesh,
+    *,
+    M: int,
+    E: int,
+    out_capacity: int,
+    budget: int,
+    xbudget: int,
+    base: int,
+    propose_leaders: bool = False,
+    propose_n: int = 1,
+):
+    """Build the jitted shard_map'd consensus round for a 1-D groups
+    mesh: per-device step over the local G-slice, intra-device routing
+    EXACTLY as the single-device router (``route`` over the mesh
+    tables' local view), and cross-device raft traffic on the
+    ``cross_exchange`` collective lane — zero host transfers in the
+    steady loop (pinned by the jaxcheck transfer audit over
+    ``registry.mesh_entry_points``).
+
+    Returns ``round_fn(state, inbox, dest_local, dest_dev, rank) ->
+    (state', inbox', route_stats [D, 6], lane_stats [D, 7])`` where all
+    row-axis operands are sharded over the mesh (jit re-shards
+    uncommitted inputs automatically) and the per-device stats lanes
+    are: RouteStats order for the local router, then [sent, delivered,
+    dropped_budget, dropped_xlane, dropped_ring, escalated, rows_live]
+    for the lane/step — the per-device split ``bench.py
+    phase_multichip`` balances and records.
+    """
+    import jax as _jax
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - older jax spelling
+        from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as _PS
+
+    if len(mesh.axis_names) != 1:
+        raise ValueError("groups mesh must be one-dimensional")
+    axis = mesh.axis_names[0]
+    D = mesh.size
+    from . import kernel as K
+
+    def _local_round(state, inbox, dest_local, dest_dev, rank):
+        new_state, out = K.step(state, inbox, out_capacity=out_capacity)
+        esc = out.escalate != 0
+        n_esc = jnp.sum(esc, dtype=I32)
+        keep = ~esc
+
+        def sel(a, b):
+            m = keep.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, b, a)
+
+        state2 = jax.tree.map(sel, state, new_state)
+        prefill = make_prefill(
+            state2, M, E,
+            propose_leaders=propose_leaders, propose_n=propose_n,
+        )
+        me = jax.lax.axis_index(axis)
+        local_dest = jnp.where(
+            dest_dev == me, dest_local, jnp.int32(-1)
+        )
+        next_inbox, stats, _delivered = route(
+            state2, out, local_dest, rank,
+            M=M, E=E, budget=budget, base=base,
+            base_inbox=prefill, suppress=esc,
+        )
+        next_inbox, xstats = cross_exchange(
+            state2, out, next_inbox, dest_local, dest_dev, rank,
+            axis=axis, n_dev=D, budget=budget, xbudget=xbudget,
+            base=base, suppress=esc,
+        )
+        rows_live = jnp.sum(keep, dtype=I32)
+        lane = jnp.stack(
+            list(xstats) + [n_esc, rows_live]
+        )[None]  # [1, 7] per shard
+        return state2, next_inbox, jnp.stack(list(stats))[None], lane
+
+    return _jax.jit(
+        _shard_map(
+            _local_round,
+            mesh=mesh,
+            in_specs=(
+                _PS(axis), _PS(axis), _PS(axis), _PS(axis), _PS(axis),
+            ),
+            out_specs=(_PS(axis), _PS(axis), _PS(axis), _PS(axis)),
+            # see make_step_sharded: while_loop has no replication rule
+            check_rep=False,
+        )
+    )

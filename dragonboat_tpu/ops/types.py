@@ -118,6 +118,14 @@ F_CHANGED, F_COUNT, F_APPEND, F_NEED_SS, F_ESC = 1, 2, 4, 8, 16
 # leader row with a peer lane still behind its log: quiesce entry is
 # blocked while set (the scalar remotes of a resident row are stale)
 F_PEERS_BEHIND = 32
+# CheckQuorum leader row (self a voter) whose CURRENT activity window
+# already holds a quorum of active voter lanes: the device-plane lease
+# evidence bit (ROADMAP 4b) — the host anchors the scalar remotes'
+# last_resp_tick at the window start so gateway lease reads stay on
+# device-hosted shards (ops/hostplane.LeaseLanes; docs/GATEWAY.md).
+# Deliberately NOT in F_ANY_LIVE: it must ride the flags word for free
+# without promoting a quiet leader into the values-readback set.
+F_QUORUM_ACTIVE = 64
 F_ANY_LIVE = F_CHANGED | F_COUNT | F_APPEND | F_NEED_SS
 
 

@@ -693,6 +693,23 @@ class Raft:
             and self.election_tick < self.election_timeout
         )
 
+    def anchor_quorum_evidence(self, tick: int) -> None:
+        """Device-plane lease evidence (ROADMAP 4b): the engine proved
+        a quorum of voter lanes active since ``tick`` (the device
+        CheckQuorum window start — ops/hostplane.LeaseLanes), so raise
+        every voting remote's ``last_resp_tick`` floor to it.  Raising
+        ALL voters is exact for the lease: ``quorum_responded_tick``
+        takes the quorum-th freshest, which becomes >= ``tick`` — the
+        literal claim the device evidence makes — and monotone max
+        keeps any fresher scalar-path probe anchors intact."""
+        if self.role != RaftRole.LEADER:
+            return
+        for pid, rm in self.voting_members().items():
+            if pid == self.replica_id:
+                continue
+            if tick > rm.last_resp_tick:
+                rm.last_resp_tick = tick
+
     def quorum_responded_tick(self) -> int:
         """LEADER side of the lease (gateway lease reads): the most
         recent tick by which a QUORUM of voters (self included) had

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Multi-chip device-plane smoke (docs/MULTICHIP.md, ISSUE 12): run the
+# sharded-vs-single-device parity tests under 8 forced host devices —
+#   1. kernel step parity (shard_map G-slices bit-exact with the
+#      single-device step),
+#   2. the full sharded consensus round at 2/4/8 devices in a
+#      replica-major layout (every group straddles device blocks, so
+#      cross-device raft traffic genuinely rides the ppermute
+#      collective exchange lane; zero lane drops at the xbudget_for
+#      sizing),
+#   3. a membership-change fence mid-run,
+#   4. the jaxcheck transfer audit over the sharded entry points
+#      (registry.mesh_entry_points): zero host transfers in the steady
+#      sharded loop.
+# The test module's conftest forces
+# --xla_force_host_platform_device_count=8 (the MULTICHIP harness
+# mechanism), so this runs anywhere the tier-1 suite runs.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_multichip.py \
+    -q -p no:cacheprovider \
+    -k "parity or fence or transfer_free" \
+    --no-header
