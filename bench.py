@@ -1053,6 +1053,562 @@ def phase_hostplane(rows_list=None, launches: int = 6) -> dict:
     return {"tiers": tiers, "parity": True}
 
 
+def phase_updatelanes(rows_list=None, reps: int = 3) -> dict:
+    """Update-stage residual, scalar (the r8 per-row loop) vs lane
+    (r9, ops/hostplane.UpdateLanes), over fabricated generations
+    against REAL raft/pending-table/logdb objects.
+
+    The r6 vectorization left one per-AFFECTED-row loop on the merge
+    tail: scalar raft sync, ``peer.get_update`` (one Update/State/
+    UpdateCommit object walk per row), ``_tick_bookkeeping``'s five
+    pending-table GCs, and per-row save/process/commit plumbing —
+    the residual ISSUE 13 names as the host-plane wall at 50k-250k
+    rows.  This phase times exactly that stage END TO END (residual
+    loop + persist + apply handoff; the downstream apply itself is
+    excluded — identical both sides) on twin node populations:
+
+    * scalar — the r8 loop verbatim: per-row 5-table GC, int
+      unpacking, ``RaftRole(role)``, ``get_update``,
+      ``dispatch_dropped``, ``_check_leader_change``, then the
+      by-LogDB ``save_raft_state`` + ``process_update`` +
+      ``peer.commit`` chain per row;
+    * lane — ``hostplane.plan_update_sync`` over the update lanes +
+      the residual lane loop (sync only what moved) + ONE batched
+      ``save_state_lanes`` per LogDB + inline cursor/apply handoff
+      (the ops/colocated.py ``_lane_commit_pass`` shape).
+
+    Three generation shapes run per rep, mirroring the r5 Config-4
+    mixed-election population the ledger blamed (docs/
+    BENCH_NOTES_r05.md): ``election`` (term/vote/leader churn on 30%
+    of rows, no commits — the mass-election storm), ``commit_wave``
+    (commit advance + real committed entries on 15%), ``steady``
+    (ticks only).  Per-shape and aggregate speedups are reported; the
+    acceptance gate reads the AGGREGATE (the election-dominated mix
+    is the measured wall).  Parity runs OUTSIDE the timed windows:
+    plan parity against the hostplane scalar twin every generation,
+    and full raft-word equality across the twin populations at the
+    end.  Host-only (numpy; no device).  Default tier 10k rows rides
+    the standard bench; 50k/250k (the r5 ledger's scale) run when
+    BENCH_UPDATELANES_HEAVY=1 — same convention as
+    BENCH_HOSTPLANE_HEAVY.
+    """
+    import gc as _gc
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from dragonboat_tpu.ops import hostplane as hp
+    from dragonboat_tpu.ops.engine import _ROLE_OF
+    from dragonboat_tpu.ops.types import (
+        N_VALS, R_COMMIT, R_LAST, R_LEADER, R_ROLE, R_TERM, R_VOTE,
+        ROLE_LEADER, U_COMMIT, U_LEADER, U_LOST_LEAD, U_ROLE, U_STATE,
+    )
+    from dragonboat_tpu.pb import Entry, State, UpdateCommit
+    from dragonboat_tpu.raft.log import InMemLogReader
+    from dragonboat_tpu.raft.peer import Peer
+    from dragonboat_tpu.raft.raft import Raft, RaftRole
+    from dragonboat_tpu.request import (
+        NO_DEADLINE, PendingConfigChange, PendingLeaderTransfer,
+        PendingProposal, PendingReadIndex, PendingSnapshot, gc_tables,
+    )
+    from dragonboat_tpu.rsm.statemachine import Task, TaskType
+    from dragonboat_tpu.storage.logdb import InMemLogDB
+
+    if rows_list is None:
+        rows_list = [10_000]
+        if bool(int(os.environ.get("BENCH_UPDATELANES_HEAVY", "0"))):
+            rows_list += [50_000, 250_000]
+
+    N_ENTRIES = 16  # pre-appended log depth commits walk through
+
+    class _TaskQueue:  # counts handoffs; apply itself is out of scope
+        __slots__ = ("n",)
+
+        def __init__(self):
+            self.n = 0
+
+        def add(self, t):
+            self.n += 1
+
+    class _SM:
+        __slots__ = ("last_applied", "task_queue")
+
+        def __init__(self):
+            self.last_applied = 0
+            self.task_queue = _TaskQueue()
+
+    class _DevReads:
+        __slots__ = ()
+
+        def has_pending(self):
+            return False
+
+    _DR = _DevReads()
+
+    class _BenchNode:
+        """Light stand-in with the REAL cost centers: real Raft, real
+        Peer, real shared-lock pending tables + deadline hint, the
+        node.py process_update/dispatch_dropped/_check_leader_change
+        statement shapes (Node itself needs transports/logdbs/SMs —
+        unbuildable at 250k rows)."""
+
+        __slots__ = (
+            "peer", "tick_count", "pending_proposal",
+            "pending_read_index", "pending_config_change",
+            "pending_snapshot", "pending_leader_transfer",
+            "pending_tables", "pending_deadline_hint", "sm", "stopped",
+            "leader_id", "device_reads", "logdb", "shard_id",
+            "replica_id", "engine_apply_ready", "_trace_spans",
+            "hs_lane_slot",
+        )
+
+        def __init__(self, sid, rid, logdb):
+            r = Raft(
+                shard_id=sid, replica_id=rid,
+                peers={rid: "a", 98: "b", 99: "c"},
+                log_reader=InMemLogReader(),
+            )
+            self.peer = Peer(r)
+            self.shard_id, self.replica_id = sid, rid
+            self.tick_count = 0
+            lock = threading.Lock()
+            hint = [NO_DEADLINE]
+            self.pending_deadline_hint = hint
+            self.pending_proposal = PendingProposal(
+                lock, deadline_hint=hint
+            )
+            self.pending_read_index = PendingReadIndex(
+                lock, deadline_hint=hint
+            )
+            self.pending_config_change = PendingConfigChange(
+                lock, deadline_hint=hint
+            )
+            self.pending_snapshot = PendingSnapshot(
+                lock, deadline_hint=hint
+            )
+            self.pending_leader_transfer = PendingLeaderTransfer(
+                lock, deadline_hint=hint
+            )
+            self.pending_tables = (
+                self.pending_proposal, self.pending_read_index,
+                self.pending_config_change, self.pending_snapshot,
+                self.pending_leader_transfer,
+            )
+            self.sm = _SM()
+            self.stopped = False
+            self.leader_id = 0
+            self.device_reads = _DR
+            self.logdb = logdb
+            self.engine_apply_ready = None
+            self._trace_spans = {}
+            self.hs_lane_slot = -1
+
+        def dispatch_dropped(self, u):
+            for e in u.dropped_entries:
+                pass
+            for _c in u.dropped_read_indexes:
+                pass
+
+        def _check_leader_change(self):
+            lid = self.peer.leader_id()
+            if lid != self.leader_id:
+                self.leader_id = lid
+
+        def process_update(self, u):  # node.py's statement shape
+            if self._trace_spans:
+                pass
+            scheduled = False
+            if not u.snapshot.is_empty():
+                scheduled = True
+            if u.entries_to_save:
+                ents = u.entries_to_save
+                assert all(
+                    ents[i].index + 1 == ents[i + 1].index
+                    for i in range(len(ents) - 1)
+                )
+            for _m in u.messages:
+                pass
+            if u.ready_to_reads:
+                pass
+            if u.committed_entries:
+                self.sm.task_queue.add(
+                    Task(type=TaskType.ENTRIES, entries=u.committed_entries)
+                )
+                scheduled = True
+            self.peer.commit(u)
+            return scheduled
+
+    def _tick_bookkeeping_r8(node, ticks):
+        """The pre-r9 bookkeeping verbatim: five per-table gc calls."""
+        if not ticks:
+            return
+        node.tick_count += ticks
+        node.peer.raft.tick_count += ticks
+        node.pending_proposal.gc(node.tick_count)
+        node.pending_read_index.gc(node.tick_count)
+        node.pending_config_change.gc(node.tick_count)
+        node.pending_snapshot.gc(node.tick_count)
+        node.pending_leader_transfer.gc(node.tick_count)
+
+    def _scalar_stage(db, nodes, vals_np, pos_l, ticks_l, G):
+        """The r8 update-stage residual verbatim (the old
+        _complete_generation tail + _persist_and_process chain)."""
+        updates = []
+        vals_l = vals_np.tolist()
+        t0 = _time.perf_counter()
+        for g in range(G):
+            node = nodes[g]
+            if node.stopped:
+                continue
+            r = node.peer.raft
+            _tick_bookkeeping_r8(node, ticks_l[g])
+            k = pos_l[g]
+            if k < 0:
+                continue
+            sv = vals_l[k]
+            term, vote, committed, leader, role, last = sv[:6]
+            r.term, r.vote, r.leader_id = term, vote, leader
+            r.role = RaftRole(role)
+            if committed > r.log.committed:
+                r.log.commit_to(committed)
+            if (
+                role != int(RaftRole.LEADER)
+                and node.device_reads.has_pending()
+            ):
+                node.drop_device_reads()
+            u = node.peer.get_update(last_applied=node.sm.last_applied)
+            node.dispatch_dropped(u)
+            updates.append((node, u))
+            node._check_leader_change()
+        by_db = {}
+        for node, u in updates:
+            by_db.setdefault(id(node.logdb), (node.logdb, []))[1].append(
+                (node, u)
+            )
+        for db_, pairs in by_db.values():
+            db_.save_raft_state([u for _, u in pairs], 0)
+            for node, u in pairs:
+                if node.process_update(u):
+                    if node.engine_apply_ready is not None:
+                        node.engine_apply_ready(node.shard_id)
+        return _time.perf_counter() - t0, len(updates)
+
+    def _lane_stage(db, nodes, vals_np, sum_rows, ticks_l, ulanes,
+                    bases, G, slot_np):
+        """The r9 lane path (ops/colocated._lane_commit_pass shape —
+        open-coded in lockstep with both engine merge tails; see the
+        note in engine._device_step's lane branch)."""
+        t0 = _time.perf_counter()
+        # batched bookkeeping, inlined like the engines' passes:
+        # clock lockstep + hint-gated single-lock sweeps
+        for node, t in zip(nodes, ticks_l):
+            if not t or node.stopped:
+                continue
+            tc = node.tick_count + t
+            node.tick_count = tc
+            node.peer.raft.tick_count += t
+            if tc >= node.pending_deadline_hint[0]:
+                gc_tables(
+                    node.pending_tables, node.pending_deadline_hint, tc
+                )
+        gs = sum_rows
+        old_w = ulanes.words[:, gs]
+        uplan = hp.plan_update_sync(
+            old_w, np.arange(len(gs)), vals_np, bases[gs]
+        )
+        ulanes.words[:, gs] = uplan.words
+        ub_l = uplan.ubits.tolist()
+        w_term = uplan.words[R_TERM].tolist()
+        w_vote = uplan.words[R_VOTE].tolist()
+        w_com = uplan.words[R_COMMIT].tolist()
+        w_lead = uplan.words[R_LEADER].tolist()
+        w_role = uplan.words[R_ROLE].tolist()
+        # slot-backed rows take the array-batched persist (the
+        # engine's _persist_lane_batches shape): the loop only records
+        # exceptions; commit rows hand (node, entries) to the
+        # post-save apply leg
+        so_mask = (uplan.ubits & (U_STATE | U_COMMIT)) != 0
+        so_drop = []
+        lane_rows = []
+        lane_append = lane_rows.append
+        lane_apply = []
+        fulls = []
+        for gi, ub, term, vote, committed, leader, role, so in zip(
+            gs.tolist(), ub_l, w_term, w_vote, w_com, w_lead, w_role,
+            so_mask.tolist(),
+        ):
+            node = nodes[gi]
+            if node.stopped:
+                if so:
+                    so_drop.append(gi)
+                continue
+            r = node.peer.raft
+            log = r.log
+            im = log.inmem
+            if (
+                r.msgs or r.ready_to_reads or r.dropped_entries
+                or r.dropped_read_indexes or im.snapshot.index
+                or im.saved_to + 1 - im.marker < len(im.entries)
+            ):
+                if so:
+                    so_drop.append(gi)
+                r.term, r.vote, r.leader_id = term, vote, leader
+                r.role = _ROLE_OF[role]
+                if committed > log.committed:
+                    log.commit_to(committed)
+                u = node.peer.get_update(
+                    last_applied=node.sm.last_applied
+                )
+                node.dispatch_dropped(u)
+                fulls.append((node, u))
+                node._check_leader_change()
+                continue
+            if ub & U_STATE:
+                r.term = term
+                r.vote = vote
+            if ub & U_LEADER:
+                r.leader_id = leader
+            if ub & U_ROLE:
+                r.role = _ROLE_OF[role]
+            if ub & U_LOST_LEAD and node.device_reads.has_pending():
+                node.drop_device_reads()
+            if ub & U_COMMIT:
+                log.commit_to(committed)
+                ce = log.entries_to_apply()
+                if so:
+                    lane_apply.append((node, ce))
+                else:
+                    lane_append((node, term, vote, committed, ce))
+            elif ub & U_STATE and not so:
+                lane_append((node, term, vote, committed, None))
+            if ub & U_LEADER:
+                node._check_leader_change()
+        n_so = 0
+        if so_mask.any():
+            if so_drop:
+                so_mask &= ~np.isin(gs, np.asarray(so_drop))
+            ii = np.nonzero(so_mask)[0]
+            n_so = len(ii)
+            if n_so:
+                w = uplan.words
+                db.save_state_slots(
+                    slot_np[gs[ii]], w[R_TERM][ii], w[R_VOTE][ii],
+                    w[R_COMMIT][ii], 0,
+                )
+                for node, ce in lane_apply:
+                    node.sm.task_queue.add(
+                        Task(type=TaskType.ENTRIES, entries=ce)
+                    )
+                    log = node.peer.raft.log
+                    log.processed = ce[-1].index
+                    # amortized in-mem GC (_persist_lane_batches)
+                    im = log.inmem
+                    if log.processed - im.marker >= 32:
+                        im.applied_log_to(log.processed)
+                    if node.engine_apply_ready is not None:
+                        node.engine_apply_ready(node.shard_id)
+        if lane_rows:
+            by_db = {}
+            for t in lane_rows:
+                d = t[0].logdb
+                by_db.setdefault(id(d), (d, []))[1].append(t)
+            for d, rs in by_db.values():
+                # commit rows keep the tuple form (their entries ride
+                # along); cached-slot save like _persist_lane_rows
+                get_slot = d.state_lane_slot
+                slots = []
+                for t in rs:
+                    nd = t[0]
+                    s = nd.hs_lane_slot
+                    if s < 0:
+                        s = get_slot(nd.shard_id, nd.replica_id)
+                        nd.hs_lane_slot = s
+                    slots.append(s)
+                d.save_state_slots(
+                    slots,
+                    [t[1] for t in rs], [t[2] for t in rs],
+                    [t[3] for t in rs], 0,
+                )
+                for node, _t, _v, _c, ce in rs:
+                    if ce:
+                        node.sm.task_queue.add(
+                            Task(type=TaskType.ENTRIES, entries=ce)
+                        )
+                        log = node.peer.raft.log
+                        log.processed = ce[-1].index
+                        im = log.inmem
+                        if log.processed - im.marker >= 32:
+                            im.applied_log_to(log.processed)
+                        if node.engine_apply_ready is not None:
+                            node.engine_apply_ready(node.shard_id)
+        if fulls:
+            for node, u in fulls:
+                node.logdb.save_raft_state([u], 0)
+                node.process_update(u)
+        return (
+            _time.perf_counter() - t0,
+            len(lane_rows) + len(fulls) + n_so,
+        )
+
+    def _gen(rng, G, ulanes, commits, mode, it):
+        """One fabricated generation over the CURRENT lane state so
+        both populations see identical, consistent inputs."""
+        if mode == "steady":
+            sr = np.zeros((0,), np.int64)
+            v = np.zeros((0, N_VALS), np.int64)
+            ticks = np.where(rng.random(G) < 0.8, 2, 0)
+        else:
+            aff = 0.30 if mode == "election" else 0.15
+            sr = np.nonzero(rng.random(G) < aff)[0]
+            n = len(sr)
+            v = np.zeros((n, N_VALS), np.int64)
+            v[:, R_ROLE] = int(RaftRole.FOLLOWER)
+            v[:, R_LAST] = N_ENTRIES
+            if mode == "election":
+                # term/vote/leader churn, no commit movement — the
+                # mass-election population of the r5 Config-4 ledger
+                v[:, R_TERM] = 100 + it
+                v[:, R_VOTE] = 1 + (it % 3)
+                v[:, R_LEADER] = np.where(
+                    rng.random(n) < 0.5, 1 + (it % 3), 0
+                )
+                v[:, R_COMMIT] = ulanes.words[R_COMMIT, sr]
+            else:  # commit_wave: commit advances by 1 w/ real entries
+                v[:, R_TERM] = ulanes.words[R_TERM, sr]
+                v[:, R_VOTE] = ulanes.words[R_VOTE, sr]
+                v[:, R_LEADER] = ulanes.words[R_LEADER, sr]
+                v[:, R_COMMIT] = np.minimum(
+                    ulanes.words[R_COMMIT, sr] + 1, N_ENTRIES
+                )
+            ticks = np.where(rng.random(G) < 0.3, 1, 0)
+        pos = np.full((G,), -1, np.int32)
+        if len(sr):
+            pos[sr] = np.arange(len(sr), dtype=np.int32)
+        return sr, v, pos, ticks.tolist()
+
+    tiers = []
+    for G in rows_list:
+        db_s, db_l = InMemLogDB(), InMemLogDB()
+        nodes_s = [_BenchNode(1 + i // 3, 1 + i % 3, db_s) for i in range(G)]
+        nodes_l = [_BenchNode(1 + i // 3, 1 + i % 3, db_l) for i in range(G)]
+        ents = [
+            Entry(term=1, index=j + 1, cmd=b"x" * 16)
+            for j in range(N_ENTRIES)
+        ]
+        for pop in (nodes_s, nodes_l):
+            for nd in pop:
+                nd.peer.raft.log.append(list(ents))
+                nd.peer.raft.log.inmem.saved_log_to(N_ENTRIES, 1)
+        ulanes = hp.UpdateLanes(G)
+        slot_np = np.zeros((G,), np.int64)
+        for g, nd in enumerate(nodes_l):
+            r = nd.peer.raft
+            ulanes.seed_row(
+                g, r.term, r.vote, r.log.committed, r.leader_id,
+                int(r.role), r.log.last_index(),
+            )
+            # slot resolution is an upload-time event in the engine
+            # (ops/engine._upload_rows) — same here, outside the timer
+            s = db_l.state_lane_slot(nd.shard_id, nd.replica_id)
+            nd.hs_lane_slot = s
+            slot_np[g] = s
+        # a slice of rows holds live far-deadline futures (realistic
+        # in-flight proposals; arms the hint without firing it)
+        for pop in (nodes_s, nodes_l):
+            for i in range(0, G, 50):
+                pop[i].pending_proposal._alloc(10**9)
+        bases = np.zeros((G,), np.int64)
+        rng = np.random.default_rng(13)
+        script = ["election"] * 4 + ["commit_wave"] * 2 + ["steady"] * 2
+        shapes = {}
+        tot_s = tot_l = 0.0
+        for rep in range(reps + 1):
+            for si, mode in enumerate(script):
+                it = rep * len(script) + si
+                sr, v, pos, ticks_l = _gen(rng, G, ulanes, None, mode, it)
+                # plan parity OUTSIDE the timed window
+                if len(sr):
+                    old_w = np.array(ulanes.words[:, sr], copy=True)
+                    hp.assert_update_plan_parity(
+                        old_w, np.arange(len(sr)), v, bases[sr],
+                        hp.plan_update_sync(
+                            old_w, np.arange(len(sr)), v, bases[sr]
+                        ),
+                    )
+                _gc.collect()
+                ts, n_s = _scalar_stage(
+                    db_s, nodes_s, v, pos.tolist(), ticks_l, G
+                )
+                _gc.collect()
+                tl, n_l = _lane_stage(
+                    db_l, nodes_l, v, sr, ticks_l, ulanes, bases, G,
+                    slot_np,
+                )
+                if rep == 0:
+                    continue  # warm rep: allocator/caches settle
+                tot_s += ts
+                tot_l += tl
+                e = shapes.setdefault(mode, [0.0, 0.0, 0])
+                e[0] += ts
+                e[1] += tl
+                e[2] += 1
+        # full-population parity OUTSIDE the timed windows: both
+        # loops must leave identical raft words + identical apply
+        # handoff counts
+        diverged = sum(
+            1
+            for a, b in zip(nodes_s, nodes_l)
+            if (
+                a.peer.raft.term, a.peer.raft.vote,
+                a.peer.raft.log.committed, a.peer.raft.leader_id,
+                a.peer.raft.role, a.peer.raft.log.processed,
+            ) != (
+                b.peer.raft.term, b.peer.raft.vote,
+                b.peer.raft.log.committed, b.peer.raft.leader_id,
+                b.peer.raft.role, b.peer.raft.log.processed,
+            )
+        )
+        tasks_s = sum(nd.sm.task_queue.n for nd in nodes_s)
+        tasks_l = sum(nd.sm.task_queue.n for nd in nodes_l)
+        # persisted hard state must match too (the lane path's batched
+        # save_state_slots vs the scalar save_raft_state chain) —
+        # sampled, and read AFTER the run so InMemLogDB materializes
+        # any pending lane words through its reader path
+        db_diverged = 0
+        for i in range(0, G, 37):
+            a, b = nodes_s[i], nodes_l[i]
+            ra = db_s.read_raft_state(a.shard_id, a.replica_id, 0)
+            rb = db_l.read_raft_state(b.shard_id, b.replica_id, 0)
+            sa = ra.state if ra is not None else None
+            sb = rb.state if rb is not None else None
+            ta = (sa.term, sa.vote, sa.commit) if sa else None
+            tb = (sb.term, sb.vote, sb.commit) if sb else None
+            db_diverged += ta != tb
+        diverged += db_diverged
+        tier = {
+            "rows": G,
+            "gens": reps * len(script),
+            "t_stage_scalar_ms": round(tot_s * 1000, 1),
+            "t_stage_lane_ms": round(tot_l * 1000, 1),
+            "stage_speedup": round(tot_s / max(tot_l, 1e-9), 1),
+            "parity_divergences": diverged,
+            "apply_handoffs": [tasks_s, tasks_l],
+        }
+        for mode, (a, b, c) in shapes.items():
+            tier[f"{mode}_speedup"] = round(a / max(b, 1e-9), 1)
+            tier[f"{mode}_ms"] = [round(a * 1000, 1), round(b * 1000, 1)]
+        tiers.append(tier)
+        del nodes_s, nodes_l
+        _gc.collect()
+    ok = all(
+        t["parity_divergences"] == 0
+        and t["apply_handoffs"][0] == t["apply_handoffs"][1]
+        for t in tiers
+    )
+    return {"tiers": tiers, "parity": ok}
+
+
 def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
     """Serial vs double-buffered colocated launch loop under the
     simulated-tunnel sync-latency shim (ROADMAP item 2 / ISSUE 11).
@@ -2219,7 +2775,7 @@ def main() -> None:
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
              gateway=None, bigstate=None, hostplane=None,
-             pipeline=None, multichip=None) -> None:
+             pipeline=None, multichip=None, updatelanes=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -2276,6 +2832,11 @@ def main() -> None:
                     # (shard_map G-sharding + collective exchange lane
                     # at 1-8 forced host devices — docs/MULTICHIP.md)
                     "multichip": multichip,
+                    # r15 schema addition: update-lane guard
+                    # (ops/hostplane.UpdateLanes; scalar-vs-lane
+                    # update-stage residual per rows tier — the ISSUE-13
+                    # "Raft-less host rows" wall, docs/BENCH_NOTES_r09.md)
+                    "updatelanes": updatelanes,
                 }
             ),
             flush=True,
@@ -2531,6 +3092,24 @@ def main() -> None:
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb, hpb, ppb, mcb)
 
+    # Update-lane guard (pure numpy — no device, cheap): scalar-vs-lane
+    # update-stage residual per rows tier (BENCH_UPDATELANES gate; heavy
+    # 50k/250k tiers ride BENCH_UPDATELANES_HEAVY=1 like the hostplane
+    # guard — docs/BENCH_NOTES_r09.md)
+    ulb = None
+    if bool(int(os.environ.get("BENCH_UPDATELANES", "1"))) and remaining() > 45:
+        code = (
+            "import json, bench;"
+            "print('BENCHUL ' + json.dumps(bench.phase_updatelanes()))"
+        )
+        ulb, ul_err = run_sub(
+            code, "BENCHUL", max(45, min(240, int(remaining() - 30)))
+        )
+        if ulb is None:
+            ulb = {"error": ul_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb, ppb, mcb, ulb)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -2570,5 +3149,9 @@ if __name__ == "__main__":
         # (spawns its own per-device-count subprocesses; no backend is
         # initialized in THIS process, so the forced counts latch)
         print("BENCHMC " + json.dumps(phase_multichip()), flush=True)
+    elif "phase_updatelanes" in _sys.argv[1:]:
+        # standalone update-lane run: `python bench.py phase_updatelanes`
+        # (host-only numpy; BENCH_UPDATELANES_HEAVY=1 adds 50k/250k)
+        print("BENCHUL " + json.dumps(phase_updatelanes()), flush=True)
     else:
         main()

@@ -63,14 +63,16 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     one mutex.
 
 ``host-loop``
-    In the colocated host-plane modules (``ops/colocated.py``,
+    In the host-plane modules (``ops/colocated.py``, ``ops/engine.py``,
     ``ops/hostplane.py``), a function whose ``def`` line carries a
     ``# hostplane-hot`` comment is a declared array-at-once pass over
     ALL rows of a generation: ``for`` statements and comprehensions
     are banned inside it — per-row Python in the plan/merge stages is
     exactly what the r6 vectorization removed (t_plan 887 s +
     t_updates 538 s of a 2,731 s 50k-shard election at 250k rows,
-    docs/BENCH_NOTES_r05.md) and must not rot back in.  A ``#
+    docs/BENCH_NOTES_r05.md) and must not rot back in; the r9
+    update-lane assembly/sync functions (plan_update_sync and friends,
+    ISSUE 13) carry the same marker.  A ``#
     raftlint: ignore[host-loop] <reason>`` on the ``def`` line (or on
     a pure-comment line directly above it) exempts a whole function —
     the documented scalar fallbacks and parity oracles (``*_scalar``
@@ -185,9 +187,12 @@ GATEWAY_MODULES = ("dragonboat_tpu/gateway/",)
 GATEWAY_HOT_RE = re.compile(r"#\s*gateway-hot\b")
 
 # the colocated host plane: `# hostplane-hot` functions are
-# array-at-once passes — no for-over-rows (docs/ANALYSIS.md)
+# array-at-once passes — no for-over-rows (docs/ANALYSIS.md).
+# ops/engine.py joined for the ISSUE-13 update-lane assembly/sync
+# functions (the base engine's merge tail shares the lane machinery).
 HOSTPLANE_MODULES = (
     "dragonboat_tpu/ops/colocated.py",
+    "dragonboat_tpu/ops/engine.py",
     "dragonboat_tpu/ops/hostplane.py",
 )
 HOSTPLANE_HOT_RE = re.compile(r"#\s*hostplane-hot\b")

@@ -38,6 +38,7 @@ from .raft.quiesce import QuiesceManager
 from .raft.read_index import ReadIndex as _DeviceReadIndex
 from .raftio import EntryInfo, NodeInfoEvent, SnapshotInfo
 from .request import (
+    NO_DEADLINE,
     PendingConfigChange,
     PendingLeaderTransfer,
     PendingProposal,
@@ -45,6 +46,7 @@ from .request import (
     PendingSnapshot,
     RequestState,
     SystemBusy,
+    gc_tables,
 )
 from .rsm.managed import wrap_state_machine
 from .rsm.statemachine import (
@@ -121,7 +123,8 @@ class Node:
         "_leader_transfers", "_pending_ticks",
         "_ticks_in", "_ticks_taken",
         "pending_proposal", "pending_read_index", "pending_config_change",
-        "pending_snapshot", "pending_leader_transfer", "device_reads",
+        "pending_snapshot", "pending_leader_transfer", "pending_tables",
+        "pending_deadline_hint", "device_reads", "hs_lane_slot",
         "tick_count", "leader_id", "proposal_count", "stopped", "stopping",
         "_snapshotting",
         "_applied_since_snapshot", "_retired_snapshots", "_apply_lock",
@@ -207,26 +210,46 @@ class Node:
             return ((config.replica_id & 0xFFF) << 48) | _SYSRAND.getrandbits(47)
 
         _tables_lock = threading.Lock()  # shared: see _PendingBase
+        # shared earliest-deadline hint cell: the tick paths (scalar
+        # tail below, ops/engine._tick_bookkeeping) probe it lock-free
+        # and sweep all five tables under ONE lock acquisition only
+        # when the clock reaches it (request.gc_tables)
+        self.pending_deadline_hint = [NO_DEADLINE]
         self.pending_proposal = PendingProposal(
-            _tables_lock, key_base=key_base()
+            _tables_lock, key_base=key_base(),
+            deadline_hint=self.pending_deadline_hint,
         )
         self.pending_read_index = PendingReadIndex(
-            _tables_lock, key_base=key_base()
+            _tables_lock, key_base=key_base(),
+            deadline_hint=self.pending_deadline_hint,
         )
         self.pending_config_change = PendingConfigChange(
-            _tables_lock, key_base=key_base()
+            _tables_lock, key_base=key_base(),
+            deadline_hint=self.pending_deadline_hint,
         )
         self.pending_snapshot = PendingSnapshot(
-            _tables_lock, key_base=key_base()
+            _tables_lock, key_base=key_base(),
+            deadline_hint=self.pending_deadline_hint,
         )
         self.pending_leader_transfer = PendingLeaderTransfer(
-            _tables_lock, key_base=key_base()
+            _tables_lock, key_base=key_base(),
+            deadline_hint=self.pending_deadline_hint,
+        )
+        self.pending_tables = (
+            self.pending_proposal, self.pending_read_index,
+            self.pending_config_change, self.pending_snapshot,
+            self.pending_leader_transfer,
         )
         # ctx/quorum table for DEVICE-resident reads (ops/engine.py): the
         # kernel serves the protocol (gate + ctx heartbeats); the host
         # tracks which voters echoed each ctx.  Scalar-path reads use
         # peer.raft.read_index instead — the two never overlap.
         self.device_reads = _DeviceReadIndex()
+        # cached hard-state lane slot in this node's LogDB (the ILogDB
+        # optional slot protocol; -1 = unresolved).  Resolved once by
+        # the device merge tail's first batched lane save; stable for
+        # the node's life (the node<->logdb binding never changes).
+        self.hs_lane_slot = -1
 
         self.tick_count = 0
         self.leader_id = 0
@@ -791,21 +814,23 @@ class Node:
                 self.peer.quiesced_tick()
             else:
                 self.peer.tick()
-            # tick-driven GC of timed-out futures
-            self.pending_proposal.gc(self.tick_count)
-            self.pending_read_index.gc(self.tick_count)
-            self.pending_config_change.gc(self.tick_count)
-            self.pending_snapshot.gc(self.tick_count)
-            self.pending_leader_transfer.gc(self.tick_count)
+            # tick-driven GC of timed-out futures: hint-gated — one
+            # int compare per tick, a five-table single-lock sweep
+            # only when the clock reaches the earliest pending
+            # deadline (request.gc_tables keeps the timeout-delivery
+            # tick exactly what the old sweep-every-tick loop gave)
+            gc_tables(
+                self.pending_tables, self.pending_deadline_hint,
+                self.tick_count,
+            )
         if si.gc_ticks:
             # backlog-dropped ticks: clock + deadline GC only (deadlines
             # are monotone, so one pass at the final count is exact)
             self.tick_count += si.gc_ticks
-            self.pending_proposal.gc(self.tick_count)
-            self.pending_read_index.gc(self.tick_count)
-            self.pending_config_change.gc(self.tick_count)
-            self.pending_snapshot.gc(self.tick_count)
-            self.pending_leader_transfer.gc(self.tick_count)
+            gc_tables(
+                self.pending_tables, self.pending_deadline_hint,
+                self.tick_count,
+            )
 
         self._check_leader_change()
 
@@ -856,6 +881,21 @@ class Node:
                     )
                     break
         for e in u.committed_entries:
+            if e.type != app:
+                continue
+            s = ts.get(e.key)
+            if s is not None:
+                s.annotate(f"raft:committed index={e.index}")
+
+    def _trace_committed(self, entries) -> None:
+        """The committed leg of ``_trace_update`` alone, for the device
+        merge tail's LANE rows (ops/engine.py): their commit advances
+        carry no ``Update`` object, so the per-entry span annotation
+        must ride the lane handoff directly.  Called only when
+        ``_trace_spans`` is non-empty."""
+        ts = self._trace_spans
+        app = EntryType.APPLICATION
+        for e in entries:
             if e.type != app:
                 continue
             s = ts.get(e.key)

@@ -59,6 +59,7 @@ from ..logger import get_logger
 from ..node import StepInputs
 from ..pb import Entry
 from ..raft.raft import RaftRole
+from ..request import gc_tables
 from . import kernel as K
 from . import sync as S
 from .engine import (
@@ -80,6 +81,7 @@ from .engine import (
     _R_TERM,
     _R_VOTE,
     _R_LAST,
+    _ROLE_OF,
     _bucket,
     _place_rows,
     _pos_map,
@@ -96,6 +98,14 @@ from .engine import (
     _tick_bookkeeping,
     _pad_idx,
     _set_remote_snapshot,
+)
+from .types import (
+    ROLE_LEADER as _ROLE_LEADER_I,
+    U_COMMIT,
+    U_LEADER,
+    U_LOST_LEAD,
+    U_ROLE,
+    U_STATE,
 )
 from .route import build_route_tables, route
 from .types import (
@@ -1676,21 +1686,60 @@ class ColocatedVectorEngine(VectorStepEngine):
         return (pos_buf, pos_slot, pos_need, pos_ring, pos_sum,
                 rows_sum[:n_sum])
 
-    def _early_commit_pass(self, live, flags, pos_sum, pos_buf, pos_slot,
-                           pos_need, vals_np, early_done) -> List[Tuple]:
-        """Complete commit-only rows straight off the head blob.
+    def _bookkeeping_pass(self, live) -> None:
+        """Batched tick bookkeeping for one generation's live rows —
+        hoisted out of the merge loops so every row pays it exactly
+        once, BEFORE any effects merge (and AFTER _lease_pass: lease
+        window starts stamp the PRE-launch clock).  Zero-tick rows (a
+        launch-rate above the wall-tick cadence makes them the
+        majority) skip with two attribute loads; ticked rows advance
+        both clocks and take the hint-gated single-lock pending-table
+        sweep inside _tick_bookkeeping."""
+        meta_get = self._meta.get
+        for node, g, si in live:
+            if si is None:
+                continue
+            t = si.ticks + si.gc_ticks
+            if t and not node.stopped and meta_get(g) is not None:
+                # _tick_bookkeeping, inlined (clock lockstep +
+                # hint-gated single-lock pending-table sweep)
+                tc = node.tick_count + t
+                node.tick_count = tc
+                node.peer.raft.tick_count += t
+                if tc >= node.pending_deadline_hint[0]:
+                    gc_tables(
+                        node.pending_tables,
+                        node.pending_deadline_hint, tc,
+                    )
+
+    def _lane_commit_pass(self, live, flags, pos_sum, pos_buf, pos_slot,
+                          pos_need, vals_np, early_done) -> None:
+        """Array-side update assembly for commit-only rows — the
+        update-lane contract (ISSUE 13; docs/PARITY.md).
 
         Eligible: live rows with a values entry but no append, no
         host-visible outbox bytes, no proposal slots and no
         snapshot-needing peer — their whole merge is the scalar sync +
-        commit advance + update construction, none of which touches the
-        detail payload.  Their updates persist immediately, so a
-        proposal appended in an earlier generation whose commit this
-        generation proves completes without waiting for the detail
-        payload or the heavy merge tail.  Marks completed positions in
-        ``early_done`` so the main loop skips them."""
+        commit advance + update emission, none of which touches the
+        detail payload.  One ``plan_update_sync`` pass over the update
+        lanes classifies their effects (``U_*`` bits vs the last
+        synced words); the residual loop then only writes the scalar
+        words that moved and collects ``(node, term, vote, commit,
+        entries)`` LANE tuples for ONE batched ``_persist_lane_rows``
+        call — no per-row ``get_update`` walk, no per-row Update/
+        State/UpdateCommit objects.  On the pipelined path this still
+        runs straight off the HEAD blob, so a proposal whose commit
+        this generation proves completes without waiting for the
+        detail payload (PR 11's early-completion win, kept).
+
+        Rows with scalar-side residue (pending raft msgs / reads /
+        drops / unsaved entries / snapshot — a resident-clean row
+        should never accumulate any; defense in depth) fall back to
+        the classic get_update emission.  Marks completed positions in
+        ``early_done`` so the heavy loop skips them."""
         if not live:
-            return []
+            return
+        # raftlint: ignore[sync-budget] host-built index array, not a device readback
         gs_all = np.asarray([g for _, g, _ in live], np.int64)
         sum_k = pos_sum[gs_all]
         eligible = (
@@ -1701,38 +1750,145 @@ class ColocatedVectorEngine(VectorStepEngine):
             & (pos_need[gs_all] < 0)
         )
         if not eligible.any():
-            return []
-        updates: List[Tuple] = []
-        sum_k_l = sum_k.tolist()
-        for j in np.nonzero(eligible)[0].tolist():
+            return
+        idx = np.nonzero(eligible)[0]
+        gs = gs_all[idx]
+        k_sel = sum_k[idx]
+        old_w = self._ulanes.words[:, gs]
+        uplan = hostplane.plan_update_sync(
+            old_w, k_sel, vals_np, self._base[gs]
+        )
+        if hostplane.PARITY:
+            hostplane.check_update_plan_parity(
+                old_w, k_sel, vals_np, self._base[gs], uplan
+            )
+        # rows the loop below skips (stopped/halted mid-flight) are
+        # freed and re-seeded at their next upload — bulk write is moot
+        # for them, exactly the mirror-table argument
+        self._ulanes.words[:, gs] = uplan.words
+        ub_l = uplan.ubits.tolist()
+        w_term = uplan.words[_R_TERM].tolist()
+        w_vote = uplan.words[_R_VOTE].tolist()
+        w_com = uplan.words[_R_COMMIT].tolist()
+        w_lead = uplan.words[_R_LEADER].tolist()
+        w_role = uplan.words[_R_ROLE].tolist()
+        # rows eligible for the array-batched persist (hard-state
+        # effect, slot-backed store; `eligible` already proved no heavy
+        # sections) — the loop only records exceptions; commit rows
+        # hand (node, entries) to the post-save apply leg
+        so_mask = (
+            ((uplan.ubits & (U_STATE | U_COMMIT)) != 0)
+            & (self._lane_dbi[gs] >= 0)
+        )
+        so_drop: List[int] = []
+        meta_get = self._meta.get
+        lane_rows: List[Tuple] = []
+        lane_append = lane_rows.append
+        lane_apply: List[Tuple] = []
+        fulls: List[Tuple] = []
+        for j, ub, term, vote, committed, leader, role, so in zip(
+            idx.tolist(), ub_l, w_term, w_vote, w_com, w_lead, w_role,
+            so_mask.tolist(),
+        ):
             node, g, si = live[j]
             early_done[j] = True
-            if node.stopped or self._meta.get(g) is None:
+            if node.stopped or meta_get(g) is None:
+                if so:
+                    so_drop.append(j)
                 continue
             r = node.peer.raft
-            base = int(self._base[g])
-            if si is not None:
-                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
-            sv = vals_np[sum_k_l[j]]
-            term, vote, committed, leader, role = (
-                int(sv[_R_TERM]), int(sv[_R_VOTE]), int(sv[_R_COMMIT]),
-                int(sv[_R_LEADER]), int(sv[_R_ROLE]),
-            )
-            committed += base
-            r.term, r.vote, r.leader_id = term, vote, leader
-            r.role = RaftRole(role)
-            if committed > r.log.committed:
-                r.log.commit_to(committed)
+            log = r.log
+            im = log.inmem
+            # NOTE: open-coded in lockstep with the engine lane branch
+            # and the bench twin — see the note in engine._device_step
             if (
-                role != int(RaftRole.LEADER)
-                and node.device_reads.has_pending()
+                r.msgs or r.ready_to_reads or r.dropped_entries
+                or r.dropped_read_indexes or im.snapshot.index
+                or im.saved_to + 1 - im.marker < len(im.entries)
             ):
+                # residue: the classic path drains it
+                if so:
+                    so_drop.append(j)
+                r.term, r.vote, r.leader_id = term, vote, leader
+                r.role = _ROLE_OF[role]
+                if committed > log.committed:
+                    log.commit_to(committed)
+                if (
+                    role != _ROLE_LEADER_I
+                    and node.device_reads.has_pending()
+                ):
+                    node.drop_device_reads()
+                u = node.peer.get_update(
+                    last_applied=node.sm.last_applied
+                )
+                node.dispatch_dropped(u)
+                fulls.append((node, u))
+                node._check_leader_change()
+                continue
+            if ub & U_STATE:
+                r.term = term
+                r.vote = vote
+            if ub & U_LEADER:
+                r.leader_id = leader
+            if ub & U_ROLE:
+                r.role = _ROLE_OF[role]
+            if ub & U_LOST_LEAD and node.device_reads.has_pending():
+                # leadership lost: confirmations will never arrive.
+                # Exact for lane rows — device reads only register off
+                # merged outbox messages (a heavy row by definition),
+                # so any pending read predates this sync and the
+                # losing transition is THIS generation's lane diff
+                # (docs/PARITY.md "Update-lane contract").
                 node.drop_device_reads()
-            u = node.peer.get_update(last_applied=node.sm.last_applied)
-            node.dispatch_dropped(u)
-            updates.append((node, u))
-            node._check_leader_change()
-        return updates
+            if ub & U_COMMIT:
+                log.commit_to(committed)
+                ce = log.entries_to_apply()
+                if so:
+                    lane_apply.append((g, node, ce))
+                else:
+                    lane_append((node, term, vote, committed, ce))
+            elif ub & U_STATE and not so:
+                # hard-state move without a slot-backed store
+                lane_append((node, term, vote, committed, None))
+            if ub & U_LEADER:
+                node._check_leader_change()
+        n_so = 0
+        if so_mask.any():
+            if so_drop:
+                so_mask &= ~np.isin(idx, np.asarray(so_drop))
+            ii = np.nonzero(so_mask)[0]
+            n_so = len(ii)
+            if n_so:
+                gs_so = gs[ii]
+                dbi = self._lane_dbi[gs_so]
+                slots = self._lane_slot[gs_so]
+                w = uplan.words
+                app_by_db: Dict[int, List] = {}
+                if lane_apply:
+                    dbi_all = self._lane_dbi
+                    for g2, node, ce in lane_apply:
+                        app_by_db.setdefault(
+                            int(dbi_all[g2]), []
+                        ).append((node, ce))
+                batches = []
+                for d in np.unique(dbi).tolist():
+                    m = dbi == d
+                    im_ = ii[m]
+                    batches.append((
+                        self._lane_dbs[d], slots[m], w[_R_TERM][im_],
+                        w[_R_VOTE][im_], w[_R_COMMIT][im_], live,
+                        idx[im_], app_by_db.get(d, ()),
+                    ))
+                self._persist_lane_batches(
+                    batches, self._last_worker_id
+                )
+        n = len(lane_rows) + len(fulls) + n_so
+        if n:
+            self.stats["early_completions"] += n
+        if lane_rows:
+            self._persist_lane_rows(lane_rows, self._last_worker_id)
+        if fulls:
+            self._persist_and_process(fulls, self._last_worker_id)
 
     def _launch_generation(self, batch) -> None:  # sync-hot
         """Assemble, upload and dispatch one generation, request its
@@ -2117,29 +2273,26 @@ class ColocatedVectorEngine(VectorStepEngine):
             # ms/launch at storm-tier capacities (review finding)
             sel_vals = sel_vals[:n_sum_d]
             vals_np = sel_vals
-            # lease pass BEFORE the early pass: early rows run their
-            # tick bookkeeping inside _early_commit_pass, and window
-            # starts must stamp the PRE-launch clock (see _lease_pass)
+            # lease pass BEFORE bookkeeping: lease window starts must
+            # stamp the PRE-launch clock (see _lease_pass); then ONE
+            # batched bookkeeping pass for the whole generation
             self._lease_pass(live, flags, vals_np, pos_sum, rec.tick_fed)
             lease_done = True
+            self._bookkeeping_pass(live)
             # ---- EARLY completion: the commit-proving prefix --------
             # A live row with values but NO append/outbox/slot/need
             # sections (the common shape: a leader whose routed acks
             # just advanced commit, a follower applying) needs nothing
-            # from the detail payload — sync its scalars, advance
-            # commit and hand its update to persist/apply NOW, so
+            # from the detail payload — the LANE pass diffs its words
+            # against the update lanes, syncs only what moved and
+            # persists the whole set in one batched lane save NOW, so
             # proposals complete from the earliest sync that proves
             # their commit instead of waiting for the detail to land
             # and the heavy merge tail to run.
-            updates_early = self._early_commit_pass(
+            self._lane_commit_pass(
                 live, flags, pos_sum, pos_buf, pos_slot, pos_need,
                 vals_np, early_done,
             )
-            if updates_early:
-                self.stats["early_completions"] += len(updates_early)
-                self._persist_and_process(
-                    updates_early, self._last_worker_id
-                )
             need_detail = bool(
                 len(buf_rows) or len(append_rows)
                 or len(slot_rows) or len(need_rows)
@@ -2261,8 +2414,17 @@ class ColocatedVectorEngine(VectorStepEngine):
         # The dev_ok path already ran this pass (pre-early-commit, so
         # window starts stamp the pre-launch clock); running it again
         # would feed tick_fed twice and halve the modeled window period.
+        # On the exact-fallback path the bookkeeping + lane passes run
+        # here instead (detail and position maps only just landed) —
+        # same order as dev_ok: lease, bookkeeping, lane commit.
         if not lease_done:
             self._lease_pass(live, flags, vals_np, pos_sum, rec.tick_fed)
+            self._bookkeeping_pass(live)
+            if vals_np is not None:
+                self._lane_commit_pass(
+                    live, flags, pos_sum, pos_buf, pos_slot, pos_need,
+                    vals_np, early_done,
+                )
         # one C-level conversion for the merge loop's 10-ints-per-row
         # reads (numpy scalar -> int costs ~100 ns each)
         vals_l = vals_np.tolist() if vals_np is not None else None
@@ -2313,6 +2475,15 @@ class ColocatedVectorEngine(VectorStepEngine):
                 self._mirror[:6, gs_m[in_sum]] = (
                     vals_np[sum_k[in_sum], :6].T
                 )
+                # update lanes follow for the HEAVY rows the loop below
+                # syncs per-row (lane-pass rows were already written —
+                # identical values, idempotent), absolute frame: the
+                # next generation's lane diff must see what was synced
+                w_abs = vals_np[sum_k[in_sum], :6].T.astype(np.int64)
+                b_abs = self._base[gs_m[in_sum]]
+                w_abs[_R_COMMIT] += b_abs
+                w_abs[_R_LAST] += b_abs
+                self._ulanes.words[:, gs_m[in_sum]] = w_abs
         if vals_np is not None and len(sum_src):
             # fast-lane invalidation, batch-wide: rows approaching an
             # int32 lane limit or streaming a snapshot re-run the full
@@ -2350,8 +2521,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 continue
             r = node.peer.raft
             base = bases_l[j]  # the shard's shared base
-            if si is not None:
-                _tick_bookkeeping(node, si.ticks + si.gc_ticks)
+            # (tick bookkeeping already ran in _bookkeeping_pass)
             k = sum_k_l[j]
             if k < 0:
                 # no flags, no slots: the row only ticked

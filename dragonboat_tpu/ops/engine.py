@@ -52,6 +52,8 @@ from ..logger import get_logger
 from ..pb import Entry, EntryType, Message, MessageType, Snapshot
 from ..raft.raft import Raft, RaftRole
 from ..raft.remote import RemoteState
+from ..request import gc_tables
+from ..rsm.statemachine import Task, TaskType
 from . import hostplane
 from . import kernel as K
 from . import sync as S
@@ -81,10 +83,33 @@ _HOT_SET = frozenset(HOT_TYPES)
 
 # readback row indices of the per-row VALUES block (_gather_detail's
 # idx_sum part); 0-5 double as the [6, G] host mirror's row indices
-_R_TERM, _R_VOTE, _R_COMMIT, _R_LEADER, _R_ROLE, _R_LAST = range(6)
-_R_COUNT, _R_APPEND_LO = 6, 7
-_R_BARRIER_IDX, _R_BARRIER_TERM = 8, 9
-N_VALS = 10
+# AND the update-lane word layout (hostplane.UpdateLanes).  The values
+# live in types.py (one definition across the device gather program,
+# both merge tails and the lane store); the `_R_*` aliases keep this
+# module's historical spelling.
+from .types import (  # noqa: E402 — alias block, not a new dependency
+    N_VALS,
+    R_TERM as _R_TERM,
+    R_VOTE as _R_VOTE,
+    R_COMMIT as _R_COMMIT,
+    R_LEADER as _R_LEADER,
+    R_ROLE as _R_ROLE,
+    R_LAST as _R_LAST,
+    R_COUNT as _R_COUNT,
+    R_APPEND_LO as _R_APPEND_LO,
+    R_BARRIER_IDX as _R_BARRIER_IDX,
+    R_BARRIER_TERM as _R_BARRIER_TERM,
+    U_COMMIT,
+    U_LEADER,
+    U_LOST_LEAD,
+    U_ROLE,
+    U_STATE,
+)
+
+# int role -> RaftRole member: the merge tails' enum lookup.  The
+# `RaftRole(role)` enum call costs ~0.5 µs per row (EnumMeta.__call__)
+# — a real share of the per-affected-row residual at 250k rows.
+_ROLE_OF = {int(x): x for x in RaftRole}
 
 # per-row flag bits of the _summarize_flags readback — the ONLY
 # full-width [G] readback a launch performs.  Everything row-valued
@@ -416,12 +441,22 @@ def _shift_msg_indexes(msg: Message, delta: int) -> Message:
 def _tick_bookkeeping(node, ticks: int) -> None:
     """Advance the node's logical clock and GC timed-out futures — the
     device path's mirror of the tick tail of ``Node.step_with_inputs``.
-    Deadlines are monotone, so ONE sweep at the final count is exact
-    (with multi-tick fusion ``ticks`` is now tens per step; a per-tick
-    sweep would be 5*n lock acquisitions per row per generation)."""
+
+    The GC is ONE hint-gated sweep over the node's five pending tables
+    per call (request.gc_tables) instead of the old five per-table
+    ``gc()`` calls — at 250k rows the five probes (and, with any table
+    non-empty, five lock acquisitions) per affected row per generation
+    were a top-3 share of the merge tail's residual (ISSUE 13).  The
+    monotone-deadline argument, kept honest: deadlines are fixed at
+    allocation and the clock is monotone, so sweeping exactly when the
+    clock first reaches the earliest pending deadline (the hint cell)
+    delivers every timeout at the same tick value the old per-table
+    sweep did — fused multi-tick counts land on the SAME final count
+    either way, and ticks below the hint can expire nothing."""
     if not ticks:
         return
-    node.tick_count += ticks
+    tc = node.tick_count + ticks
+    node.tick_count = tc
     # the SCALAR raft's logical clock advances too: device-resident
     # rows never call Raft.tick(), and a frozen r.tick_count poisons
     # every wall-clock comparison made while resident — the CheckQuorum
@@ -432,11 +467,65 @@ def _tick_bookkeeping(node, ticks: int) -> None:
     # lockstep (step_with_inputs ticks the raft, then advances the node
     # clock by the same count); this is the device path's mirror.
     node.peer.raft.tick_count += ticks
-    node.pending_proposal.gc(node.tick_count)
-    node.pending_read_index.gc(node.tick_count)
-    node.pending_config_change.gc(node.tick_count)
-    node.pending_snapshot.gc(node.tick_count)
-    node.pending_leader_transfer.gc(node.tick_count)
+    if tc >= node.pending_deadline_hint[0]:
+        gc_tables(node.pending_tables, node.pending_deadline_hint, tc)
+
+
+def _plan_lane_words(  # hostplane-hot
+    ulanes, bases, gs_live, sum_rows, vals, capacity, mirror=None,
+):
+    """Assemble one generation's array-side update words (ISSUE 13).
+
+    Gathers the live rows' last-synced lanes, diffs the generation's
+    merged values against them (``hostplane.plan_update_sync``) and
+    writes the new words back for exactly those rows — the whole
+    assembly is numpy gathers over ``[G]`` lanes; rows the caller's
+    merge loop then skips (none on this engine: the batch is
+    re-validated under the lock) would be re-seeded at their next
+    upload, so the bulk write-back is always safe.  When ``mirror`` is
+    given, the device-frame ``[6, G]`` host mirror is bulk-synced for
+    every values-carrying row too (replacing the per-row
+    ``mirror[:6, g] = vals[k, :6]`` writes of the old merge loop).
+    Returns the ``UpdateSyncPlan`` whose ``ubits`` drive the
+    LANE/heavy row split.
+    """
+    sum_k = hostplane.pos_of(
+        capacity, np.asarray(sum_rows, np.int64)
+    )[gs_live]
+    old_w = ulanes.words[:, gs_live]
+    uplan = hostplane.plan_update_sync(old_w, sum_k, vals, bases[gs_live])
+    if hostplane.PARITY:
+        hostplane.check_update_plan_parity(
+            old_w, sum_k, vals, bases[gs_live], uplan
+        )
+    ulanes.words[:, gs_live] = uplan.words
+    if mirror is not None:
+        in_sum = sum_k >= 0
+        if in_sum.any():
+            mirror[:6, gs_live[in_sum]] = vals[sum_k[in_sum], :6].T
+    return uplan
+
+
+def _apply_lane_commit(node, ce) -> None:
+    """The lane rows' post-save apply handoff — one definition for the
+    slot-batched and list-fallback persist paths (both MUST run it
+    only after the row's save landed: persist-before-apply,
+    peer.commit's order).  Hands the committed entries to the apply
+    queue, advances the processed cursor, and runs the AMORTIZED
+    in-mem GC: ``applied_log_to`` slices the entry list (O(live
+    entries)) every call, so sweep once per ~32 applied entries
+    instead of per commit — bounded residency (<=32 applied entries
+    linger), 32x fewer slices on the commit-wave path."""
+    if node._trace_spans:
+        node._trace_committed(ce)
+    node.sm.task_queue.add(Task(type=TaskType.ENTRIES, entries=ce))
+    log = node.peer.raft.log
+    log.processed = ce[-1].index
+    im = log.inmem
+    if log.processed - im.marker >= 32:
+        im.applied_log_to(log.processed)
+    if node.engine_apply_ready is not None:
+        node.engine_apply_ready(node.shard_id)
 
 
 class _RowMeta:
@@ -580,6 +669,25 @@ class VectorStepEngine(IStepEngine):
         # anchored from the F_QUORUM_ACTIVE flag bit — see
         # hostplane.LeaseLanes and _lease_row_step
         self._lease = hostplane.LeaseLanes(capacity)
+        # array-side pb.Update lanes (ISSUE 13): the last SYNCED
+        # absolute scalar words per row.  A generation's effects diff
+        # against these in one vectorized pass (plan_update_sync), and
+        # effect-free/commit-only rows skip the per-row get_update
+        # object walk entirely — see hostplane.UpdateLanes.
+        self._ulanes = hostplane.UpdateLanes(capacity)
+        # lane rows classified by the last _device_step, drained by
+        # step_shards AFTER the core lock releases (_persist_lane_rows)
+        self._lane_pending: List[Tuple] = []
+        # array-batched STATE-ONLY persists (no per-row tuples at all):
+        # (db, slots, terms, votes, commits, live, js) per LogDB — see
+        # _persist_lane_batches.  Rows map to their store through the
+        # per-row slot/db-index lanes below, resolved at upload via the
+        # ILogDB optional slot protocol (-1 = store has no slot path;
+        # such rows ride the tuple form + save_state_lanes instead).
+        self._lane_pending_arr: List[Tuple] = []
+        self._lane_slot = np.full((capacity,), -1, np.int64)
+        self._lane_dbi = np.full((capacity,), -1, np.int64)
+        self._lane_dbs: List = []
         if self._mesh is not None:
             # STRIPED free order: consecutive attaches land on distinct
             # device blocks, so resident rows (and their group-tick
@@ -1097,6 +1205,40 @@ class VectorStepEngine(IStepEngine):
             self._mirror[_R_LEADER, g] = r.leader_id
             self._mirror[_R_ROLE, g] = int(r.role)
             self._mirror[_R_LAST, g] = r.log.last_index() - self._base[g]
+            # update lanes hold the ABSOLUTE frame (rebases never
+            # perturb them); the scalar raft is authoritative at upload
+            self._ulanes.seed_row(
+                g, r.term, r.vote, r.log.committed, r.leader_id,
+                int(r.role), r.log.last_index(),
+            )
+            # lane-diff leader notifications (U_LEADER) assume the node
+            # view is in sync with the raft at seed time; the scalar
+            # path's own _check_leader_change keeps it so, but a join/
+            # restore can upload before the first scalar step ran
+            node = self._meta[g].node
+            if node.leader_id != r.leader_id:
+                node._check_leader_change()
+            # hard-state lane slot + db index (the ILogDB optional slot
+            # protocol): resolved once per upload so the merge tail's
+            # state-only persist is a pure array scatter per LogDB
+            db = node.logdb
+            get_slot = getattr(db, "state_lane_slot", None)
+            if get_slot is not None:
+                s = node.hs_lane_slot
+                if s < 0:
+                    s = get_slot(node.shard_id, node.replica_id)
+                    node.hs_lane_slot = s
+                self._lane_slot[g] = s
+                for di, d in enumerate(self._lane_dbs):
+                    if d is db:
+                        break
+                else:
+                    self._lane_dbs.append(db)
+                    di = len(self._lane_dbs) - 1
+                self._lane_dbi[g] = di
+            else:
+                self._lane_slot[g] = -1
+                self._lane_dbi[g] = -1
             # lease evidence lanes follow device residency (ROADMAP 4b)
             if r.role == RaftRole.LEADER and r.check_quorum:
                 self._lease.arm(g, r.election_timeout, r.election_tick)
@@ -1248,6 +1390,8 @@ class VectorStepEngine(IStepEngine):
                 updates.append((node, u))
 
         # ---- device path ---------------------------------------------
+        lane_rows: List[Tuple] = []
+        lane_batches: List[Tuple] = []
         if batch:
             with self._lock:
                 # re-validate: a concurrent detach() (stop_replica) may
@@ -1270,7 +1414,19 @@ class VectorStepEngine(IStepEngine):
                 )
                 if batch:
                     updates.extend(self._device_step(batch))
+                    # this worker's lane rows, swapped out under the
+                    # same lock hold (each worker persists only its own)
+                    lane_rows, self._lane_pending = (
+                        self._lane_pending, []
+                    )
+                    lane_batches, self._lane_pending_arr = (
+                        self._lane_pending_arr, []
+                    )
 
+        # lane persist FIRST: it advances the processed cursors, so a
+        # retrying node's get_update below re-emits only the remainder
+        self._persist_lane_batches(lane_batches, worker_id)
+        self._persist_lane_rows(lane_rows, worker_id)
         self._drain_update_retries(updates, owned={id(n) for n in nodes})
         if updates:
             self._persist_and_process(updates, worker_id)
@@ -1347,6 +1503,141 @@ class VectorStepEngine(IStepEngine):
             for node, u in pairs:
                 if node.process_update(u):
                     node.engine_apply_ready(node.shard_id)
+
+    def _persist_lane_batches(self, batches, worker_id: int) -> None:
+        """Array-batched persist for slot-backed lane rows: one
+        ``save_state_slots`` scatter per LogDB, zero per-row Python on
+        the state-only success path.  ``batches`` entries are ``(db,
+        slots, terms, votes, commits, live, js, applies)`` — the node
+        list is materialized from ``live[j]`` ONLY on a save failure
+        (re-emit + quarantine, the _persist_and_process contract) or
+        while a quarantine is active.  ``applies`` carries the batch's
+        commit rows' ``(node, committed-entries)`` handoffs; they run
+        strictly AFTER the batch's save lands (peer.commit's
+        persist-before-apply order) and not at all on failure — the
+        failed rows re-emit classic updates with cursors untouched.
+        Same ordering contract as _persist_lane_rows: runs before this
+        step's _drain_update_retries."""
+        if not batches:
+            return
+        n = 0
+        n_commit = 0
+        for db, slots, terms, votes, commits, live, js, applies \
+                in batches:
+            n += len(slots)
+            try:
+                db.save_state_slots(slots, terms, votes, commits,
+                                    worker_id)
+            except Exception:  # noqa: BLE001
+                self.stats["save_failures"] += 1
+                _log.exception(
+                    "batched slot save failed for %d row(s); will "
+                    "re-emit",
+                    len(slots),
+                )
+                self._on_save_failure(
+                    [(live[j][0], None) for j in js.tolist()]
+                )
+                continue
+            if self._save_quarantine:
+                self._on_save_ok(
+                    [(live[j][0], None) for j in js.tolist()]
+                )
+            for node, ce in applies:
+                n_commit += 1
+                _apply_lane_commit(node, ce)
+        if n:
+            self.stats["lane_rows"] = (
+                self.stats.get("lane_rows", 0) + n
+            )
+        if n_commit:
+            self.stats["lane_commit_rows"] = (
+                self.stats.get("lane_commit_rows", 0) + n_commit
+            )
+
+    def _persist_lane_rows(self, rows, worker_id: int) -> None:
+        """Persist + apply-handoff for LANE rows — the batched
+        replacement for per-row save_raft_state/process_update/
+        peer.commit on rows whose whole effect is a hard-state move
+        and/or a commit advance (ISSUE 13).
+
+        ``rows`` is a list of ``(node, term, vote, commit, ce)`` where
+        ``ce`` is the row's committed-entries list (None when only the
+        hard state moved).  One ``save_state_lanes`` call per LogDB
+        persists every row's (term, vote, commit) triple; only then do
+        commit rows hand their entries to the apply queue and advance
+        the processed cursor — peer.commit's job, inlined: ``ce`` came
+        from ``entries_to_apply(processed+1 .. committed+1)``, so the
+        new processed is in (processed, committed] by construction
+        (the commit_update guard, pre-verified).  A failed batched
+        save advances NOTHING: the nodes re-emit classic full updates
+        (state + the same committed entries, cursors untouched) via
+        _drain_update_retries — exactly the _persist_and_process
+        contract.  MUST run before this step's _drain_update_retries,
+        or a retrying node's fresh get_update would collect entries a
+        pending lane handoff is about to deliver too."""
+        if not rows:
+            return
+        self.stats["lane_rows"] = (
+            self.stats.get("lane_rows", 0) + len(rows)
+        )
+        by_db: Dict[int, Tuple] = {}
+        for t in rows:
+            db = t[0].logdb
+            by_db.setdefault(id(db), (db, []))[1].append(t)
+        n_commit = 0
+        for db, rs in by_db.values():
+            try:
+                save_slots = getattr(db, "save_state_slots", None)
+                if save_slots is not None:
+                    # vectorized scatter by cached slot (the ILogDB
+                    # optional slot protocol): slot resolution is a
+                    # once-per-node event, the steady save is three
+                    # numpy scatters under one lock hold
+                    get_slot = db.state_lane_slot
+                    slots = []
+                    for t in rs:
+                        node = t[0]
+                        s = node.hs_lane_slot
+                        if s < 0:
+                            s = get_slot(node.shard_id, node.replica_id)
+                            node.hs_lane_slot = s
+                        slots.append(s)
+                    save_slots(
+                        slots,
+                        [t[1] for t in rs],
+                        [t[2] for t in rs],
+                        [t[3] for t in rs],
+                        worker_id,
+                    )
+                else:
+                    db.save_state_lanes(
+                        [t[0].shard_id for t in rs],
+                        [t[0].replica_id for t in rs],
+                        [t[1] for t in rs],
+                        [t[2] for t in rs],
+                        [t[3] for t in rs],
+                        worker_id,
+                    )
+            except Exception:  # noqa: BLE001
+                self.stats["save_failures"] += 1
+                _log.exception(
+                    "batched lane save failed for %d row(s); will "
+                    "re-emit",
+                    len(rs),
+                )
+                self._on_save_failure([(t[0], None) for t in rs])
+                continue
+            self._on_save_ok([(t[0], None) for t in rs])
+            for node, _term, _vote, _commit, ce in rs:
+                if not ce:
+                    continue
+                n_commit += 1
+                _apply_lane_commit(node, ce)
+        if n_commit:
+            self.stats["lane_commit_rows"] = (
+                self.stats.get("lane_commit_rows", 0) + n_commit
+            )
 
     def _on_save_failure(self, pairs) -> None:
         """Queue re-emission and quarantine the nodes to the scalar
@@ -1536,20 +1827,79 @@ class VectorStepEngine(IStepEngine):
         sum_at = {g: k for k, g in enumerate(sum_rows)}
 
         # ---- per-row update construction -----------------------------
+        # A generation's effects classify ARRAY-SIDE first: one
+        # plan_update_sync pass over the update lanes yields per-row
+        # U_* effect bits, and rows with no heavy sections (append /
+        # outbox / slot / snapshot-need) sync from the plan's words and
+        # hand a (node, term, vote, commit, entries) LANE tuple to the
+        # batched _persist_lane_rows — no per-row get_update object
+        # walk, no per-row Update/State/UpdateCommit construction
+        # (ISSUE 13; hostplane.UpdateLanes).  Heavy rows keep the
+        # classic full-body merge.
+        gs_live = np.asarray([g for _, g, _ in live], np.int64)
+        vals_for_plan = (
+            vals_np if vals_np is not None
+            else np.zeros((1, N_VALS), np.int64)
+        )
+        ub_l = w_term = w_vote = w_com = w_lead = w_role = None
+        so_mask = None
+        if len(gs_live):
+            uplan = _plan_lane_words(
+                self._ulanes, self._base, gs_live, sum_rows,
+                vals_for_plan, self.capacity, mirror=self._mirror,
+            )
+            ub_l = uplan.ubits.tolist()
+            w_term = uplan.words[_R_TERM].tolist()
+            w_vote = uplan.words[_R_VOTE].tolist()
+            w_com = uplan.words[_R_COMMIT].tolist()
+            w_lead = uplan.words[_R_LEADER].tolist()
+            w_role = uplan.words[_R_ROLE].tolist()
+            # rows eligible for the array-batched persist (hard-state
+            # effect, no heavy sections, slot-backed store) classify
+            # vectorized; the loop only CLEARS exceptions (residue
+            # fallbacks).  Their persist is three scatters per LogDB
+            # (_persist_lane_batches); commit rows additionally hand
+            # (node, entries) to the post-save apply leg.
+            so_mask = (uplan.ubits & (U_STATE | U_COMMIT)) != 0
+            if so_mask.any():
+                hv = np.zeros((self.capacity,), bool)
+                if buf_rows:
+                    hv[buf_rows] = True
+                if slot_rows:
+                    hv[slot_rows] = True
+                if need_rows:
+                    hv[need_rows] = True
+                so_mask &= ~hv[gs_live]
+                so_mask &= (flags[gs_live] & _F_APPEND) == 0
+                so_mask &= self._lane_dbi[gs_live] >= 0
+            so_l = so_mask.tolist()
+        lane_rows = self._lane_pending
+        lane_apply: List[Tuple] = []
+        sum_get = sum_at.get
         # (g, p, lane-or-None, pid, ss_index) — see _send_snapshots
         snapshot_sends: List[Tuple[int, int, Optional[int], int, int]] = []
-        for node, g, si in live:
+        for j, (node, g, si) in enumerate(live):
             r = node.peer.raft
-            base = int(self._base[g])
             # PRE-launch clock for lease window starts: stamping after
             # bookkeeping would date a window up to half an election
             # window late (the fused tick count) and overstate the
             # lease by the same amount — the colocated _lease_pass
             # follows the same pre-bookkeeping contract
             now0 = node.tick_count
-            # tick bookkeeping (mirrors Node.step_with_inputs)
-            _tick_bookkeeping(node, si.ticks + si.gc_ticks)
-            if g not in sum_at:
+            # tick bookkeeping, inlined (mirrors Node.step_with_inputs
+            # / _tick_bookkeeping: clock lockstep + hint-gated GC)
+            t = si.ticks + si.gc_ticks
+            if t:
+                tc = now0 + t
+                node.tick_count = tc
+                r.tick_count += t
+                if tc >= node.pending_deadline_hint[0]:
+                    gc_tables(
+                        node.pending_tables, node.pending_deadline_hint,
+                        tc,
+                    )
+            k = sum_get(g, -1)
+            if k < 0:
                 # no flags, no slots: the row only ticked — but an
                 # armed leader's window mirror still advances, and the
                 # quorum-active flag may anchor the lease (ROADMAP 4b)
@@ -1559,25 +1909,109 @@ class VectorStepEngine(IStepEngine):
                 if a >= 0:
                     r.anchor_quorum_evidence(a)
                 continue
-            sv = vals_np[sum_at[g]]
-            term, vote, committed, leader, role, last = (
-                int(sv[i]) for i in range(6)
-            )
-            committed += base
-            last += base
+            ub = ub_l[j]
+            term = w_term[j]
+            vote = w_vote[j]
+            committed = w_com[j]
+            leader = w_lead[j]
+            role = w_role[j]
             # lease lanes track role transitions observed at merge: an
             # on-device election win arms a FRESH window model
             # (election_tick reset to 0 by the kernel's _reset), any
-            # other transition disarms
-            if role != int(self._mirror[_R_ROLE, g]):
-                if role == int(RaftRole.LEADER) and r.check_quorum:
+            # other transition disarms.  U_ROLE is exactly the old
+            # `role != mirror role` probe: lanes and mirror both seed
+            # at upload and sync at every merge.
+            if ub & U_ROLE:
+                if role == ROLE_LEADER_I and r.check_quorum:
                     self._lease.arm(g, r.election_timeout, 0)
                 else:
                     self._lease.disarm(g)
             a = self._lease.row_step(
                 g, tick_fed.get(g, 0), now0, int(flags[g])
             )
+            log = r.log
             appended = bool(flags[g] & _F_APPEND)
+            if not (
+                appended or g in buf_at or g in slot_at or g in need_at
+            ):
+                # ---- LANE row: no heavy sections ---------------------
+                # NOTE: this residue-probe + U_*-application block is
+                # intentionally OPEN-CODED in three places — here,
+                # colocated._lane_commit_pass and the bench's
+                # _lane_stage twin — because a shared per-row helper
+                # (call/closure per row) costs exactly the altitude
+                # this loop exists to remove.  Any semantic change
+                # MUST land in all three; the bench's twin-population
+                # raft-word + persisted-state equality is the
+                # application-level drift detector.
+                im = log.inmem
+                if (
+                    r.msgs or r.ready_to_reads or r.dropped_entries
+                    or r.dropped_read_indexes or im.snapshot.index
+                    or im.saved_to + 1 - im.marker < len(im.entries)
+                ):
+                    # scalar-side residue (a resident-clean row should
+                    # never accumulate any — defense in depth): only
+                    # the classic get_update walk drains it
+                    r.term, r.vote, r.leader_id = term, vote, leader
+                    r.role = _ROLE_OF[role]
+                    if a >= 0:
+                        r.anchor_quorum_evidence(a)
+                    if committed > log.committed:
+                        log.commit_to(committed)
+                    if (
+                        role != ROLE_LEADER_I
+                        and node.device_reads.has_pending()
+                    ):
+                        node.drop_device_reads()
+                    u = node.peer.get_update(
+                        last_applied=node.sm.last_applied
+                    )
+                    node.dispatch_dropped(u)
+                    updates.append((node, u))
+                    node._check_leader_change()
+                    so_mask[j] = False  # residue rows left the array path
+                    continue
+                if ub & U_STATE:
+                    r.term = term
+                    r.vote = vote
+                if ub & U_LEADER:
+                    r.leader_id = leader
+                if ub & U_ROLE:
+                    r.role = _ROLE_OF[role]
+                if a >= 0:
+                    r.anchor_quorum_evidence(a)  # post-sync role
+                if ub & U_LOST_LEAD and node.device_reads.has_pending():
+                    # leadership lost: confirmations will never arrive.
+                    # U_LOST_LEAD is exact for lane rows: device reads
+                    # only register off merged outbox messages (a heavy
+                    # row by definition), so any pending read predates
+                    # this sync — if the row is no longer leader, the
+                    # losing transition is THIS generation's lane diff
+                    # (docs/PARITY.md "Update-lane contract").
+                    node.drop_device_reads()
+                if ub & U_COMMIT:
+                    log.commit_to(committed)
+                    ce = log.entries_to_apply()
+                    if so_l[j]:
+                        # persist rides the array batch; entries hand
+                        # off after that batch's save proves durable
+                        lane_apply.append((j, node, ce))
+                    else:
+                        lane_rows.append(
+                            (node, term, vote, committed, ce)
+                        )
+                elif ub & U_STATE and not so_l[j]:
+                    # hard-state move without a slot-backed store:
+                    # tuple form through save_state_lanes
+                    lane_rows.append((node, term, vote, committed, None))
+                if ub & U_LEADER:
+                    node._check_leader_change()
+                continue
+            # ---- heavy row: the classic full-body merge --------------
+            sv = vals_np[k]
+            base = int(self._base[g])
+            last = int(sv[_R_LAST]) + base
             # 1. append reconstruction
             if appended:
                 self._merge_appends(
@@ -1596,13 +2030,13 @@ class VectorStepEngine(IStepEngine):
                 )
             # 2. protocol scalar sync
             r.term, r.vote, r.leader_id = term, vote, leader
-            r.role = RaftRole(role)
+            r.role = _ROLE_OF[role]
             if a >= 0:
                 r.anchor_quorum_evidence(a)  # post-sync: role is fresh
             if committed > r.log.committed:
                 r.log.commit_to(committed)
             if (
-                role != int(RaftRole.LEADER)
+                role != ROLE_LEADER_I
                 and node.device_reads.has_pending()
             ):
                 # leadership lost: confirmations will never arrive
@@ -1626,7 +2060,9 @@ class VectorStepEngine(IStepEngine):
                         r.dropped_entries.extend(ents)
                     elif sb[slot] >= 0:
                         r.dropped_entries.extend(
-                            e for j, e in enumerate(ents) if drop[slot, j]
+                            e
+                            for j2, e in enumerate(ents)
+                            if drop[slot, j2]
                         )
             # 5. peers needing a snapshot stream
             if g in need_at:
@@ -1636,8 +2072,34 @@ class VectorStepEngine(IStepEngine):
             u = node.peer.get_update(last_applied=node.sm.last_applied)
             node.dispatch_dropped(u)
             updates.append((node, u))
-            self._mirror[:6, g] = sv[:6]
             node._check_leader_change()
+
+        if so_mask is not None and so_mask.any():
+            # array-batched persist: group the survivors by LogDB
+            # through the db-index lane; node lists materialize lazily
+            # (only on save failure / active quarantine); commit rows'
+            # apply handoffs ride with their db's batch so entries
+            # never reach the apply queue before their save lands
+            js = np.nonzero(so_mask)[0]
+            gs_so = gs_live[js]
+            dbi = self._lane_dbi[gs_so]
+            slots = self._lane_slot[gs_so]
+            w = uplan.words
+            app_by_db: Dict[int, List] = {}
+            if lane_apply:
+                dbi_all = self._lane_dbi
+                for j, node, ce in lane_apply:
+                    app_by_db.setdefault(
+                        int(dbi_all[gs_live[j]]), []
+                    ).append((node, ce))
+            for d in np.unique(dbi).tolist():
+                m = dbi == d
+                jd = js[m]
+                self._lane_pending_arr.append((
+                    self._lane_dbs[d], slots[m], w[_R_TERM][jd],
+                    w[_R_VOTE][jd], w[_R_COMMIT][jd], live, jd,
+                    app_by_db.get(d, ()),
+                ))
 
         lanes = [t for t in snapshot_sends if t[2] is not None]
         if lanes:

@@ -55,6 +55,19 @@ from .types import (
     F_ESC,
     F_NEED_SS,
     F_QUORUM_ACTIVE,
+    R_COMMIT,
+    R_LAST,
+    R_LEADER,
+    R_ROLE,
+    R_TERM,
+    R_VOTE,
+    ROLE_LEADER,
+    U_COMMIT,
+    U_LEADER,
+    U_LOST_LEAD,
+    U_ROLE,
+    U_STATE,
+    UL_N,
 )
 
 # parity mode: run the scalar twins beside every vectorized pass and
@@ -196,6 +209,198 @@ class LeaseLanes:
         if ws >= 0 and (flags_word & F_QUORUM_ACTIVE):
             return int(ws)
         return -1
+
+
+class UpdateLanes:
+    """SoA mirror of the scalar words the merge tail syncs into each
+    resident row's ``Raft`` — the array-side ``pb.Update`` truth store
+    (ISSUE 13 / ROADMAP item 1's "Raft-less host rows").
+
+    One ``[UL_N, G]`` int64 block, rows indexed by the values-block
+    layout (``types.R_TERM`` … ``types.R_LAST``), holding the LAST
+    SYNCED absolute-frame words per device row: term / vote / commit /
+    leader / role / last-log-index (commit and last carry the shard
+    base added back, so rebases never perturb them).  Beside the lanes
+    the device plane already tracks per row — delivered outbox bits
+    (the head blob), lease evidence (:class:`LeaseLanes`) and the
+    plan/alive flags (:class:`RowLanes`) — this completes the set: a
+    generation's *effects* now diff as ``new words != lane words``
+    over whole ``[G]`` gathers (:func:`plan_update_sync`) instead of
+    one Python object walk per affected row.
+
+    Chip-sharded by construction: the block's G axis is the engine row
+    axis, so under the ``ops/placement.py`` row-block contract a
+    device's G-slice is the contiguous column slice
+    :meth:`device_slice` returns — per-device lane views compose with
+    zero copies (docs/MULTICHIP.md), ready for the mesh plane.
+
+    Lifecycle mirrors the ``_mirror`` table: seeded at upload
+    (``_upload_rows``) from the scalar raft, bulk-written at every
+    merge for the rows the generation synced; rows skipped by a merge
+    (stopped / halted mid-flight) are freed and re-seeded at their
+    next upload, so their stale words are moot.  All access runs under
+    the engine's core lock, like RowLanes.
+    """
+
+    __slots__ = ("words",)
+
+    def __init__(self, capacity: int):
+        self.words = np.zeros((UL_N, capacity), np.int64)
+
+    def seed_row(self, g: int, term: int, vote: int, commit: int,
+                 leader: int, role: int, last: int) -> None:
+        """Scalar -> lanes at upload: the raft is authoritative."""
+        w = self.words
+        w[R_TERM, g] = term
+        w[R_VOTE, g] = vote
+        w[R_COMMIT, g] = commit
+        w[R_LEADER, g] = leader
+        w[R_ROLE, g] = role
+        w[R_LAST, g] = last
+
+    def device_slice(self, device_index: int, n_devices: int) -> np.ndarray:
+        """The contiguous per-device lane view under the row-block
+        contract (placement.device_of_row): device ``d`` owns columns
+        ``[d*Gl, (d+1)*Gl)``.  A VIEW, never a copy — the mesh test
+        asserts the slices tile the block exactly."""
+        from .placement import rows_per_device
+
+        per = rows_per_device(self.words.shape[1], n_devices)
+        return self.words[:, device_index * per:(device_index + 1) * per]
+
+
+class UpdateSyncPlan(NamedTuple):
+    """One generation's vectorized effect classification: the new
+    absolute words ``[UL_N, n]`` for the planned rows and the per-row
+    ``U_*`` effect bits ``[n]`` (0 = the row's merged values are
+    byte-identical to the last sync — nothing to write, persist or
+    notify)."""
+
+    words: np.ndarray
+    ubits: np.ndarray
+
+
+def plan_update_sync(  # hostplane-hot
+    old_words: np.ndarray,
+    sum_k: np.ndarray,
+    vals: np.ndarray,
+    bases: np.ndarray,
+) -> UpdateSyncPlan:
+    """Vectorized update-sync classification for one generation.
+
+    ``old_words`` is the ``[UL_N, n]`` gather of the rows' current
+    lanes, ``sum_k`` the per-row position into the ``[m, N_VALS]``
+    values block (-1 = the row carried no values this generation —
+    its words are kept and its ubits are 0), ``bases`` the per-row
+    shard bases converting the device frame to the absolute frame.
+
+    The ``U_*`` bits come from lane diffs, NOT from the device's
+    F_CHANGED flag: F_CHANGED compares one step's old/new device
+    state, while the lanes compare against the last HOST sync — the
+    quantity the merge tail actually owes an action for.  The caller
+    writes ``plan.words`` back into the lanes for exactly the rows it
+    then merges (skipped rows re-seed at their next upload).
+    """
+    in_sum = sum_k >= 0
+    if not len(vals):
+        # no row carried values this generation: every sum_k is -1 and
+        # the gather below must still be indexable
+        vals = np.zeros((1, UL_N), np.int64)
+    safe_k = np.where(in_sum, sum_k, 0)
+    new = vals[safe_k, :UL_N].T.astype(np.int64)
+    new[R_COMMIT] += bases
+    new[R_LAST] += bases
+    new = np.where(in_sum[None, :], new, old_words)
+    state_chg = (
+        (new[R_TERM] != old_words[R_TERM])
+        | (new[R_VOTE] != old_words[R_VOTE])
+        | (new[R_COMMIT] != old_words[R_COMMIT])
+    )
+    ubits = (
+        np.where(state_chg, U_STATE, 0)
+        | np.where(new[R_COMMIT] > old_words[R_COMMIT], U_COMMIT, 0)
+        | np.where(new[R_ROLE] != old_words[R_ROLE], U_ROLE, 0)
+        | np.where(new[R_LEADER] != old_words[R_LEADER], U_LEADER, 0)
+        | np.where(
+            (old_words[R_ROLE] == ROLE_LEADER)
+            & (new[R_ROLE] != ROLE_LEADER),
+            U_LOST_LEAD,
+            0,
+        )
+    )
+    return UpdateSyncPlan(words=new, ubits=ubits)
+
+
+# raftlint: ignore[host-loop] parity oracle — the per-row decision shape the lanes replaced, kept for the harness
+def plan_update_sync_scalar(  # hostplane-hot
+    old_words: np.ndarray,
+    sum_k: Sequence[int],
+    vals: np.ndarray,
+    bases: Sequence[int],
+) -> UpdateSyncPlan:
+    """Per-row twin of :func:`plan_update_sync` — the old merge loop's
+    implicit per-row comparisons (scalar sync always wrote, commit
+    advance probed ``committed > r.log.committed``, role/leader
+    transitions probed per row), made explicit row by row."""
+    n = len(sum_k)
+    words = np.array(old_words, np.int64, copy=True)
+    ubits = np.zeros((n,), np.int64)
+    for i in range(n):
+        k = int(sum_k[i])
+        if k < 0:
+            continue
+        term, vote, commit, leader, role, last = (
+            int(vals[k, c]) for c in range(UL_N)
+        )
+        commit += int(bases[i])
+        last += int(bases[i])
+        ub = 0
+        if (
+            term != int(old_words[R_TERM, i])
+            or vote != int(old_words[R_VOTE, i])
+            or commit != int(old_words[R_COMMIT, i])
+        ):
+            ub |= U_STATE
+        if commit > int(old_words[R_COMMIT, i]):
+            ub |= U_COMMIT
+        if role != int(old_words[R_ROLE, i]):
+            ub |= U_ROLE
+        if leader != int(old_words[R_LEADER, i]):
+            ub |= U_LEADER
+        if (
+            int(old_words[R_ROLE, i]) == ROLE_LEADER
+            and role != ROLE_LEADER
+        ):
+            ub |= U_LOST_LEAD
+        words[:, i] = (term, vote, commit, leader, role, last)
+        ubits[i] = ub
+    return UpdateSyncPlan(words=words, ubits=ubits)
+
+
+def assert_update_plan_parity(
+    old_words: np.ndarray,
+    sum_k: np.ndarray,
+    vals: np.ndarray,
+    bases: np.ndarray,
+    plan: UpdateSyncPlan,
+) -> None:
+    ref = plan_update_sync_scalar(
+        old_words, np.asarray(sum_k).tolist(), vals,
+        np.asarray(bases).tolist(),
+    )
+    if not np.array_equal(np.asarray(plan.ubits), ref.ubits):
+        raise HostPlaneParityError(_diff("update_ubits", plan.ubits,
+                                         ref.ubits))
+    if not np.array_equal(np.asarray(plan.words), ref.words):
+        raise HostPlaneParityError(_diff("update_words", plan.words,
+                                         ref.words))
+
+
+def check_update_plan_parity(old_words, sum_k, vals, bases, plan) -> None:
+    try:
+        assert_update_plan_parity(old_words, sum_k, vals, bases, plan)
+    except HostPlaneParityError as e:  # pragma: no cover - bug path
+        _record_failure(e)
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,14 @@ points, so it registers nothing here; the auditor's
 and any future ``@jax.jit`` added there must be registered or the
 scan fails.  Its per-row discipline is enforced separately by
 raftlint's ``host-loop`` rule (docs/ANALYSIS.md).
+
+Scope note (r9): the update-lane plane (``hostplane.UpdateLanes`` /
+``plan_update_sync``, ``ops/engine._plan_lane_words``, the batched
+persist paths in both merge tails — ISSUE 13) is host-side numpy over
+the ALREADY-read-back values blob: no new device programs, no new
+jitted entry points, nothing to register.  The same ``unregistered-
+jit`` scan and the ``host-loop`` rule (now spanning ``ops/engine.py``)
+gate it.
 """
 from __future__ import annotations
 

@@ -128,6 +128,30 @@ F_PEERS_BEHIND = 32
 F_QUORUM_ACTIVE = 64
 F_ANY_LIVE = F_CHANGED | F_COUNT | F_APPEND | F_NEED_SS
 
+# per-row VALUES block layout (engine._gather_vals order) — the columns
+# of the post-step values readback.  Defined HERE (like the F_* bits)
+# because three layers consume them: the device-side gather program,
+# both engines' merge tails, and the array-at-once update lanes in
+# ops/hostplane.py (UpdateLanes stores the first UL_N columns per row,
+# absolute frame) — one definition keeps the device readback, the host
+# decode and the lane store from ever disagreeing on a column.
+R_TERM, R_VOTE, R_COMMIT, R_LEADER, R_ROLE, R_LAST = range(6)
+R_COUNT, R_APPEND_LO = 6, 7
+R_BARRIER_IDX, R_BARRIER_TERM = 8, 9
+N_VALS = 10
+UL_N = 6  # update-lane words = the first 6 values columns
+
+# per-row update effect bits (hostplane.plan_update_sync): what a
+# generation's merged values changed RELATIVE TO THE LAST SYNC for one
+# row — the vectorized replacement for the per-row "did anything I
+# must act on happen" probes of the old merge loop.  U_STATE means the
+# hard-state triple (term/vote/commit) moved and must persist;
+# U_COMMIT that commit advanced (committed entries to hand to apply);
+# U_ROLE / U_LEADER that the role / leader word moved (role resync,
+# leader-change notification); U_LOST_LEAD that the row held LEADER at
+# the last sync and no longer does (pending device reads must drop).
+U_STATE, U_COMMIT, U_ROLE, U_LEADER, U_LOST_LEAD = 1, 2, 4, 8, 16
+
 
 class DeviceState(NamedTuple):
     """SoA mirror of one scalar ``Raft`` per row.

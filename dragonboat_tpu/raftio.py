@@ -54,6 +54,52 @@ class ILogDB(abc.ABC):
     @abc.abstractmethod
     def save_raft_state(self, updates: List[Update], worker_id: int) -> None: ...
 
+    def save_state_lanes(
+        self,
+        shard_ids: List[int],
+        replica_ids: List[int],
+        terms: List[int],
+        votes: List[int],
+        commits: List[int],
+        worker_id: int,
+    ) -> None:
+        """Batched hard-state-only save for the device merge tail's
+        LANE rows (ops/hostplane.UpdateLanes): one call persists the
+        (term, vote, commit) triple of many replicas with no per-row
+        ``pb.Update`` carrier — the per-affected-row object walk was
+        the residual host-plane wall at 50k-250k rows (ISSUE 13).
+
+        Default implementation delegates through ``save_raft_state``
+        with minimal state-only Updates, so every ILogDB — and any
+        fault plane wrapped around its save path — behaves exactly as
+        if the merge tail had emitted classic per-row updates.
+        Implementations with a cheap hard-state slot (InMemLogDB)
+        override with a direct batched write.  Atomicity/fsync
+        contract is save_raft_state's.
+
+        Optional slot protocol: a store may additionally expose
+        ``state_lane_slot(shard_id, replica_id) -> int`` and
+        ``save_state_slots(slots, terms, votes, commits, worker_id)``
+        (vectorized scatter by pre-registered slot).  The engine
+        detects the pair via ``getattr`` and caches slots per node
+        (``Node.hs_lane_slot``); stores without it — including fault
+        planes wrapped around the save path — get the list form
+        above, so injected save faults still fire."""
+        self.save_raft_state(
+            [
+                Update(
+                    shard_id=s,
+                    replica_id=r,
+                    state=State(term=t, vote=v, commit=c),
+                    has_update=True,
+                )
+                for s, r, t, v, c in zip(
+                    shard_ids, replica_ids, terms, votes, commits
+                )
+            ],
+            worker_id,
+        )
+
     @abc.abstractmethod
     def read_raft_state(
         self, shard_id: int, replica_id: int, last_index: int

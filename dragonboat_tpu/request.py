@@ -114,13 +114,21 @@ class RequestState:
         return self.code == RequestResultCode.COMPLETED
 
 
+# deadline-hint sentinel: "no pending deadline".  An int (not inf) so
+# the lock-free `tick >= hint[0]` probe stays int-vs-int.  Far above
+# any reachable tick count (ticks are ~100 ms; 2^62 ticks is ~1.4e10
+# years) yet small enough to never overflow arithmetic around it.
+NO_DEADLINE = 1 << 62
+
+
 class _PendingBase:
-    __slots__ = ("_lock", "_next_key", "_pending")
+    __slots__ = ("_lock", "_next_key", "_pending", "_hint")
 
     def __init__(
         self,
         lock: Optional[threading.Lock] = None,
         key_base: Optional[int] = None,
+        deadline_hint: Optional[list] = None,
     ):
         # a node's five tables share one lock (pass it in): contention
         # is per-replica and tiny, while 4 saved locks x 50k rows is
@@ -132,12 +140,26 @@ class _PendingBase:
         self._next_key = (  # guarded-by: _lock
             random_key_base() if key_base is None else key_base
         )
+        # earliest-pending-deadline hint, shared across a node's five
+        # tables (a 1-element list cell, like the lock): _alloc lowers
+        # it under _lock; gc_tables re-arms it after a sweep.  The tick
+        # path probes it LOCK-FREE (`tick >= hint[0]`) — a stale-high
+        # read (probe raced a concurrent _alloc's lowering) only delays
+        # that future's timeout to the next tick sweep, the same benign
+        # race the lock-free `_pending` probe in gc() already accepts;
+        # a stale-low read (pop/seal/drop_all never raise it) costs one
+        # no-op sweep that re-arms it.
+        self._hint = deadline_hint if deadline_hint is not None else [
+            NO_DEADLINE
+        ]
 
     def _alloc(self, deadline: int) -> RequestState:
         with self._lock:
             self._next_key += 1
             rs = RequestState(self._next_key, deadline)
             self._pending[self._next_key] = rs
+            if deadline < self._hint[0]:
+                self._hint[0] = deadline  # guarded-by: _lock
             return rs
 
     def pop(self, key: int) -> Optional[RequestState]:
@@ -158,13 +180,24 @@ class _PendingBase:
             # request registered concurrently is swept next tick.
             return
         with self._lock:
-            expired = [
-                k for k, rs in self._pending.items() if rs.deadline <= now_tick
-            ]
-            for k in expired:
-                self._pending.pop(k).notify(RequestResultCode.TIMEOUT)
-            if expired:
-                self._gc_extra(set(expired))
+            self._gc_locked(now_tick)
+
+    def _gc_locked(self, now_tick: int) -> int:  # guarded-by: _lock
+        """Sweep under a held ``self._lock`` and return the surviving
+        minimum deadline (``NO_DEADLINE`` when empty) so batched
+        callers (:func:`gc_tables`) can re-arm the shared hint."""
+        expired = [
+            k for k, rs in self._pending.items() if rs.deadline <= now_tick
+        ]
+        for k in expired:
+            self._pending.pop(k).notify(RequestResultCode.TIMEOUT)
+        if expired:
+            self._gc_extra(set(expired))
+        nd = NO_DEADLINE
+        for rs in self._pending.values():
+            if rs.deadline < nd:
+                nd = rs.deadline
+        return nd
 
     def _gc_extra(self, expired_keys) -> None:  # guarded-by: _lock
         """Subclass hook, called under self._lock, to drop side-table state
@@ -201,6 +234,41 @@ class _PendingBase:
     def __len__(self) -> int:
         with self._lock:
             return len(self._pending)
+
+
+def gc_tables(tables, hint, now_tick: int) -> None:
+    """One hint-gated sweep over a node's pending tables — the batched
+    replacement for five per-table ``gc()`` calls per tick/generation.
+
+    ``tables`` must share ONE lock and ONE deadline-hint cell (the
+    ``Node`` construction; asserted under ``__debug__``): the whole
+    sweep then runs under a single lock acquisition, and the hint
+    re-arm cannot race a concurrent ``_alloc``'s lowering (both are
+    serialized by the same lock).
+
+    Exactness (the monotone-deadline argument, kept honest): deadlines
+    are fixed at allocation and ``now_tick`` is monotone, so a future
+    times out at exactly the first sweep whose ``now_tick`` reaches its
+    deadline.  The hint is the min pending deadline, therefore the
+    first tick at which ANY future could expire is precisely the first
+    tick at which this function sweeps — every timeout is delivered at
+    the same tick value the old sweep-every-tick loop delivered it at,
+    while ticks below the hint (the overwhelming majority) cost one
+    int compare instead of five lock-acquiring sweeps.
+    """
+    if now_tick < hint[0]:
+        return
+    lock = tables[0]._lock
+    assert all(t._lock is lock and t._hint is hint for t in tables), (
+        "gc_tables requires tables sharing one lock + hint cell"
+    )
+    with lock:
+        nd = NO_DEADLINE
+        for t in tables:
+            d = t._gc_locked(now_tick)
+            if d < nd:
+                nd = d
+        hint[0] = nd  # guarded-by: the shared tables lock
 
 
 class PendingProposal(_PendingBase):
@@ -248,8 +316,9 @@ class PendingReadIndex(_PendingBase):
         self,
         lock: Optional[threading.Lock] = None,
         key_base: Optional[int] = None,
+        deadline_hint: Optional[list] = None,
     ):
-        super().__init__(lock, key_base)
+        super().__init__(lock, key_base, deadline_hint)
         self._ctx_map: Dict[Tuple[int, int], int] = {}  # ctx->key; guarded-by: _lock
         self._waiting: List[Tuple[int, int]] = []  # (read_index, key); guarded-by: _lock
 

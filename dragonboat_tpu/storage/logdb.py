@@ -13,6 +13,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..pb import Bootstrap, Entry, Snapshot, State, EMPTY_SNAPSHOT, Update
 from ..raft.log import LogCompactedError, LogUnavailableError
 from ..raftio import ILogDB, NodeInfo, RaftState
@@ -43,6 +45,66 @@ class InMemLogDB(ILogDB):
         self._lock = threading.RLock()
         self._nodes: Dict[Tuple[int, int], _NodeStore] = {}
         self.sync_count = 0  # batched-write counter (1 per save_raft_state)
+        # columnar hard-state lanes (ISSUE 13): replicas the device
+        # merge tail saves every generation register a SLOT once
+        # (state_lane_slot) and from then on save_state_slots persists
+        # their (term, vote, commit) triples as THREE numpy scatters —
+        # zero per-row Python on the hot save.  ``_hs_dirty[s]`` marks
+        # lane words newer than ``ns.state``; readers and the classic
+        # save path reconcile through _hs_sync (lane words materialize
+        # into a State lazily, exactly-once).  All guarded by _lock.
+        self._hs_slots: Dict[Tuple[int, int], int] = {}
+        self._hs_next = 0  # monotone slot counter (slots of removed
+        # replicas are orphaned, never reused — see remove_node_data)
+        self._hs = np.zeros((3, 0), np.int64)
+        self._hs_dirty = np.zeros((0,), bool)
+
+    def _hs_sync(self, key, ns) -> None:  # guarded-by: _lock
+        """Materialize pending lane words into ``ns.state`` (reader's
+        half of the columnar protocol)."""
+        s = self._hs_slots.get(key)
+        if s is not None and self._hs_dirty[s]:
+            self._hs_dirty[s] = False
+            ns.state = State(
+                term=int(self._hs[0, s]),
+                vote=int(self._hs[1, s]),
+                commit=int(self._hs[2, s]),
+            )
+
+    def state_lane_slot(self, shard_id: int, replica_id: int) -> int:
+        """Register (or look up) the replica's hard-state lane slot.
+        Callers cache the returned slot (engine: ``node.hs_lane_slot``)
+        so the steady-state save path never touches the key dict."""
+        with self._lock:
+            key = (shard_id, replica_id)
+            s = self._hs_slots.get(key)
+            if s is None:
+                s = self._hs_next
+                self._hs_next = s + 1
+                self._hs_slots[key] = s
+                if s >= self._hs.shape[1]:
+                    grow = max(64, 2 * self._hs.shape[1])
+                    nb = np.zeros((3, grow), np.int64)
+                    nb[:, : self._hs.shape[1]] = self._hs
+                    self._hs = nb
+                    nd = np.zeros((grow,), bool)
+                    nd[: self._hs_dirty.shape[0]] = self._hs_dirty
+                    self._hs_dirty = nd
+            return s
+
+    def save_state_slots(
+        self, slots, terms, votes, commits, worker_id: int
+    ) -> None:
+        """Batched hard-state save by pre-registered slot: three numpy
+        scatters + a dirty mark, one lock hold — the vectorized half of
+        ILogDB.save_state_lanes for stores with a cheap hard-state
+        column (atomicity contract is save_raft_state's)."""
+        with self._lock:
+            self._hs[0, slots] = terms
+            self._hs[1, slots] = votes
+            self._hs[2, slots] = commits
+            self._hs_dirty[slots] = True
+            self.sync_count += 1
 
     def _get(self, shard_id: int, replica_id: int) -> _NodeStore:
         key = (shard_id, replica_id)
@@ -81,6 +143,11 @@ class InMemLogDB(ILogDB):
                 ns = self._get(u.shard_id, u.replica_id)
                 if not u.state.is_empty():
                     ns.state = u.state
+                    if self._hs_slots:
+                        # a classic save overrides pending lane words
+                        s = self._hs_slots.get((u.shard_id, u.replica_id))
+                        if s is not None:
+                            self._hs_dirty[s] = False
                 for e in u.entries_to_save:
                     ns.entries[e.index] = e
                     if e.index > ns.max_index:
@@ -98,12 +165,34 @@ class InMemLogDB(ILogDB):
                         ns.max_index = u.snapshot.index
             self.sync_count += 1
 
+    def save_state_lanes(
+        self, shard_ids, replica_ids, terms, votes, commits, worker_id
+    ) -> None:
+        """Batched hard-state-only save (see ILogDB.save_state_lanes):
+        one lock hold, one State write per lane row, no per-row Update
+        carrier — the in-memory store's half of the ISSUE-13 merge-tail
+        vectorization."""
+        with self._lock:
+            get = self._get
+            slots = self._hs_slots
+            for s_id, r_id, t, v, c in zip(
+                shard_ids, replica_ids, terms, votes, commits
+            ):
+                get(s_id, r_id).state = State(t, v, c)
+                if slots:
+                    s = slots.get((s_id, r_id))
+                    if s is not None:
+                        self._hs_dirty[s] = False
+            self.sync_count += 1
+
     def read_raft_state(self, shard_id, replica_id, last_index) -> Optional[RaftState]:
         with self._lock:
             key = (shard_id, replica_id)
             if key not in self._nodes:
                 return None
             ns = self._nodes[key]
+            if self._hs_slots:
+                self._hs_sync(key, ns)
             first = max(ns.min_index, ns.snapshot.index + 1)
             count = 0
             i = first
@@ -165,6 +254,11 @@ class InMemLogDB(ILogDB):
     def remove_node_data(self, shard_id, replica_id) -> None:
         with self._lock:
             self._nodes.pop((shard_id, replica_id), None)
+            # orphan the hard-state lane slot: a re-added replica gets
+            # a fresh slot (and a fresh _NodeStore); writes through a
+            # stale cached slot land on the orphaned array column,
+            # which no reader can reach once the key is popped
+            self._hs_slots.pop((shard_id, replica_id), None)
 
     def import_snapshot(self, snapshot: Snapshot, replica_id: int) -> None:
         with self._lock:
@@ -173,6 +267,9 @@ class InMemLogDB(ILogDB):
             ns.state = State(
                 term=snapshot.term, vote=0, commit=snapshot.index
             )
+            s = self._hs_slots.get((snapshot.shard_id, replica_id))
+            if s is not None:
+                self._hs_dirty[s] = False
             ns.entries.clear()
             ns.max_index = snapshot.index
             ns.min_index = snapshot.index + 1

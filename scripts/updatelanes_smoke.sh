@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# Update-lane smoke (array-side pb.Update lanes, ISSUE 13 /
+# docs/PARITY.md "Update-lane contract"): boot a 3-replica colocated
+# cluster with the per-generation hostplane parity oracle armed, drive
+# a small proposal workload through the device path, then assert
+#   1. every future completes (the lane merge tail must not strand or
+#      duplicate any completion),
+#   2. the lane path actually carried rows: lane_rows > 0 (batched
+#      save_state_lanes persists replaced per-row get_update walks —
+#      the "Raft-less host rows" mechanism, visible without hardware),
+#   3. zero divergence halts and the parity oracle stayed green across
+#      every generation (lane words == the scalar twin's, bit for bit).
+# Cheap (~5s) — wired into tier1.sh as a post-step.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu DRAGONBOAT_TPU_HOSTPLANE_PARITY=1 python - <<'EOF'
+import shutil
+import sys
+import time
+
+sys.path.insert(0, "tests")
+
+from dragonboat_tpu import (
+    Config,
+    EngineConfig,
+    ExpertConfig,
+    NodeHost,
+    NodeHostConfig,
+)
+from dragonboat_tpu.ops import hostplane
+from dragonboat_tpu.ops.colocated import ColocatedEngineGroup
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+from test_nodehost import KVStore, set_cmd
+
+ADDRS = {1: "ul-smoke-1", 2: "ul-smoke-2", 3: "ul-smoke-3"}
+reset_inproc_network()
+group = ColocatedEngineGroup(
+    capacity=16, P=5, W=32, M=8, E=4, O=32, budget=4,
+)
+nhs = {}
+for rid, addr in ADDRS.items():
+    d = f"/tmp/nh-ul-smoke-{rid}"
+    shutil.rmtree(d, ignore_errors=True)
+    nhs[rid] = NodeHost(NodeHostConfig(
+        nodehost_dir=d,
+        rtt_millisecond=5,
+        raft_address=addr,
+        expert=ExpertConfig(
+            engine=EngineConfig(exec_shards=1, apply_shards=2),
+            step_engine_factory=group.factory,
+        ),
+    ))
+try:
+    for rid, nh in nhs.items():
+        nh.start_replica(
+            ADDRS, False, KVStore,
+            Config(replica_id=rid, shard_id=1, election_rtt=20,
+                   heartbeat_rtt=2, pre_vote=True, check_quorum=True),
+        )
+    deadline = time.time() + 30.0
+    leader = None
+    while time.time() < deadline and leader is None:
+        leader = next((r for r, nh in nhs.items() if nh.is_leader_of(1)),
+                      None)
+        time.sleep(0.02)
+    assert leader, "no leader within 30s"
+
+    nh = nhs[leader]
+    sess = nh.get_noop_session(1)
+    pending = []
+    for i in range(30):
+        pending.append(nh.propose(sess, set_cmd(f"k{i}", str(i)), 20.0))
+        if len(pending) >= 6:
+            rs = pending.pop(0)
+            rs._event.wait(20.0)
+            assert rs.code == 1, f"proposal failed: code={rs.code}"
+    for rs in pending:
+        rs._event.wait(20.0)
+        assert rs.code == 1, f"tail proposal failed: code={rs.code}"  # (1)
+
+    core = group.core
+    st = core.stats
+    assert st["launches"] > 5, st
+    assert st.get("lane_rows", 0) > 0, (                   # (2)
+        f"lane path never carried a row: {st}"
+    )
+    assert st.get("divergence_halts", 0) == 0, st          # (3)
+    assert hostplane.PARITY_FAILURE_COUNT == 0, hostplane.PARITY_FAILURES
+finally:
+    for nh in nhs.values():
+        try:
+            nh.close()
+        except Exception:
+            pass
+
+core = group.core
+print(
+    f"UPDATELANES_SMOKE_OK launches={core.stats['launches']} "
+    f"lane_rows={core.stats.get('lane_rows', 0)} "
+    f"lane_commit_rows={core.stats.get('lane_commit_rows', 0)} "
+    f"early={core.stats.get('early_completions', 0)} parity_green=1"
+)
+EOF

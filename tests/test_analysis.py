@@ -563,6 +563,68 @@ def test_host_loop_real_tree_colocated_annotation_is_live():
     assert any(f.rule == "host-loop" for f in fs)
 
 
+def test_host_loop_engine_module_in_scope():
+    """ops/engine.py joined HOSTPLANE_MODULES for the ISSUE-13 lane
+    machinery: marked functions there are held to the same no-loop
+    discipline as hostplane/colocated."""
+    fs = lint_source(HOST_LOOP_SRC, "dragonboat_tpu/ops/engine.py")
+    assert rules_of(fs) == {"host-loop"} and len(fs) == 3, fs
+
+
+def test_host_loop_real_tree_lane_plan_annotation_is_live():
+    """plan_update_sync (the r9 update-lane classifier) carries the
+    # hostplane-hot marker; a for-over-rows seeded into its body must
+    surface."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/hostplane.py")
+    src = open(path).read()
+    assert "def plan_update_sync(  # hostplane-hot" in src
+    assert lint_source(src, "dragonboat_tpu/ops/hostplane.py") == []
+    needle = "    in_sum = sum_k >= 0"
+    assert needle in src
+    seeded = src.replace(
+        needle,
+        "    junk = [int(k) for k in sum_k]\n" + needle,
+        1,
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/ops/hostplane.py")
+    assert any(f.rule == "host-loop" for f in fs)
+
+
+def test_host_loop_lane_scalar_oracle_ignore_is_live():
+    """plan_update_sync_scalar (the documented per-row parity oracle)
+    is exempted by a def-line-adjacent ignore; stripping the ignore
+    must surface its row loop — the exemption is doing real work."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/hostplane.py")
+    src = open(path).read()
+    marker = "# raftlint: ignore[host-loop] parity oracle"
+    assert marker in src
+    stripped = src.replace(marker, "# stripped", 1)
+    fs = lint_source(stripped, "dragonboat_tpu/ops/hostplane.py")
+    assert any(f.rule == "host-loop" for f in fs), (
+        "stripping the scalar-oracle ignore surfaced nothing — either "
+        "the oracle lost its hot marker or the rule went dead"
+    )
+
+
+def test_host_loop_real_tree_engine_lane_assembly_is_live():
+    """_plan_lane_words (ops/engine.py's lane assembly) is marked; a
+    per-row scan seeded into it must surface — the engine module's
+    membership in HOSTPLANE_MODULES is live, not decorative."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/engine.py")
+    src = open(path).read()
+    assert "def _plan_lane_words(  # hostplane-hot" in src
+    assert lint_source(src, "dragonboat_tpu/ops/engine.py") == []
+    needle = "    old_w = ulanes.words[:, gs_live]"
+    assert needle in src
+    seeded = src.replace(
+        needle,
+        "    junk = [int(g) for g in gs_live]\n" + needle,
+        1,
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/ops/engine.py")
+    assert any(f.rule == "host-loop" for f in fs)
+
+
 # ---------------------------------------------------------------------------
 # sync-budget (# sync-hot launch-pipeline functions: one readback per
 # generation — docs/BENCH_NOTES_r07.md)

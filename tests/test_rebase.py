@@ -74,6 +74,16 @@ class FakeNode:
         self.stopped = False
         self.tick_count = 0
         self.notify_work = None
+        # r9 update-lane surface: leader view (lane-diff notifications
+        # sync it at upload), pending-table hint cell, LogDB binding
+        # (no slot protocol -> the engine takes the list-form persist)
+        self.leader_id = raft.leader_id
+        self.pending_deadline_hint = [1 << 62]
+        self.pending_tables = ()
+        self.hs_lane_slot = -1
+        self.logdb = None
+        self.engine_apply_ready = None
+        self._trace_spans = {}
 
         class _Reads:
             def has_pending(self):
