@@ -1053,6 +1053,40 @@ def phase_hostplane(rows_list=None, launches: int = 6) -> dict:
     return {"tiers": tiers, "parity": True}
 
 
+def phase_day(seed: int = 7, scale: float = 0.6) -> dict:
+    """Production-day scenario guard (dragonboat_tpu/scenario/,
+    docs/SCENARIO.md): one seeded mini-day over the mixed
+    on-disk/in-memory/witness fleet under live gateway traffic — every
+    disturbance class fired, every recovery under assert_recovery_sla,
+    the whole history Wing-Gong-audited across the DR boundary.
+
+    The emitted record is the DayReport's ledger surface: baseline
+    committed/s, the per-fault-class throughput-dip table, worst/p99
+    recovery per class and the audit verdict — the repo's end-to-end
+    "can it run a real day in production" number.  Host path only (no
+    device); BENCH_DAY gate; BENCH_DAY_SEED/BENCH_DAY_SCALE knobs."""
+    from dragonboat_tpu.scenario import DayPlan, ScenarioRunner
+
+    plan = DayPlan.mini(seed, scale=scale)
+    r = ScenarioRunner(plan, tag=f"bench-day-{seed}").run()
+    return {
+        "ok": r.ok,
+        "seed": seed,
+        "scale": scale,
+        "wall_s": round(r.wall_s, 1),
+        "baseline_committed_per_s": round(r.baseline_committed_per_s, 1),
+        "fault_dips": {k: round(v, 3) for k, v in r.fault_dips.items()},
+        "recovery": r.recovery,
+        "disturbances_fired": r.disturbances_fired,
+        "audit_ok": bool(r.audit.get("ok", False)),
+        "ops_ok": r.audit.get("ops", {}).get("ok", 0),
+        "aborted": r.aborted,
+        "sla_violations": sum(
+            c.get("violations", 0) for c in r.recovery.values()
+        ),
+    }
+
+
 def phase_updatelanes(rows_list=None, reps: int = 3) -> dict:
     """Update-stage residual, scalar (the r8 per-row loop) vs lane
     (r9, ops/hostplane.UpdateLanes), over fabricated generations
@@ -2775,7 +2809,8 @@ def main() -> None:
     def emit(ticks_per_sec: float, a_groups, device_loop, consensus,
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
              gateway=None, bigstate=None, hostplane=None,
-             pipeline=None, multichip=None, updatelanes=None) -> None:
+             pipeline=None, multichip=None, updatelanes=None,
+             day=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -2837,6 +2872,11 @@ def main() -> None:
                     # update-stage residual per rows tier — the ISSUE-13
                     # "Raft-less host rows" wall, docs/BENCH_NOTES_r09.md)
                     "updatelanes": updatelanes,
+                    # r16 schema addition: production-day scenario guard
+                    # (scenario/; mini-day ledger — per-fault-class
+                    # throughput dips + recovery table + audit verdict
+                    # over the mixed fleet — docs/SCENARIO.md)
+                    "day": day,
                 }
             ),
             flush=True,
@@ -3110,6 +3150,26 @@ def main() -> None:
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb, hpb, ppb, mcb, ulb)
 
+    # Production-day scenario guard (host path only, ~15-25s; BENCH_DAY
+    # gate): the mini-day ledger — dips per fault class, recovery table,
+    # audit verdict (docs/SCENARIO.md)
+    dayb = None
+    if bool(int(os.environ.get("BENCH_DAY", "1"))) and remaining() > 60:
+        day_seed = int(os.environ.get("BENCH_DAY_SEED", "7"))
+        day_scale = float(os.environ.get("BENCH_DAY_SCALE", "0.6"))
+        code = (
+            "import json, bench;"
+            f"print('BENCHDAY ' + json.dumps(bench.phase_day({day_seed}, "
+            f"{day_scale})))"
+        )
+        dayb, day_err = run_sub(
+            code, "BENCHDAY", max(60, min(300, int(remaining() - 30)))
+        )
+        if dayb is None:
+            dayb = {"error": day_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb, ppb, mcb, ulb, dayb)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -3149,6 +3209,11 @@ if __name__ == "__main__":
         # (spawns its own per-device-count subprocesses; no backend is
         # initialized in THIS process, so the forced counts latch)
         print("BENCHMC " + json.dumps(phase_multichip()), flush=True)
+    elif "phase_day" in _sys.argv[1:]:
+        # standalone mini-day run: `python bench.py phase_day`
+        import json
+
+        print("BENCHDAY " + json.dumps(phase_day()), flush=True)
     elif "phase_updatelanes" in _sys.argv[1:]:
         # standalone update-lane run: `python bench.py phase_updatelanes`
         # (host-only numpy; BENCH_UPDATELANES_HEAVY=1 adds 50k/250k)

@@ -26,9 +26,11 @@ from .gateway import (
     GatewayConfig,
 )
 from .faults import (
+    RECOVERY_STATS,
     Fault,
     FaultController,
     FaultPlan,
+    RecoveryStats,
     RecoverySLAAborted,
     RecoverySLAViolation,
     assert_recovery_sla,
@@ -70,6 +72,8 @@ from .statemachine import (
 )
 
 __all__ = [
+    "RECOVERY_STATS",
+    "RecoveryStats",
     "Balancer",
     "BalanceAborted",
     "DrainTimeout",

@@ -159,6 +159,9 @@ HOT_IMPORT_MODULES = (
 DETERMINISM_MODULES = (
     "dragonboat_tpu/faults.py",
     "dragonboat_tpu/balance/planner.py",
+    # the production-day schedule builder: DayPlan.describe() is the
+    # day's byte-determinism contract (docs/SCENARIO.md)
+    "dragonboat_tpu/scenario/plan.py",
 )
 WIDTH_MODULES = (
     "dragonboat_tpu/transport/wire.py",

@@ -120,6 +120,19 @@ class HistoryRecorder:
             out[o.status] = out.get(o.status, 0) + 1
         return out
 
+    def ops_for(self, key_prefix) -> List[Op]:
+        """The sub-history whose keys start with ``key_prefix`` (str or
+        bytes, matched against same-typed keys).  A recorder shared by
+        several shards keys each shard's traffic under its own prefix;
+        the per-key linearizability search never mixes them, but the
+        SESSION pass must be scoped to the one shard whose replica
+        journals it is judging against — this is that scope."""
+        return [
+            o for o in self.ops()
+            if isinstance(o.key, type(key_prefix))
+            and o.key.startswith(key_prefix)
+        ]
+
     # -- replay serialization (docs/AUDIT.md) ----------------------------
     def to_jsonl(self) -> str:
         return "\n".join(

@@ -103,6 +103,15 @@ class Balancer:
             "balance_draining_hosts", lambda: len(self._draining)
         )
 
+    @property
+    def last_move_report(self) -> dict:
+        """The executor's most recent move report (incl. the
+        ``"catchup"`` snapshot-stream progress block) — surfaced so
+        drain/rebalance drivers (the scenario orchestrator's region
+        drain foremost) can put stream totals in their ledgers without
+        reaching into the executor."""
+        return self.executor.last_move_report
+
     # -- membership of the host fleet -----------------------------------
     def join(self, key: str, nh) -> None:
         """Register a (new or returning) host; subsequent passes spread

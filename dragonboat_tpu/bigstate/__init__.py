@@ -15,9 +15,20 @@ module load and must not drag the storage/rsm stack in with it):
 """
 from __future__ import annotations
 
+import os
+
 from .pacing import CapFeedback, TokenBucket
 
-__all__ = ["CapFeedback", "TokenBucket"]
+__all__ = ["CapFeedback", "TokenBucket", "gb_tier"]
+
+
+def gb_tier() -> bool:
+    """True when the operator armed the GB-scale big-state tier
+    (``DRAGONBOAT_BIGSTATE_GB=1``): the slow catch-up tests and the
+    full production-day soak (docs/SCENARIO.md) then size their on-disk
+    shard near a gigabyte and keep streams capped; everything else
+    stays at the MB-scale default."""
+    return os.environ.get("DRAGONBOAT_BIGSTATE_GB", "0") not in ("", "0")
 
 
 def __getattr__(name):

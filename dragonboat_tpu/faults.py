@@ -60,6 +60,7 @@ docs/FAULTS.md).
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 import zlib
@@ -117,8 +118,12 @@ CHURN_KINDS = (
 # receiver's cursor, not restart from zero; ``snapshot_stream_stall``
 # sleeps ``delay`` per chunk, stretching the transfer so leader churn /
 # wire faults can land while a laggard is mid-catch-up.  Targets are
-# SENDER transport addresses (wire-kind convention; empty = any sender).
+# SENDER transport addresses (wire-kind convention; empty = any sender)
+# or ``"dst:<addr>"`` entries scoping by RECEIVER — the witness/dummy
+# chaos schedule needs "every stream going TO this replica" regardless
+# of which live voter happens to lead (and therefore send) that round.
 STREAM_KINDS = ("snapshot_stream_kill", "snapshot_stream_stall")
+STREAM_DST_PREFIX = "dst:"
 ALL_KINDS = (
     WIRE_KINDS + FS_KINDS + ENGINE_KINDS + PROCESS_KINDS + BALANCE_KINDS
     + CHURN_KINDS + STREAM_KINDS
@@ -190,6 +195,7 @@ class FaultPlan:
         shards: Sequence[int] = (),
         churn_shards: Sequence[int] = (),
         stream_addrs: Sequence[str] = (),
+        stream_recv_addrs: Sequence[str] = (),
         rounds: int = 8,
         mean_gap: float = 0.8,
         mean_duration: float = 0.8,
@@ -201,9 +207,18 @@ class FaultPlan:
         (the consumer must have called ``install_churn``);
         ``stream_addrs`` adds the snapshot-stream plane (kill/stall the
         streamer of the named sender addresses) — opt-in so existing
-        seeded schedules stay byte-identical."""
+        seeded schedules stay byte-identical.  ``stream_recv_addrs``
+        widens the stream plane's target pool with RECEIVER-scoped
+        entries (``dst:<addr>``): a schedule can then strike every
+        stream going TO a witness/dummy or laggard replica no matter
+        which voter is the current sender; passing only
+        ``stream_addrs`` keeps the drawn plan byte-identical to
+        pre-``stream_recv_addrs`` trees (same pool, same draws)."""
         rng = Random(seed)
         addrs = list(addrs)
+        stream_pool = list(stream_addrs) + [
+            STREAM_DST_PREFIX + a for a in stream_recv_addrs
+        ]
         kinds = ["partition", "drop", "delay", "duplicate", "reorder"]
         if fs_keys:
             kinds += ["fsync_err", "torn_write"]
@@ -213,7 +228,7 @@ class FaultPlan:
             kinds.append("escalate")
         if churn_shards:
             kinds += ["leader_kill", "leader_transfer", "member_cycle"]
-        if stream_addrs:
+        if stream_pool:
             kinds += ["snapshot_stream_kill", "snapshot_stream_stall"]
         t = 0.0
         faults: List[Fault] = []
@@ -272,7 +287,7 @@ class FaultPlan:
                         kind,
                         at=t,
                         duration=dur,
-                        targets=(rng.choice(list(stream_addrs)),),
+                        targets=(rng.choice(stream_pool),),
                         p=round(rng.uniform(0.05, 0.3), 3),
                         delay=round(rng.uniform(0.01, 0.1), 3),
                     )
@@ -335,6 +350,71 @@ class RecoverySLAAborted(Exception):
     no verdict, neither a pass nor a violation."""
 
 
+class RecoveryStats:
+    """Process-wide recovery aggregator, one bucket per ``fault_class``
+    (the label :func:`assert_recovery_sla` stamps on each check).
+
+    Every SLA check that reaches a verdict records its wall recovery
+    time and its margin against the tick budget here, so consumers that
+    need "recovery per disturbance class" — the scenario orchestrator's
+    ``DayReport`` dip table (docs/SCENARIO.md) foremost — read ONE
+    source instead of wrapping every recovery in an ad-hoc timer.
+    Aborted checks (:class:`RecoverySLAAborted`) record nothing: an
+    abort has no verdict.  ``reset()`` starts a fresh measurement epoch
+    (the runner calls it at day start); snapshot() is cheap enough for
+    per-phase ledger sampling."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: Dict[str, List[float]] = {}  # guarded-by: _lock
+        self._violations: Dict[str, int] = {}  # guarded-by: _lock
+        self._min_margin: Dict[str, float] = {}  # guarded-by: _lock
+
+    def record(
+        self, fault_class: str, seconds: float, budget: float, ok: bool
+    ) -> None:
+        cls = fault_class or "unclassified"
+        margin = budget - seconds
+        with self._lock:
+            self._samples.setdefault(cls, []).append(float(seconds))
+            if not ok:
+                self._violations[cls] = self._violations.get(cls, 0) + 1
+            cur = self._min_margin.get(cls)
+            if cur is None or margin < cur:
+                self._min_margin[cls] = margin
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._violations.clear()
+            self._min_margin.clear()
+
+    def snapshot(self) -> Dict[str, dict]:
+        """``{fault_class: {count, worst_s, p99_s, violations,
+        min_margin_s}}`` over everything recorded since the last
+        reset()."""
+        with self._lock:
+            samples = {k: list(v) for k, v in self._samples.items()}
+            violations = dict(self._violations)
+            margins = dict(self._min_margin)
+        out: Dict[str, dict] = {}
+        for cls, xs in samples.items():
+            s = sorted(xs)
+            p99_i = max(0, math.ceil(len(s) * 0.99) - 1)
+            out[cls] = {
+                "count": len(s),
+                "worst_s": round(s[-1], 4),
+                "p99_s": round(s[p99_i], 4),
+                "violations": violations.get(cls, 0),
+                "min_margin_s": round(margins.get(cls, 0.0), 4),
+            }
+        return out
+
+
+#: the process-wide aggregator every assert_recovery_sla records into
+RECOVERY_STATS = RecoveryStats()
+
+
 def assert_recovery_sla(
     nhs: Dict,
     shard_id: int = 1,
@@ -343,6 +423,7 @@ def assert_recovery_sla(
     rtt_ms: Optional[int] = None,
     per_try_timeout: float = 1.0,
     should_abort: Optional[Callable[[], bool]] = None,
+    fault_class: str = "",
 ) -> int:
     """Recovery-SLA invariant: after faults heal, the cluster must
     re-establish FULL leader coverage (every NodeHost knows the same
@@ -355,15 +436,20 @@ def assert_recovery_sla(
     between waits/tries (a caller's stop flag — the nemesis thread must
     not sit in a minutes-long SLA wait while teardown joins it); when
     it fires, :class:`RecoverySLAAborted` is raised — an aborted check
-    has NO verdict.  Returns the leader id.  Raises
-    :class:`RecoverySLAViolation` otherwise."""
+    has NO verdict.  ``fault_class`` labels the disturbance being
+    recovered from ("leader_kill", "rolling_restart", ...); every
+    verdict — pass or violation — lands in :data:`RECOVERY_STATS`
+    under that label with its wall recovery time and budget margin.
+    Returns the leader id.  Raises :class:`RecoverySLAViolation`
+    otherwise."""
     hosts = list(nhs.values())
     if not hosts:
         raise ValueError("no nodehosts")
     if rtt_ms is None:
         rtt_ms = max(nh.config.rtt_millisecond for nh in hosts)
     budget = sla_ticks * rtt_ms / 1000.0
-    deadline = time.monotonic() + budget
+    t_start = time.monotonic()
+    deadline = t_start + budget
     leader = None
     while time.monotonic() < deadline:
         if should_abort is not None and should_abort():
@@ -386,6 +472,9 @@ def assert_recovery_sla(
                 break
         time.sleep(0.02)
     if leader is None:
+        RECOVERY_STATS.record(
+            fault_class, time.monotonic() - t_start, budget, ok=False
+        )
         raise _sla_violation(
             hosts, shard_id,
             f"no full leader coverage for shard {shard_id} within "
@@ -418,11 +507,18 @@ def assert_recovery_sla(
                 # deadline; the verdict at the deadline is the same
                 # violation whether the error was transient or terminal
                 if time.monotonic() >= deadline:
+                    RECOVERY_STATS.record(
+                        fault_class, time.monotonic() - t_start, budget,
+                        ok=False,
+                    )
                     raise _sla_violation(
                         hosts, shard_id,
                         f"no commit progress on shard {shard_id} within "
                         f"{sla_ticks} ticks ({budget:.1f}s): {e!r}",
                     ) from e
+    RECOVERY_STATS.record(
+        fault_class, time.monotonic() - t_start, budget, ok=True
+    )
     return leader
 
 
@@ -759,6 +855,26 @@ class FaultController:
             self._thread = None
         self._heal_kinds(ALL_KINDS, restart=False)
 
+    def run_phase(
+        self, plan: FaultPlan, timeout: Optional[float] = None
+    ) -> bool:
+        """Execute one declarative plan to completion and return whether
+        it finished (False = timeout with the nemesis thread still
+        live).  The scenario orchestrator's phase hook: a production-day
+        run is a SEQUENCE of plans over ONE controller, so every phase
+        shares the seed, the per-lane RNGs and the event log (phase
+        boundaries are visible as plan gaps; docs/SCENARIO.md).  Unlike
+        :meth:`start`, the controller is reusable immediately after a
+        completed phase."""
+        if self._thread is not None:
+            raise RuntimeError("nemesis already running a plan")
+        self.plan = plan
+        self.start()
+        done = self.wait(timeout)
+        if done:
+            self._thread = None
+        return done
+
     def _run_plan(self) -> None:
         # timeline = activations + heals merged in schedule order; ties
         # break by plan position so execution order is deterministic
@@ -891,13 +1007,22 @@ class FaultController:
         bounded-retry path must resume from the receiver's cursor;
         ``snapshot_stream_stall`` sleeps ``delay`` seconds.  Kills only
         strike past chunk 0 so every killed transfer IS mid-transfer
-        (a pre-first-chunk kill would test plain retry, not resume)."""
+        (a pre-first-chunk kill would test plain retry, not resume) —
+        which also means a witness's DUMMY stream (exactly one chunk,
+        chunk_id 0) is structurally immune to kills: it either lands
+        whole or the ordinary send-failure retry applies.  Targets
+        match the SENDER address, or the RECEIVER when written as
+        ``dst:<addr>`` (docs/FAULTS.md, witness/dummy chaos)."""
         with self._lock:
             active = list(self._active)
         for f in active:
             if f.kind not in STREAM_KINDS:
                 continue
-            if f.targets and source not in f.targets:
+            if (
+                f.targets
+                and source not in f.targets
+                and (STREAM_DST_PREFIX + str(target)) not in f.targets
+            ):
                 continue
             if f.kind == "snapshot_stream_stall":
                 if self._draw("snapshot_stream_stall", source, target) < f.p:
@@ -1048,7 +1173,7 @@ class FaultController:
                     self._churn_note(
                         fault, "restart", f"shard={shard_id} host={key}"
                     )
-                    self._churn_sla(shard_id)
+                    self._churn_sla(shard_id, fault.kind)
             elif fault.kind == "member_cycle":
                 v = self._churn_state.pop(id(fault), None)
                 if v is not None:
@@ -1113,7 +1238,7 @@ class FaultController:
         self._churn_note(
             fault, "transfer", f"shard={shard_id} {lid} -> {target}"
         )
-        self._churn_sla(shard_id)
+        self._churn_sla(shard_id, fault.kind)
 
     def _churn_member_add(self, fault: Fault) -> None:
         shard_id = self._churn_pick_shard(fault)
@@ -1127,21 +1252,40 @@ class FaultController:
             % len(keys)
         ]
         addr = hosts[addr_key].raft_address()
-        with self._lock:
-            self._churn_member_seq += 1
-            rid = 70_000 + self._churn_member_seq
         api = self._churn_api_host(shard_id)
         if api is None:
             self._churn_note(fault, "skip", "no live host holds the shard")
             return
+        with self._lock:
+            self._churn_member_seq += 1
+            rid = 70_000 + self._churn_member_seq
+        # the throwaway rid must clear EVERY id the shard has ever seen:
+        # other planes allocate max(known ids)+1 (the balance executor's
+        # next_replica_id walks voters+non-votings+witnesses+removed), so
+        # a fixed 70_000+seq can COLLIDE with a move-created voter once a
+        # churned id lands in `removed` — the add then rejects and the
+        # heal would remove a REAL member (found by the production-day
+        # soak: cycle-1 member_cycle deleted the voter cycle-0's drain
+        # had just placed, docs/SCENARIO.md)
+        try:
+            m = api.get_shard_membership(shard_id)
+            known = [
+                *m.addresses, *m.non_votings, *m.witnesses, *m.removed,
+            ]
+            rid = max(rid, max(known, default=0) + 1)
+        except Exception:  # noqa: BLE001 — membership mid-change; the
+            # remove-side guard still protects real members
+            pass
         from .client import call_with_retry
 
         # record the victim BEFORE the RPC: an add whose ack times out
         # may still have committed, and the heal must try the remove
         # either way (removing a never-committed member just rejects,
         # which the remove path counts as member_leak noise — better
-        # than a phantom non-voting member replicated-to forever)
-        self._churn_state[id(fault)] = (shard_id, rid)
+        # than a phantom non-voting member replicated-to forever).  The
+        # ADDRESS rides along so the heal can recognize a non-voting
+        # that is NOT ours (a concurrent plane winning the same rid)
+        self._churn_state[id(fault)] = (shard_id, rid, addr)
         # the new member is never started: a transiently-unreachable
         # NON-VOTING add (quorum untouched) the heal removes again —
         # the membership entries themselves are the churn
@@ -1164,7 +1308,10 @@ class FaultController:
             fault, "member_add", f"shard={shard_id} rid={rid} addr={addr}"
         )
 
-    def _churn_member_remove(self, fault: Fault, shard_id: int, rid: int) -> None:
+    def _churn_member_remove(
+        self, fault: Fault, shard_id: int, rid: int,
+        addr: Optional[str] = None,
+    ) -> None:
         api = self._churn_api_host(shard_id)
         if api is None:
             self._count("churn_member_failures")
@@ -1172,6 +1319,34 @@ class FaultController:
                 fault, "member_leak", f"shard={shard_id} rid={rid}"
             )
             return
+        # the heal may only remove the NON-VOTING member this cycle
+        # added: if the rid now resolves to a voter or witness — or to
+        # a non-voting at a DIFFERENT address — some other plane owns
+        # it (an id collision, e.g. a concurrent balance move's
+        # catch-up replica winning the same max(known)+1 draw) and
+        # removing it would damage the real membership; leak loudly
+        # instead
+        try:
+            m = api.get_shard_membership(shard_id)
+            stolen = (
+                rid in m.addresses
+                or rid in m.witnesses
+                or (
+                    addr is not None
+                    and m.non_votings.get(rid, addr) != addr
+                )
+            )
+            if stolen:
+                self._count("churn_member_failures")
+                self._churn_note(
+                    fault, "member_remove_skipped",
+                    f"shard={shard_id} rid={rid} is another plane's "
+                    "member (id collision), not removing",
+                )
+                return
+        except Exception:  # noqa: BLE001 — membership mid-change; the
+            # remove below still rejects ids that vanished
+            pass
         from .client import call_with_retry
 
         try:
@@ -1192,7 +1367,7 @@ class FaultController:
             self._churn_note(
                 fault, "member_leak", f"shard={shard_id} rid={rid}: {e!r}"
             )
-        self._churn_sla(shard_id)
+        self._churn_sla(shard_id, fault.kind)
 
     def _churn_api_host(self, shard_id: int):
         """A live host holding the shard (prefer the leader's)."""
@@ -1224,11 +1399,13 @@ class FaultController:
         self._churn_state[id(fault)] = t
         t.start()
 
-    def _churn_sla(self, shard_id: int) -> None:
+    def _churn_sla(self, shard_id: int, fault_class: str = "") -> None:
         """Per-event recovery-SLA assert: full re-election within the
         tick bound + commit continuity (when a probe cmd is armed).
         Runs on the nemesis thread — the next scheduled fault fires
-        after the cluster has either recovered or violated."""
+        after the cluster has either recovered or violated.  The churn
+        kind rides along as the SLA's ``fault_class``, so every churn
+        recovery lands in :data:`RECOVERY_STATS` under its own label."""
         if not self._churn_sla_ticks:
             return
         hosts = {
@@ -1249,6 +1426,7 @@ class FaultController:
                 hosts, shard_id, sla_ticks=self._churn_sla_ticks, cmd=cmd,
                 per_try_timeout=self._churn_sla_per_try,
                 should_abort=self._stop.is_set,
+                fault_class=fault_class,
             )
             self._count("churn_sla_ok")
         except RecoverySLAAborted:

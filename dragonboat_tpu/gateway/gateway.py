@@ -819,6 +819,12 @@ class Gateway:
             "lease_reads": self._lease_reads.value,
             "read_fallbacks": self._fallback_reads.value,
             "route_table": self.routes.table(),
+            # the commit path's live latency picture, as the scenario
+            # ledger samples it per phase (docs/SCENARIO.md): p99 is the
+            # budget's sliding-window estimate (bootstrap until any
+            # sample lands — see samples)
+            "p99_s": self.budget.p99(),
+            "budget_samples": self.budget.samples(),
         }
 
     def close(self) -> None:

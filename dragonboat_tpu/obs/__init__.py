@@ -21,6 +21,7 @@ from .recorder import (
     format_timeline,
     hosts_timeline,
     merged_timeline,
+    record_all,
 )
 from .trace import (
     Span,
@@ -41,6 +42,7 @@ __all__ = [
     "format_timeline",
     "hosts_timeline",
     "merged_timeline",
+    "record_all",
     "spans_to_trace_events",
     "stitched_traces",
 ]

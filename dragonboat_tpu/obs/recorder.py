@@ -155,6 +155,24 @@ def attach_timeline(
     return exc
 
 
+def record_all(hosts, shard_id: int, kind: str, detail: str = "") -> None:
+    """Stamp one marker event into EVERY given host's flight recorder
+    (hosts without a recorder contribute nothing; never raises — same
+    best-effort contract as :func:`attach_timeline`).  The scenario
+    orchestrator uses this for phase boundaries: a post-incident dump
+    must show WHICH production-day phase the cluster was in when the
+    state transitions around the failure happened (docs/SCENARIO.md)."""
+    hs = hosts.values() if hasattr(hosts, "values") else hosts
+    for nh in hs:
+        rec = getattr(nh, "recorder", None)
+        if rec is None:
+            continue
+        try:
+            rec.record(shard_id, kind, detail)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+
+
 def hosts_timeline(hosts, shard_id: Optional[int] = None) -> str:
     """The auto-dump entry point (``assert_recovery_sla`` violations,
     audit-gate failures): one formatted cross-host timeline from every

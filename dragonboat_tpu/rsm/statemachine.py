@@ -184,8 +184,22 @@ class StateMachine:
 
         with self._mu:
             for e in task.entries:
-                if e.index <= self.last_applied:
-                    continue  # replayed tail below on-disk applied index
+                # ONE dispatch ladder for live apply AND the on-disk
+                # replay window (entries at or below the index an
+                # IOnDiskStateMachine reported durably applied —
+                # reference: statemachine.go's onDiskInitIndex
+                # discipline [U]).  Membership and session state live
+                # in rsm MEMORY, so config-change / register /
+                # unregister entries run UNCONDITIONALLY and rebuild it
+                # during replay (their `_advance` is a no-op below the
+                # window); skipping them wholesale lost every
+                # witness/non-voting added below the on-disk index on
+                # the next restart without a snapshot — the restarted
+                # replica, and any leader it became, forgot those
+                # members existed and never replicated to them again
+                # (found by the production-day soak's rolling-restart
+                # phase, docs/SCENARIO.md).  Only USER code is gated on
+                # the window, in the application branch below.
                 if e.type == EntryType.CONFIG_CHANGE:
                     flush()
                     results.append(self._handle_config_change(e))
@@ -198,6 +212,25 @@ class StateMachine:
                 elif e.is_end_session_request():
                     flush()
                     results.append(self._handle_unregister(e))
+                elif e.index <= self.last_applied:
+                    # replay window, application entry: the effect is
+                    # already inside the on-disk state — never re-run
+                    # user code, but mark a session-managed series
+                    # responded so a cross-restart retry dedupes
+                    # instead of being rejected as an expired session.
+                    # A series can appear TWICE below the window (a
+                    # retry that committed both copies — the case
+                    # _check_duplicate dedupes on the live path), so
+                    # only the first replayed copy records; a second
+                    # add_response would raise and wedge replay in a
+                    # deterministic restart crash loop (review finding)
+                    if e.is_session_managed():
+                        s = self.sessions.get(e.client_id)
+                        if s is not None:
+                            s.clear_to(e.responded_to)
+                            _, hit = s.get_response(e.series_id)
+                            if not s.has_responded(e.series_id) and not hit:
+                                s.add_response(e.series_id, Result())
                 else:
                     if (
                         e.is_session_managed()

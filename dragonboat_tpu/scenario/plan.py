@@ -1,0 +1,278 @@
+"""The production-day plan: a declarative, seeded phase schedule.
+
+reference: the drummer/nemesis heritage (PAPER.md) — dragonboat's
+credibility soak is scheduled churn plus a monitoring loop that keeps
+repairing the fleet while traffic flows.  A :class:`DayPlan` is to the
+scenario orchestrator what :class:`~dragonboat_tpu.faults.FaultPlan` is
+to the nemesis: the complete, byte-canonical description of what will
+be done to the cluster.  ``describe()`` is the determinism contract —
+two plans built from the same seed and arguments are the SAME schedule
+iff their describe() strings are byte-equal (tests/test_scenario.py
+pins this), and every runtime-sampled victim (which host leads, which
+stream a kill strikes) stays out of it by construction.
+
+Two gears:
+
+* :meth:`DayPlan.mini` — the tier-1-scale mini-day (~30-60 s, small
+  fleet, every disturbance class fired at least once); ``scale < 1``
+  shrinks it further for the ~10 s smoke gear.
+* :meth:`DayPlan.full` — the env-gated hours-long day
+  (``DRAGONBOAT_SOAK_DAY=1``, ``scripts/day_soak.sh``): repeated
+  disturbance cycles sized to ``hours``, with the on-disk payload
+  raised to GB scale when ``DRAGONBOAT_BIGSTATE_GB=1``
+  (:func:`dragonboat_tpu.bigstate.gb_tier`).
+
+The five disturbance classes (every gear fires each at least once):
+``rolling_restart``, ``leader_churn``, ``stream_chaos``, ``drain``,
+``dr_cycle`` — see docs/SCENARIO.md for the class catalog and the
+ledger each phase emits.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from random import Random
+from typing import List, Optional, Tuple
+
+from ..faults import Fault
+
+#: the scenario fleet's shard ids (fixed — the plan references them)
+SH_MEM = 1   # in-memory AuditKV: audited gateway session traffic + DR
+SH_DISK = 2  # on-disk OnDiskKV: big-state plane, witness + non-voting
+
+#: the five disturbance classes a production day must fire
+DISTURBANCE_CLASSES = (
+    "rolling_restart",
+    "leader_churn",
+    "stream_chaos",
+    "drain",
+    "dr_cycle",
+)
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One phase of the day.
+
+    ``action`` names an orchestrator maneuver the runner executes
+    (``rolling_restart`` / ``catchup_chaos`` / ``drain`` / ``dr_cycle``
+    or empty for traffic-only phases); ``faults`` is a nemesis
+    sub-plan executed via :meth:`FaultController.run_phase` before the
+    action; ``duration`` is the minimum wall time of the phase (traffic
+    keeps flowing until it elapses, so even a fast action yields a
+    measurable throughput window).  ``params`` is a sorted key/value
+    tuple — part of the byte-canonical describe()."""
+
+    name: str
+    fault_class: str = ""
+    duration: float = 0.0
+    action: str = ""
+    params: Tuple[Tuple[str, object], ...] = ()
+    faults: Tuple[Fault, ...] = ()
+
+    def param(self, key: str, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+    def describe(self) -> str:
+        ps = ",".join(f"{k}={v!r}" for k, v in self.params)
+        fs = ";".join(f.describe() for f in self.faults)
+        return (
+            f"phase {self.name} class={self.fault_class} "
+            f"dur={self.duration:g} action={self.action} "
+            f"params({ps}) faults[{fs}]"
+        )
+
+
+def _p(**kw) -> Tuple[Tuple[str, object], ...]:
+    return tuple(sorted(kw.items()))
+
+
+@dataclass
+class DayPlan:
+    """An ordered production-day schedule (see module docstring)."""
+
+    seed: int
+    gear: str
+    phases: List[Phase] = field(default_factory=list)
+
+    def describe(self) -> str:
+        head = f"dayplan gear={self.gear} seed={self.seed}"
+        return "\n".join([head] + [p.describe() for p in self.phases])
+
+    def classes_planned(self) -> Tuple[str, ...]:
+        return tuple(
+            sorted({p.fault_class for p in self.phases if p.fault_class})
+        )
+
+    # ------------------------------------------------------------------
+    # builders
+    # ------------------------------------------------------------------
+    @staticmethod
+    def mini(seed: int, *, scale: float = 1.0) -> "DayPlan":
+        """The tier-1 mini-day.  ``scale`` shrinks durations, payload
+        and the restart sweep (the smoke gear uses ~0.4); every
+        disturbance class still fires at least once at any scale."""
+        rng = Random(seed)
+
+        def j(lo: float, hi: float) -> float:
+            # schedule jitter, rounded so describe() stays byte-stable
+            return round(rng.uniform(lo, hi), 3)
+
+        sc = max(0.2, float(scale))
+        restarts = 3 if sc >= 0.75 else 1
+        payload_mb = max(1, int(round(3 * sc)))
+        phases = [
+            Phase("warmup", duration=round(3.0 * sc, 3)),
+            Phase(
+                "rolling_restart",
+                fault_class="rolling_restart",
+                duration=round(1.0 * sc, 3),
+                action="rolling_restart",
+                params=_p(hosts=restarts, grace=j(0.3, 0.6)),
+            ),
+            Phase(
+                "leader_churn",
+                fault_class="leader_churn",
+                duration=round(1.5 * sc, 3),
+                action="",
+                faults=(
+                    Fault("leader_kill", at=j(0.1, 0.4),
+                          duration=j(0.8, 1.4), targets=(SH_MEM,)),
+                    Fault("leader_transfer", at=j(2.6, 3.2),
+                          targets=(SH_MEM,)),
+                ),
+            ),
+            Phase(
+                "stream_chaos",
+                fault_class="stream_chaos",
+                duration=round(1.0 * sc, 3),
+                action="catchup_chaos",
+                params=_p(
+                    payload_mb=payload_mb,
+                    cap_mb=4,
+                    kill_p=j(0.3, 0.5),
+                    stall_p=j(0.2, 0.4),
+                    stall_delay=j(0.005, 0.02),
+                ),
+            ),
+            Phase(
+                "drain",
+                fault_class="drain",
+                duration=round(1.0 * sc, 3),
+                action="drain",
+                params=_p(host="h3", to="h6", timeout=90.0),
+            ),
+            Phase(
+                "dr_cycle",
+                fault_class="dr_cycle",
+                duration=round(1.0 * sc, 3),
+                action="dr_cycle",
+                params=_p(shard=SH_MEM),
+            ),
+            Phase("cooldown", duration=round(2.0 * sc, 3)),
+        ]
+        return DayPlan(seed=seed, gear="mini", phases=phases)
+
+    @staticmethod
+    def full(
+        seed: int,
+        *,
+        hours: float = 1.0,
+        gb: Optional[bool] = None,
+    ) -> "DayPlan":
+        """The hours-long day: warmup, then repeated disturbance cycles
+        (churn -> stream chaos -> rolling restart -> alternating region
+        drain) with a DR cycle every third round, sized so the whole
+        schedule spans ~``hours``.  ``gb=None`` reads the
+        ``DRAGONBOAT_BIGSTATE_GB`` gate; at the GB tier the FIRST
+        stream-chaos phase carries a ~1 GiB on-disk payload behind an
+        8 MB/s cap (the capped-stream economics measured in
+        docs/BIGSTATE.md), later ones stay MB-scale so the day is churn-
+        bound, not transfer-bound."""
+        if gb is None:
+            from ..bigstate import gb_tier
+
+            gb = gb_tier()
+        rng = Random(seed)
+
+        def j(lo: float, hi: float) -> float:
+            return round(rng.uniform(lo, hi), 3)
+
+        # one cycle is ~5 min of scheduled day; steady traffic padding
+        # dominates, so cycles scale linearly with the requested hours
+        cycles = max(2, int(round(hours * 3600 / 300.0)))
+        phases: List[Phase] = [Phase("warmup", duration=20.0)]
+        for c in range(cycles):
+            drain_from, drain_to = (
+                ("h3", "h6") if c % 2 == 0 else ("h6", "h3")
+            )
+            payload_mb = 1024 if (gb and c == 0) else max(2, int(j(2, 6)))
+            cap_mb = 8 if (gb and c == 0) else 4
+            phases += [
+                Phase(
+                    f"c{c}/leader_churn",
+                    fault_class="leader_churn",
+                    duration=30.0,
+                    faults=(
+                        Fault("leader_kill", at=j(0.5, 2.0),
+                              duration=j(1.0, 2.5), targets=(SH_MEM,)),
+                        Fault("leader_transfer", at=j(6.0, 9.0),
+                              targets=(SH_MEM,)),
+                        Fault("member_cycle", at=j(10.0, 13.0),
+                              duration=j(1.0, 2.0), targets=(SH_MEM,)),
+                    ),
+                ),
+                Phase(
+                    f"c{c}/stream_chaos",
+                    fault_class="stream_chaos",
+                    duration=30.0,
+                    action="catchup_chaos",
+                    params=_p(
+                        payload_mb=payload_mb,
+                        cap_mb=cap_mb,
+                        kill_p=j(0.2, 0.5),
+                        stall_p=j(0.2, 0.4),
+                        stall_delay=j(0.005, 0.03),
+                    ),
+                ),
+                Phase(
+                    f"c{c}/rolling_restart",
+                    fault_class="rolling_restart",
+                    duration=30.0,
+                    action="rolling_restart",
+                    params=_p(hosts=3, grace=j(0.4, 0.9)),
+                ),
+                Phase(
+                    f"c{c}/drain",
+                    fault_class="drain",
+                    duration=30.0,
+                    action="drain",
+                    params=_p(host=drain_from, to=drain_to, timeout=300.0),
+                ),
+            ]
+            if c % 3 == 2:
+                phases.append(
+                    Phase(
+                        f"c{c}/dr_cycle",
+                        fault_class="dr_cycle",
+                        duration=30.0,
+                        action="dr_cycle",
+                        params=_p(shard=SH_MEM),
+                    )
+                )
+        # the mini gear guarantees every class once; the full gear must
+        # too even at tiny `hours` (cycles>=2 fires all but dr_cycle)
+        if not any(p.fault_class == "dr_cycle" for p in phases):
+            phases.append(
+                Phase(
+                    "final/dr_cycle",
+                    fault_class="dr_cycle",
+                    duration=30.0,
+                    action="dr_cycle",
+                    params=_p(shard=SH_MEM),
+                )
+            )
+        phases.append(Phase("cooldown", duration=15.0))
+        return DayPlan(seed=seed, gear="full", phases=phases)

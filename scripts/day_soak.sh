@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# The production-day soak, both gears (docs/SCENARIO.md):
+#
+#   scripts/day_soak.sh                       # mini gear: ~30-60s day,
+#                                             # every disturbance class
+#   DRAGONBOAT_SOAK_DAY=1 scripts/day_soak.sh # full gear: hours-long
+#                                             # (DRAGONBOAT_SOAK_HOURS,
+#                                             #  default 1.0)
+#   DRAGONBOAT_SOAK_DAY=1 DRAGONBOAT_BIGSTATE_GB=1 scripts/day_soak.sh
+#                                             # full gear, GB tier: the
+#                                             # first stream-chaos phase
+#                                             # carries ~1GiB of on-disk
+#                                             # state behind an 8MB/s cap
+#
+# Knobs: DRAGONBOAT_SOAK_SEED (default 0 mini / env for full) replays a
+# byte-identical schedule; the report JSON lands in /tmp/day_report.json
+# and the ledger table prints either way.  Exits non-zero unless the day
+# is green (all classes fired, audit green, zero SLA misses).
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import logging
+import os
+import sys
+
+logging.basicConfig(level=logging.WARNING)
+
+from dragonboat_tpu.scenario import DayPlan, ScenarioRunner
+
+seed = int(os.environ.get("DRAGONBOAT_SOAK_SEED", "0"))
+full = os.environ.get("DRAGONBOAT_SOAK_DAY", "0") not in ("", "0")
+if full:
+    hours = float(os.environ.get("DRAGONBOAT_SOAK_HOURS", "1.0"))
+    plan = DayPlan.full(seed, hours=hours)
+    print(f"day gear=full seed={seed} hours={hours} "
+          f"phases={len(plan.phases)}")
+else:
+    plan = DayPlan.mini(seed)
+    print(f"day gear=mini seed={seed} phases={len(plan.phases)}")
+
+r = ScenarioRunner(plan, tag="soak-day").run()
+print(r.format_table())
+r.to_json("/tmp/day_report.json")
+print("report: /tmp/day_report.json")
+if not r.ok:
+    print(f"DAY RED: aborted={r.aborted!r} violations={r.violations[:5]}")
+    if r.timeline:
+        print("--- flight-recorder timeline (tail) ---")
+        print("\n".join(r.timeline.splitlines()[-60:]))
+    sys.exit(1)
+print(f"DAY_SOAK_OK seed={seed} wall={r.wall_s:.1f}s")
+EOF

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Production-day scenario smoke (docs/SCENARIO.md): a tiny seeded
+# mini-day (scale 0.4) over the mixed on-disk/in-memory/witness fleet
+# under live gateway traffic.  Asserts
+#   1. every disturbance class fired at least once (rolling restart,
+#      leader churn, snapshot-stream kill/stall, region drain, DR
+#      export->import),
+#   2. zero Wing-Gong audit violations across the DR boundary,
+#   3. zero recovery-SLA misses (every recovery ran under
+#      assert_recovery_sla with its fault class),
+#   4. the DayReport ledger carries a throughput-dip entry per class.
+# ~10-15s — wired into tier1.sh as a post-step.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import logging
+
+logging.basicConfig(level=logging.ERROR)
+
+from dragonboat_tpu.scenario import DISTURBANCE_CLASSES, DayPlan, ScenarioRunner
+
+plan = DayPlan.mini(7, scale=0.4)
+r = ScenarioRunner(plan, tag="smoke-day").run()
+assert r.ok, (r.aborted, r.violations, r.audit)
+assert set(r.disturbances_fired) == set(DISTURBANCE_CLASSES), (
+    r.disturbances_fired
+)
+assert all(n >= 1 for n in r.disturbances_fired.values()), (
+    r.disturbances_fired
+)
+assert r.audit["ok"] and not r.violations
+assert all(c["violations"] == 0 for c in r.recovery.values()), r.recovery
+assert set(r.fault_dips) == set(DISTURBANCE_CLASSES), r.fault_dips
+print(
+    "SCENARIO_SMOKE_OK "
+    f"wall={r.wall_s:.1f}s baseline={r.baseline_committed_per_s:.0f}/s "
+    f"classes={len(r.disturbances_fired)} "
+    f"ops_ok={r.audit['ops'].get('ok', 0)} audit=green sla_misses=0"
+)
+EOF

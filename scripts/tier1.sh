@@ -15,7 +15,10 @@
 # smoke (scripts/multichip_smoke.sh,
 # ~60s warm: sharded kernel/round parity at 2/4/8 forced host
 # devices + the transfer-free jaxcheck gate over the sharded entry
-# points) and the static-analysis gates + analyzer
+# points), the production-day scenario smoke (scripts/scenario_smoke.sh,
+# ~10-15s: tiny seeded mini-day over the mixed on-disk/in-memory/witness
+# fleet — every disturbance class fired, audit green, zero SLA misses)
+# and the static-analysis gates + analyzer
 # self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
 # Prints
 # DOTS_PASSED=<n> and a TIER1_BUDGET runtime line against the 870s
@@ -37,5 +40,6 @@ timeout -k 10 120 bash scripts/bigstate_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/pipeline_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/updatelanes_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 240 bash scripts/multichip_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/scenario_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

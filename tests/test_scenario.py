@@ -1,0 +1,486 @@
+"""Production-day scenario orchestrator (dragonboat_tpu.scenario).
+
+Five layers:
+
+* plan determinism — two builds at one seed are byte-identical
+  schedules (the ``FaultPlan.describe()`` contract lifted to the day),
+  and the randomized nemesis plan's receiver-scoped stream pool keeps
+  sender-only schedules byte-identical;
+* witness/dummy x resume chaos (ROADMAP item 5 residual) — a
+  receiver-targeted kill/stall schedule strikes the catch-up streams of
+  a restarted witness host pair: the FULL replica's stream must RESUME
+  (receiver cursor > 0, ``stream_resumes`` >= 1) instead of restarting,
+  while the witness's DUMMY stream (one chunk, chunk_id 0, kills only
+  strike past chunk 0) completes despite the same kill window — proven
+  by the witness then holding up quorum;
+* recovery stats — ``assert_recovery_sla(fault_class=...)`` lands
+  every verdict in the process-wide ``RECOVERY_STATS`` aggregator;
+* phase sequencing/abort — a failing SLA stops the day, skips the
+  remaining phases and captures the flight-recorder timeline;
+* the mini-day acceptance run — every disturbance class fired over the
+  mixed on-disk/in-memory/witness fleet under live gateway traffic,
+  audit green, every recovery inside its SLA (the tier-1 gate for
+  "can it run a real day in production"; the hours-long gear is the
+  env-gated ``test_full_day_soak`` below, scripts/day_soak.sh).
+"""
+import os
+import shutil
+import time
+
+import pytest
+
+from dragonboat_tpu import (
+    Config,
+    EngineConfig,
+    ExpertConfig,
+    Fault,
+    FaultController,
+    FaultPlan,
+    NodeHost,
+    NodeHostConfig,
+    RECOVERY_STATS,
+    assert_recovery_sla,
+    settings,
+)
+from dragonboat_tpu.faults import STREAM_DST_PREFIX
+from dragonboat_tpu.scenario import (
+    DISTURBANCE_CLASSES,
+    DayPlan,
+    Phase,
+    ScenarioRunner,
+)
+from dragonboat_tpu.transport.inproc import reset_inproc_network
+
+from test_nodehost import KVStore, propose_r, set_cmd, wait_for_leader
+
+
+# ---------------------------------------------------------------------------
+# plan determinism
+# ---------------------------------------------------------------------------
+class TestPlanDeterminism:
+    def test_mini_plan_byte_identical_at_fixed_seed(self):
+        a = DayPlan.mini(42).describe()
+        b = DayPlan.mini(42).describe()
+        assert a == b
+        assert a != DayPlan.mini(43).describe()
+
+    def test_full_plan_byte_identical_and_scales_with_hours(self):
+        a = DayPlan.full(9, hours=0.5, gb=False)
+        b = DayPlan.full(9, hours=0.5, gb=False)
+        assert a.describe() == b.describe()
+        assert len(DayPlan.full(9, hours=2.0, gb=False).phases) > len(
+            a.phases
+        )
+
+    def test_every_disturbance_class_planned_in_both_gears(self):
+        for plan in (
+            DayPlan.mini(1),
+            DayPlan.mini(1, scale=0.4),
+            DayPlan.full(1, hours=0.1, gb=False),
+        ):
+            assert set(plan.classes_planned()) == set(DISTURBANCE_CLASSES), (
+                plan.gear, plan.classes_planned()
+            )
+
+    def test_gb_tier_changes_only_the_payload(self):
+        gb = DayPlan.full(5, hours=0.5, gb=True)
+        mb = DayPlan.full(5, hours=0.5, gb=False)
+        gbp = [p for p in gb.phases if p.action == "catchup_chaos"]
+        assert gbp and gbp[0].param("payload_mb") == 1024
+        assert gbp[0].param("cap_mb") == 8
+        # the schedule SHAPE is identical: same phases, same classes
+        assert [p.name for p in gb.phases] == [p.name for p in mb.phases]
+
+    def test_randomized_recv_pool_and_sender_only_compat(self):
+        # sender-only schedules are unchanged by the new kwarg's default
+        a = FaultPlan.randomized(
+            3, addrs=["x", "y"], stream_addrs=["x"], rounds=16
+        ).describe()
+        b = FaultPlan.randomized(
+            3, addrs=["x", "y"], stream_addrs=["x"], stream_recv_addrs=(),
+            rounds=16,
+        ).describe()
+        assert a == b
+        # receiver entries enter the pool as dst:-prefixed targets and
+        # the plan stays deterministic
+        c = FaultPlan.randomized(
+            3, addrs=["x", "y"], stream_recv_addrs=["w"], rounds=32
+        )
+        assert c.describe() == FaultPlan.randomized(
+            3, addrs=["x", "y"], stream_recv_addrs=["w"], rounds=32
+        ).describe()
+        stream_faults = [
+            f for f in c.faults
+            if f.kind.startswith("snapshot_stream_")
+        ]
+        assert stream_faults, "no stream faults drawn at rounds=32"
+        assert all(
+            t == STREAM_DST_PREFIX + "w"
+            for f in stream_faults for t in f.targets
+        )
+
+
+# ---------------------------------------------------------------------------
+# recovery stats (assert_recovery_sla fault_class plumbing)
+# ---------------------------------------------------------------------------
+class TestRecoveryStats:
+    ADDRS = {1: "rs-1", 2: "rs-2", 3: "rs-3"}
+
+    def _host(self, rid):
+        return NodeHost(NodeHostConfig(
+            nodehost_dir=f"/tmp/nh-rs-{rid}",
+            rtt_millisecond=2,
+            raft_address=self.ADDRS[rid],
+            expert=ExpertConfig(
+                engine=EngineConfig(exec_shards=1, apply_shards=1)
+            ),
+        ))
+
+    def test_sla_records_pass_and_violation_per_class(self):
+        reset_inproc_network()
+        for rid in self.ADDRS:
+            shutil.rmtree(f"/tmp/nh-rs-{rid}", ignore_errors=True)
+        nhs = {rid: self._host(rid) for rid in self.ADDRS}
+        RECOVERY_STATS.reset()
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(
+                    self.ADDRS, False, KVStore,
+                    Config(replica_id=rid, shard_id=1, election_rtt=10,
+                           heartbeat_rtt=1),
+                )
+            wait_for_leader(nhs)
+            assert_recovery_sla(
+                nhs, 1, sla_ticks=10_000, cmd=set_cmd("rs", b"1"),
+                fault_class="unit_pass",
+            )
+            snap = RECOVERY_STATS.snapshot()
+            assert snap["unit_pass"]["count"] == 1
+            assert snap["unit_pass"]["violations"] == 0
+            assert snap["unit_pass"]["min_margin_s"] > 0
+            # an impossible budget records a violation under its class
+            with pytest.raises(Exception):
+                assert_recovery_sla(
+                    nhs, 99, sla_ticks=1, fault_class="unit_fail"
+                )
+            snap = RECOVERY_STATS.snapshot()
+            assert snap["unit_fail"]["violations"] == 1
+            assert snap["unit_fail"]["min_margin_s"] <= 0
+        finally:
+            RECOVERY_STATS.reset()
+            for nh in nhs.values():
+                nh.close()
+
+
+# ---------------------------------------------------------------------------
+# witness/dummy x resume chaos (ROADMAP item 5 residual)
+# ---------------------------------------------------------------------------
+class TestWitnessStreamChaos:
+    """Voters {1,2} + witness 3 + non-voting 4 on an on-disk SM.  Kill
+    the witness and the non-voting host, advance + compact the log,
+    then restart BOTH under a receiver-scoped kill/stall schedule
+    (targets = ``dst:<their addrs>``): the non-voting's REAL stream is
+    killed mid-transfer and must RESUME (cursor > 0); the witness's
+    DUMMY stream is one chunk (chunk_id 0) and kills only strike past
+    chunk 0, so it completes inside the same kill window — afterwards
+    voter 1 + the witness alone must commit (a 2/3 voting quorum), the
+    proof the witness's catch-up finished rather than restarted into a
+    wedge."""
+
+    ADDRS = {1: "wsc-1", 2: "wsc-2", 3: "wsc-3", 4: "wsc-4"}
+
+    def _host(self, rid):
+        return NodeHost(NodeHostConfig(
+            nodehost_dir=f"/tmp/nh-wsc-{rid}",
+            rtt_millisecond=2,
+            raft_address=self.ADDRS[rid],
+            expert=ExpertConfig(
+                engine=EngineConfig(exec_shards=1, apply_shards=1)
+            ),
+        ))
+
+    def _cfg(self, rid):
+        return Config(
+            replica_id=rid, shard_id=1, election_rtt=20, heartbeat_rtt=2,
+            is_witness=(rid == 3), is_non_voting=(rid == 4),
+        )
+
+    def test_witness_dummy_immune_nonvoting_resumes(self):
+        from dragonboat_tpu.bigstate.ondisk import ondisk_kv_factory, put_cmd
+
+        saved = (
+            settings.Soft.snapshot_chunk_size,
+            settings.Soft.snapshot_stream_max_tries,
+        )
+        settings.Soft.snapshot_chunk_size = 128 * 1024
+        settings.Soft.snapshot_stream_max_tries = 10
+        reset_inproc_network()
+        for rid in self.ADDRS:
+            shutil.rmtree(f"/tmp/nh-wsc-{rid}", ignore_errors=True)
+        shutil.rmtree("/tmp/wsc-sm", ignore_errors=True)
+        fac = ondisk_kv_factory("/tmp/wsc-sm")
+        voters = {1: self.ADDRS[1], 2: self.ADDRS[2]}
+        nhs = {rid: self._host(rid) for rid in self.ADDRS}
+        ctl = FaultController(seed=5)
+        try:
+            for rid in (1, 2):
+                nhs[rid].start_replica(voters, False, fac, self._cfg(rid))
+                ctl.install_transport(nhs[rid].transport)
+            lid = wait_for_leader({r: nhs[r] for r in (1, 2)})
+            api = nhs[lid]
+
+            def retry(fn, deadline=15.0):
+                end = time.time() + deadline
+                while True:
+                    try:
+                        return fn()
+                    except Exception:
+                        if time.time() >= end:
+                            raise
+                        time.sleep(0.1)
+
+            retry(lambda: api.sync_request_add_witness(
+                1, 3, self.ADDRS[3], timeout=2.0))
+            retry(lambda: api.sync_request_add_non_voting(
+                1, 4, self.ADDRS[4], timeout=2.0))
+            for rid in (3, 4):
+                nhs[rid].start_replica({}, True, fac, self._cfg(rid))
+            s = api.get_noop_session(1)
+            propose_r(api, s, put_cmd(b"seed", b"x"))
+            # both tails fall behind a payload the leader compacts away
+            for rid in (3, 4):
+                nhs[rid].close()
+            val = b"\xa5" * (512 * 1024)
+            for i in range(6):
+                propose_r(api, s, put_cmd(b"big-%d" % i, val))
+            for rid in (1, 2):
+                nhs[rid].sync_request_snapshot(1, compaction_overhead=1)
+                nhs[rid].set_snapshot_send_rate(4 * 1024 * 1024)
+            # receiver-scoped chaos: every stream TO the witness or the
+            # non-voting, regardless of which voter leads/sends
+            targets = (
+                STREAM_DST_PREFIX + self.ADDRS[3],
+                STREAM_DST_PREFIX + self.ADDRS[4],
+            )
+            kill = Fault("snapshot_stream_kill", targets=targets, p=0.8)
+            stall = Fault(
+                "snapshot_stream_stall", targets=targets, p=0.4,
+                delay=0.01,
+            )
+            ctl.activate(kill)
+            ctl.activate(stall)
+            for rid in (3, 4):
+                nhs[rid] = self._host(rid)
+                nhs[rid].start_replica({}, True, fac, self._cfg(rid))
+            # heal the kill window after it demonstrably struck, so the
+            # RESUME (not endless retry) completes the transfer
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                if ctl.stats.get("stream_kills", 0) >= 1:
+                    ctl.deactivate(kill)
+                try:
+                    if nhs[4].stale_read(1, b"big-5") == val:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            ctl.deactivate(kill)
+            ctl.deactivate(stall)
+            assert nhs[4].stale_read(1, b"big-5") == val, (
+                f"non-voting never caught up: {ctl.stats}"
+            )
+            assert ctl.stats.get("stream_kills", 0) >= 1, ctl.stats
+            # the killed stream RESUMED from the receiver's cursor
+            # (stream_resumes only counts query_resume answers > 0)
+            resumes = sum(
+                nhs[r].transport.metrics["stream_resumes"] for r in (1, 2)
+            )
+            assert resumes >= 1, (ctl.stats, "kill did not resume")
+            # witness catch-up completed despite the kill window (its
+            # dummy stream is structurally immune): voter 1 + witness
+            # must form a live 2/3 voting quorum on their own
+            nhs[2].close()
+            retry(
+                lambda: propose_r(
+                    nhs[1], nhs[1].get_noop_session(1),
+                    put_cmd(b"wq", b"1"), deadline=20.0,
+                ),
+                deadline=30.0,
+            )
+            assert retry(
+                lambda: nhs[1].sync_read(1, b"wq", timeout=2.0)
+            ) == b"1"
+        finally:
+            ctl.stop()
+            for nh in nhs.values():
+                try:
+                    nh.close()
+                except Exception:
+                    pass
+            (
+                settings.Soft.snapshot_chunk_size,
+                settings.Soft.snapshot_stream_max_tries,
+            ) = saved
+
+
+# ---------------------------------------------------------------------------
+# churn member_cycle id-collision regression (found by the full-day run)
+# ---------------------------------------------------------------------------
+class TestMemberCycleIdCollision:
+    """The churn plane's throwaway member rid (70_000+seq) collided
+    with the balance executor's max(known ids)+1 allocation once a
+    churned id landed in `removed`: the add rejected and the HEAL then
+    removed a REAL voter another plane had just placed (caught by the
+    production-day full gear — cycle-1 member_cycle deleted cycle-0's
+    drain-created voter, wedging the shard).  The rid must now clear
+    every known id, and the heal must refuse to remove anything that
+    resolves to a voter/witness."""
+
+    ADDRS = {1: "mcid-1", 2: "mcid-2", 3: "mcid-3"}
+
+    def test_rid_clears_known_ids_and_heal_spares_real_members(self):
+        reset_inproc_network()
+        for rid in self.ADDRS:
+            shutil.rmtree(f"/tmp/nh-mcid-{rid}", ignore_errors=True)
+        nhs = {
+            rid: NodeHost(NodeHostConfig(
+                nodehost_dir=f"/tmp/nh-mcid-{rid}",
+                rtt_millisecond=2,
+                raft_address=addr,
+                expert=ExpertConfig(
+                    engine=EngineConfig(exec_shards=1, apply_shards=1)
+                ),
+            ))
+            for rid, addr in self.ADDRS.items()
+        }
+        ctl = FaultController(seed=2)
+        try:
+            for rid, nh in nhs.items():
+                nh.start_replica(
+                    self.ADDRS, False, KVStore,
+                    Config(replica_id=rid, shard_id=1, election_rtt=10,
+                           heartbeat_rtt=1),
+                )
+            lid = wait_for_leader(nhs)
+            # another plane already owns rid 70001 as a VOTER (never
+            # started — 3 live of 4 voters keeps quorum)
+            nhs[lid].sync_request_add_replica(
+                1, 70_001, "mcid-x", timeout=5.0
+            )
+            ctl.install_churn(lambda: nhs, shards=(1,))
+            f = Fault("member_cycle", targets=(1,))
+            ctl.activate(f)
+            adds = [e for e in ctl.churn_log if e[2] == "member_add"]
+            assert adds, ctl.churn_log
+            assert "rid=70002" in adds[0][3], adds
+            ctl.deactivate(f)
+            m = nhs[lid].get_shard_membership(1)
+            assert 70_001 in m.addresses, "heal removed a real voter"
+            assert 70_002 not in m.non_votings, "heal leaked its member"
+            # the remove guard itself: a heal pointed at a VOTER rid
+            # (the pre-fix collision shape) must refuse
+            ctl._churn_member_remove(Fault("member_cycle"), 1, 70_001)
+            assert any(
+                e[2] == "member_remove_skipped" for e in ctl.churn_log
+            ), ctl.churn_log
+            m = nhs[lid].get_shard_membership(1)
+            assert 70_001 in m.addresses
+        finally:
+            ctl.stop()
+            for nh in nhs.values():
+                nh.close()
+
+
+# ---------------------------------------------------------------------------
+# phase sequencing / abort
+# ---------------------------------------------------------------------------
+class TestPhaseAbort:
+    def test_failing_sla_stops_the_day_and_dumps_timeline(self):
+        # a ZERO-tick SLA budget: the deadline is already past when the
+        # coverage loop would start, so the first rolling restart
+        # violates DETERMINISTICALLY (a small-but-positive budget was
+        # timing-flaky on a warm box — review finding); the day must
+        # abort there, skip every later phase, and carry the
+        # flight-recorder dump
+        plan = DayPlan(seed=3, gear="mini", phases=[
+            Phase("warmup", duration=1.0),
+            Phase("rolling_restart", fault_class="rolling_restart",
+                  duration=0.5, action="rolling_restart",
+                  params=(("grace", 0.4), ("hosts", 1))),
+            Phase("never_reached", fault_class="drain", duration=0.5,
+                  action="drain", params=(("host", "h3"), ("to", "h6"))),
+        ])
+        r = ScenarioRunner(plan, tag="abrt", sla_ticks=0).run()
+        assert not r.ok
+        assert r.aborted == "rolling_restart"
+        assert any("rolling_restart" in v for v in r.violations), r.violations
+        # later phases were skipped: only warmup made it into the ledger
+        assert [p["name"] for p in r.phases] == ["warmup"]
+        assert r.timeline, "no flight-recorder timeline captured"
+        assert "day:phase" in r.timeline
+        snap = r.recovery
+        assert snap.get("rolling_restart", {}).get("violations", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# the mini-day acceptance run (the default-suite gate)
+# ---------------------------------------------------------------------------
+class TestMiniDay:
+    @pytest.mark.flaky_isolated
+    def test_mini_day_all_classes_audit_green(self):
+        """The ISSUE 14 acceptance gate: a seeded mini-day over the
+        mixed on-disk/in-memory/witness fleet under live gateway
+        traffic fires all five disturbance classes, every recovery
+        holds its SLA, the Wing-Gong audit is green across the DR
+        boundary, and the DayReport carries a throughput-dip entry per
+        fault class."""
+        r = ScenarioRunner(DayPlan.mini(11), tag="mday").run()
+        assert r.ok, (r.aborted, r.violations, r.audit)
+        # all five disturbance classes fired at least once
+        assert set(r.disturbances_fired) == set(DISTURBANCE_CLASSES)
+        assert all(n >= 1 for n in r.disturbances_fired.values())
+        # audit green over a real history spanning the DR boundary
+        assert r.audit["ok"]
+        assert r.audit["ops"]["ok"] > 200, r.audit
+        # every recovery ran under assert_recovery_sla and held
+        assert r.recovery, "no recoveries recorded"
+        assert all(
+            c["violations"] == 0 for c in r.recovery.values()
+        ), r.recovery
+        assert {"rolling_restart", "dr_cycle", "drain",
+                "stream_chaos"} <= set(r.recovery)
+        # the ledger: a throughput-dip entry per fault class, plus the
+        # phase rows the table renders from
+        assert set(r.fault_dips) == set(DISTURBANCE_CLASSES)
+        assert all(0 < d for d in r.fault_dips.values())
+        assert r.baseline_committed_per_s > 10
+        names = [p["name"] for p in r.phases]
+        assert names[0] == "warmup" and names[-1] == "cooldown"
+        # stream chaos really exercised the kill/resume plane
+        sc = next(p for p in r.phases if p["name"] == "stream_chaos")
+        if sc["stream_kills"]:
+            assert sc["stream_resumes"] >= 1, sc
+        # the JSON emit round-trips
+        import json
+
+        assert json.loads(r.to_json())["ok"] is True
+        assert "comm/s" in r.format_table()
+
+
+# ---------------------------------------------------------------------------
+# the full day (env-gated; scripts/day_soak.sh)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("DRAGONBOAT_SOAK_DAY", "0") in ("", "0"),
+    reason="set DRAGONBOAT_SOAK_DAY=1 (scripts/day_soak.sh) for the "
+    "hours-long production day",
+)
+def test_full_day_soak():
+    hours = float(os.environ.get("DRAGONBOAT_SOAK_HOURS", "1.0"))
+    seed = int(os.environ.get("DRAGONBOAT_SOAK_SEED", "0"))
+    plan = DayPlan.full(seed, hours=hours)
+    r = ScenarioRunner(plan, tag="fday").run()
+    print(r.format_table())
+    r.to_json("/tmp/day_report.json")
+    assert r.ok, (r.aborted, r.violations, r.audit)
