@@ -1640,7 +1640,35 @@ def phase_updatelanes(rows_list=None, reps: int = 3) -> dict:
         and t["apply_handoffs"][0] == t["apply_handoffs"][1]
         for t in tiers
     )
-    return {"tiers": tiers, "parity": ok}
+    # ---- batched apply-handoff micro-split (ISSUE 15 satellite) -----
+    # The per-row Task/cursor work above is identical either way; the
+    # r10 cut is the WAKEUP: one WorkReady condition-lock take per row
+    # vs one notify_all per partition per generation
+    # (engine._apply_lane_commits).  Measure the notify leg directly
+    # at a commit-wave-sized row count.
+    import time as _t
+
+    from dragonboat_tpu.engine.execengine import WorkReady
+
+    n_rows, parts = 10_000, 4
+    wr = WorkReady(parts)
+    t0 = _t.perf_counter()
+    for s in range(n_rows):
+        wr.notify(s)
+    per_row_s = _t.perf_counter() - t0
+    for p in range(parts):
+        wr._sets[p].clear()
+    t0 = _t.perf_counter()
+    wr.notify_all(range(n_rows))
+    batched_s = _t.perf_counter() - t0
+    handoff = {
+        "rows": n_rows,
+        "partitions": parts,
+        "per_row_notify_ms": round(per_row_s * 1000, 2),
+        "batched_notify_ms": round(batched_s * 1000, 2),
+        "speedup": round(per_row_s / max(batched_s, 1e-9), 1),
+    }
+    return {"tiers": tiers, "parity": ok, "handoff_notify": handoff}
 
 
 def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
@@ -1704,14 +1732,21 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
         int(x)
         for x in os.environ.get("BENCH_PIPELINE_DEPTHS", "1,2").split(",")
     ]
+    # fused commit waves (ISSUE 15): depth>=2 configs run the product
+    # default (K routed rounds per routable generation); depth-1
+    # configs stay fused_k=1 — the serial r6 loop, the ledger's
+    # baseline.  BENCH_FUSEDROUND=0 disables both the fusing and the
+    # no-fuse control config (the `fusedround` split under this
+    # phase's key); any other value is K.
+    fused_k = int(os.environ.get("BENCH_FUSEDROUND", "3") or 3)
     REPLICAS = 3
     workers_n = int(os.environ.get("BENCH_PIPELINE_WORKERS", "4"))
     inflight = int(os.environ.get("BENCH_PIPELINE_INFLIGHT", "8"))
     probe_secs = float(os.environ.get("BENCH_PIPELINE_PROBE_SECS", "4"))
     payload = b"x" * 16
 
-    def run_config(depth: int, floor_ms: float) -> dict:
-        tag = f"{depth}-{int(floor_ms)}"
+    def run_config(depth: int, floor_ms: float, fuse: int = 1) -> dict:
+        tag = f"{depth}-{int(floor_ms)}-{fuse}"
         ADDRS = {r: f"pipe-nh-{tag}-{r}" for r in range(1, REPLICAS + 1)}
         cap = 1
         while cap < SHARDS * REPLICAS:
@@ -1720,6 +1755,7 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
         group = ColocatedEngineGroup(
             capacity=cap, P=3, W=16, M=8, E=4, O=32, budget=4,
             pipeline_depth=depth, sync_floor_ms=floor_ms,
+            fused_rounds=fuse,
         )
         nhs = {}
         for rid, addr in ADDRS.items():
@@ -1736,7 +1772,8 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
                     ),
                 )
             )
-        out = {"depth": depth, "floor_ms": floor_ms, "shards": SHARDS}
+        out = {"depth": depth, "floor_ms": floor_ms, "shards": SHARDS,
+               "fused_k": fuse}
         sm_cls = _bench_sm_cls()
         # per-config parity delta: the module counter is cumulative
         # across the matrix's configs (review finding)
@@ -1819,19 +1856,28 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
             # measure routing, not the launch pipeline (phase_c's
             # fixed-target probe includes that cost; this one isolates
             # the propose->commit launch chain the floor model covers).
+            # The probing HOST follows leadership (whichever member
+            # leads the most shards) — the old fixed-nhs[1] probe fell
+            # into the forwarded mode whenever host 1 happened to lead
+            # nothing, which read as a 2-4x probe regression purely on
+            # leader placement (the r7 ledger's bimodal ranges).
             def _probe_targets():
-                nh = nhs[1]
-                led = [
-                    s for s in range(1, SHARDS + 1)
-                    if nh.is_leader_of(s)
-                ][:3]
-                return led or [1, max(1, SHARDS // 2), SHARDS]
+                by_host = {}
+                for s in range(1, SHARDS + 1):
+                    for rid, nh in nhs.items():
+                        if nh.is_leader_of(s):
+                            by_host.setdefault(rid, []).append(s)
+                            break
+                if not by_host:
+                    return 1, [1, max(1, SHARDS // 2), SHARDS]
+                rid = max(by_host, key=lambda r: len(by_host[r]))
+                return rid, by_host[rid][:3]
 
             probe_ms = []
 
             def prober():
-                nh = nhs[1]
-                targets = _probe_targets()
+                rid, targets = _probe_targets()
+                nh = nhs[rid]
                 sess = {s: nh.get_noop_session(s) for s in targets}
                 i = 0
                 while _time.time() < stop:
@@ -1872,8 +1918,8 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
             # number the sync-latency model predicts).
             quiet_ms = []
             qstop = _time.time() + probe_secs
-            nh1 = nhs[1]
-            qtargets = _probe_targets()
+            qrid, qtargets = _probe_targets()
+            nh1 = nhs[qrid]
             qsess = {s: nh1.get_noop_session(s) for s in qtargets}
             qi = 0
             while _time.time() < qstop:
@@ -1908,6 +1954,10 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
                 detail_skipped=st.get("detail_skipped", 0),
                 fences=st.get("pipeline_fences", 0),
                 sel_fallbacks=st.get("sel_fallbacks", 0),
+                fused_waves=st.get("fused_waves", 0),
+                fused_rounds_stepped=st.get("fused_rounds_stepped", 0),
+                fused_fences=st.get("fused_fences", 0),
+                readback_windows=st.get("readback_windows", 0),
                 parity_failures=hostplane.PARITY_FAILURE_COUNT - parity0,
             )
         finally:
@@ -1924,16 +1974,50 @@ def phase_pipeline(jax, SHARDS: int = None, duration: float = None) -> dict:
     }
     for floor in floors:
         for depth in depths:
+            # depth 1 = the serial r6 baseline (never fused);
+            # depth >= 2 = the product pipeline with fused waves
+            fuse = 1 if depth == 1 else max(1, fused_k)
             try:
-                report["configs"].append(run_config(depth, floor))
+                report["configs"].append(run_config(depth, floor, fuse))
             except Exception as e:  # noqa: BLE001 — record, keep going
                 report["configs"].append(
-                    {"depth": depth, "floor_ms": floor, "error": str(e)}
+                    {"depth": depth, "floor_ms": floor, "fused_k": fuse,
+                     "error": str(e)}
                 )
     by = {
         (c.get("depth"), c.get("floor_ms")): c for c in report["configs"]
     }
     fmax = max(floors)
+    # ---- the fusedround split (ISSUE 15) ----------------------------
+    # One no-fuse CONTROL config at the headline point (depth 2, the
+    # highest floor) isolates the fusion win from the pipeline win:
+    # fused-vs-control probe ratio is the 3-rounds-to-1-launch
+    # collapse, and one_readback_per_wave pins the budget.
+    if fused_k > 1 and 2 in depths:
+        try:
+            control = run_config(2, fmax, 1)
+        except Exception as e:  # noqa: BLE001
+            control = {"error": str(e)}
+        fused_cfg = by.get((2, fmax), {})
+        split = {
+            "floor_ms": fmax, "fused_k": fused_k,
+            "fused": fused_cfg, "control_nofuse": control,
+            "one_readback_per_wave": bool(
+                fused_cfg.get("fused_waves", 0) > 0
+                and fused_cfg.get("readback_windows", 0)
+                <= fused_cfg.get("launches", 0)
+                + fused_cfg.get("sel_fallbacks", 0)
+            ),
+        }
+        for key, name in (
+            ("probe_p50_ms", "probe_p50_fused_vs_nofuse"),
+            ("probe_unloaded_p50_ms",
+             "probe_unloaded_p50_fused_vs_nofuse"),
+            ("committed_per_sec", "committed_fused_vs_nofuse"),
+        ):
+            if fused_cfg.get(key) and control.get(key):
+                split[name] = round(fused_cfg[key] / control[key], 2)
+        report["fusedround"] = split
     s = by.get((1, fmax))
     headline = {}
     for depth in depths:
@@ -3218,5 +3302,13 @@ if __name__ == "__main__":
         # standalone update-lane run: `python bench.py phase_updatelanes`
         # (host-only numpy; BENCH_UPDATELANES_HEAVY=1 adds 50k/250k)
         print("BENCHUL " + json.dumps(phase_updatelanes()), flush=True)
+    elif "phase_pipeline" in _sys.argv[1:]:
+        # standalone launch-pipeline run: `python bench.py
+        # phase_pipeline` — the floor × depth × fused-K matrix plus the
+        # fusedround split (BENCH_PIPELINE_* / BENCH_FUSEDROUND knobs,
+        # docs/BENCH_NOTES_r10.md)
+        import jax
+
+        print("BENCHPP " + json.dumps(phase_pipeline(jax)), flush=True)
     else:
         main()

@@ -201,6 +201,10 @@ class ExecEngine:
         # a stale workReady entry for this shard id can step it immediately
         node.notify_work = lambda s=node.shard_id: self.step_ready.notify(s)
         node.engine_apply_ready = lambda s: self.apply_ready.notify(s)
+        # the WorkReady itself, for the batched per-SM-worker commit
+        # handoff (ops/engine._apply_lane_commits): one notify_all per
+        # partition per generation instead of one lock take per row
+        node.apply_work_ready = self.apply_ready
         with self._nodes_lock:
             self._nodes[node.shard_id] = node
         self.step_ready.notify(node.shard_id)

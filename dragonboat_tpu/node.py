@@ -129,6 +129,7 @@ class Node:
         "_snapshotting",
         "_applied_since_snapshot", "_retired_snapshots", "_apply_lock",
         "_sm_close_lock", "notify_work", "engine_apply_ready",
+        "apply_work_ready",
         "log_reader", "sm", "_stop_event", "peer", "quiesce",
         "wake", "parked_at_tick", "tracer", "_trace_spans",
     )
@@ -284,6 +285,11 @@ class Node:
         # set by the engine at registration; wakes the owning step worker
         self.notify_work: Optional[Callable[[], None]] = None
         self.engine_apply_ready: Optional[Callable[[int], None]] = None
+        # the apply workers' WorkReady itself (also set at registration):
+        # the batched per-SM-worker commit handoff groups wakeups by
+        # partition through it (engine._apply_lane_commits) instead of
+        # taking the partition lock once per row
+        self.apply_work_ready = None
 
         # --- storage views ----------------------------------------------
         bootstrap = logdb.get_bootstrap_info(config.shard_id, config.replica_id)

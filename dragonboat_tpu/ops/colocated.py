@@ -147,6 +147,17 @@ _PIPE_DEPTH_DEFAULT = int(
 _SYNC_FLOOR_MS_DEFAULT = float(
     _os.environ.get("DRAGONBOAT_TPU_SYNC_FLOOR_MS", "0") or 0
 )
+# DRAGONBOAT_TPU_FUSED_ROUNDS: how many consecutive consensus rounds a
+# routable generation chains device-side before its ONE readback (the
+# fused commit wave, ISSUE 15).  3 (the default) is one full
+# propose -> replicate/ack -> commit/deliver sequence: a quiet-path
+# proposal commits in one launch + one readback instead of three of
+# each, breaking the ~0.52x 3-round probe asymptote the double-buffered
+# pipeline alone is bounded by (docs/BENCH_NOTES_r07.md).  1 disables
+# fusing (the PR 11 single-round launch loop, bit for bit).
+_FUSED_ROUNDS_DEFAULT = int(
+    _os.environ.get("DRAGONBOAT_TPU_FUSED_ROUNDS", "3") or 3
+)
 
 # fast-lane invalidation margin: re-validate a row's int32 headroom via
 # the full plan well before the hard 2^31 ceiling (margin >> M*E and
@@ -444,28 +455,37 @@ class _InFlightGen:
     not the interleaved stream) plus the device handles the exact
     two-sync fallback gather reads.  ``merged``/``out`` pin the
     generation's buffers alive until its merge runs; with depth 2 that
-    is the ISSUE's "two in-flight state handles"."""
+    is the ISSUE's "two in-flight state handles".
+
+    A FUSED generation (``rounds > 1``, ISSUE 15) carries one entry
+    per round in ``merged``/``out``/``head_dev``/``detail_dev``: the
+    wave dispatched K rounds back-to-back with every round's (head,
+    detail) D2H copy requested at dispatch, so the whole wave's blobs
+    ride the tunnel in ONE latency-floor window and the merge tail
+    unpacks them round by round."""
 
     __slots__ = (
         "batch", "staging", "alive_np", "batch_gs", "prop_gs", "caps",
         "merged", "out", "head_dev", "detail_dev", "t_req", "tick_fed",
+        "rounds",
     )
 
     def __init__(self, *, batch, staging, alive_np, batch_gs, prop_gs,
                  caps, merged, out, head_dev, detail_dev, t_req,
-                 tick_fed=None):
+                 tick_fed=None, rounds=1):
         self.batch = batch
         self.staging = staging
         self.alive_np = alive_np
         self.batch_gs = batch_gs
         self.prop_gs = prop_gs
         self.caps = caps
-        self.merged = merged
-        self.out = out
-        self.head_dev = head_dev
-        self.detail_dev = detail_dev
+        self.merged = merged          # per-round list of state handles
+        self.out = out                # per-round list of DeviceOut
+        self.head_dev = head_dev      # per-round list of head blobs
+        self.detail_dev = detail_dev  # per-round list of detail blobs
         self.t_req = t_req
         self.tick_fed = tick_fed or {}
+        self.rounds = rounds
 
 
 class ColocatedVectorEngine(VectorStepEngine):
@@ -478,7 +498,8 @@ class ColocatedVectorEngine(VectorStepEngine):
                  W: int = 32, M: int = 8, E: int = 4, O: int = 32,
                  rebase_chunk: int = 1 << 30, device=None, mesh=None,
                  pipeline_depth: Optional[int] = None,
-                 sync_floor_ms: Optional[float] = None):
+                 sync_floor_ms: Optional[float] = None,
+                 fused_rounds: Optional[int] = None):
         self.budget = budget
         self._pending: Optional[Inbox] = None
         self._pending_live = False  # last route delivered > 0 messages
@@ -552,6 +573,18 @@ class ColocatedVectorEngine(VectorStepEngine):
             if sync_floor_ms is not None
             else _SYNC_FLOOR_MS_DEFAULT
         ) / 1000.0
+        # fused commit waves (ISSUE 15): K consecutive routed rounds
+        # chained device-side per routable generation — propose ->
+        # commit in one launch + one readback.  Non-routable
+        # generations (membership mutation in sight, escalation holds,
+        # save quarantine, stopping rows) fence to the single-round
+        # path, extending the PR 11 pipeline fence argument unchanged.
+        self._fuse_rounds = max(
+            1,
+            fused_rounds
+            if fused_rounds is not None
+            else _FUSED_ROUNDS_DEFAULT,
+        )
         # deferred membership actions discovered mid-completion
         # (escalation replays, snapshot-below / save-failure evictions,
         # demotes): they mutate membership, so they run only once the
@@ -594,6 +627,14 @@ class ColocatedVectorEngine(VectorStepEngine):
             # floor-shim wait actually paid at collect time
             pipeline_overlap_s=0.0, pipeline_fences=0,
             early_completions=0, t_sync_wait_ms=0.0,
+            # fused commit waves (ISSUE 15): waves dispatched, rounds
+            # stepped inside them, single-round fences (a routable-work
+            # generation that could NOT fuse), and readback windows —
+            # ONE per completed generation regardless of its round
+            # count (plus one per exact-gather fallback round), the
+            # counter proving one readback per fused wave
+            fused_waves=0, fused_rounds_stepped=0, fused_fences=0,
+            readback_windows=0,
         )
 
     def _compute_base(self, r) -> int:
@@ -895,6 +936,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         st = self._state
         host = self._put_rows(make_inbox(G, self.M, E))
         combo = self._put_rows(jnp.zeros((G, 4), jnp.int32))
+        # persistent all-zero combo: rounds >= 2 of a fused wave build
+        # their (empty) host inbox region from it ON DEVICE — ticks and
+        # host slots are fed exactly once, in round 1 (never donated,
+        # so one handle serves every wave)
+        self._zero_combo = combo
         dest = self._put_rows(jnp.full((G, P), -1, I32))
         rank = self._put_rows(jnp.zeros((G, P), I32))
         # warm the REAL launch signature: host inbox built on device
@@ -1224,6 +1270,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         vanish TOGETHER for every colocated row (one shared device
         state), which is raft-safe message loss.  Rows re-upload from
         scratch on their next step."""
+        # keep the one-readback identity (readback_windows + in-flight
+        # == launches + sel_fallbacks, the fused-round smoke's gate) an
+        # invariant across resets: the discarded generations' windows
+        # will never be collected, so account them here
+        self.stats["readback_windows"] += len(self._inflight)
         self._inflight.clear()
         self._pending_live = False
         self._flush_free_pending()
@@ -1251,6 +1302,47 @@ class ColocatedVectorEngine(VectorStepEngine):
             # owning worker may be asked to stop while we'd be queued
             # behind another member's multi-second launch)
             return
+        # floor pre-wait: never hold the core lock just to wait out a
+        # readback's latency floor.  Two shapes paid the floor IN the
+        # lock and stalled every other worker's fresh proposal behind
+        # ~a full floor (measured: the unloaded probe sat at ~2
+        # floors): (a) the poke-driven idle drain (no node has work —
+        # the call exists only to merge the tail generation) blocking
+        # on the oldest collect, and (b) the dispatch room check with
+        # the pipe FULL, blocking on the oldest collect before a new
+        # generation may launch.  Both waits are for the SAME event —
+        # the oldest in-flight readback reaching its floor — so sleep
+        # it out here in small slices with the lock free: an idle call
+        # aborts the moment any of its nodes gains real work (it can
+        # then dispatch), a full-pipe call waits regardless (it needs
+        # the room anyway).  Racy peeks of the in-flight deque are
+        # benign — the in-lock paths re-check everything.
+        if self._sync_floor_s > 0 and self._inflight:
+            import time as _time
+
+            # bounded at ONE floor from entry: under multi-worker
+            # contention the oldest in-flight keeps getting fresher
+            # (another worker merges + redispatches), and an unbounded
+            # re-wait could starve this worker's nodes — past the
+            # bound it falls into the lock and blocks there exactly as
+            # before (correctness never depended on the pre-wait)
+            _cap = _time.monotonic() + self._sync_floor_s
+            while _time.monotonic() < _cap:
+                if not self._inflight:
+                    break
+                try:
+                    t_req = self._inflight[0].t_req  # racy peek
+                except IndexError:
+                    break
+                rem = t_req + self._sync_floor_s - _time.monotonic()
+                if rem <= 0:
+                    break
+                if (
+                    len(self._inflight) < self._pipeline_depth
+                    and any(n.has_work() for n in nodes)
+                ):
+                    break
+                _time.sleep(min(rem, 0.002))
         with self._lock:
             self._step_colocated(nodes, worker_id)
 
@@ -1399,14 +1491,14 @@ class ColocatedVectorEngine(VectorStepEngine):
 
                 if _t.monotonic() - rec.t_req < self._sync_floor_s:
                     break
-            # BOTH blobs must have landed: the merge may read the
-            # detail payload too, and blocking the core lock on a
-            # still-in-flight transfer is exactly the stall this
-            # non-blocking pass exists to avoid (review finding)
+            # EVERY round's blobs must have landed: the merge may read
+            # any round's detail payload too, and blocking the core
+            # lock on a still-in-flight transfer is exactly the stall
+            # this non-blocking pass exists to avoid (review finding)
             if any(
                 (ir := getattr(dev, "is_ready", None)) is not None
                 and not ir()
-                for dev in (rec.head_dev, rec.detail_dev)
+                for dev in (*rec.head_dev, *rec.detail_dev)
             ):
                 break
             ripe.extend(self._complete_oldest())
@@ -1901,7 +1993,11 @@ class ColocatedVectorEngine(VectorStepEngine):
         # each readback stays in flight across a full pipeline's worth
         # of host work — completing right after dispatch (the naive
         # order) gave every readback only ONE cycle of overlap and
-        # left half the floor exposed on the 1-core bench
+        # left half the floor exposed on the 1-core bench.  (An
+        # "express" +1 slot for proposal-carrying waves was tried and
+        # REVERTED: exceeding the depth makes the next dispatch drain
+        # TWO generations, the second still mid-floor — a systematic
+        # in-lock stall that measured worse than the wait it removed.)
         while len(self._inflight) >= self._pipeline_depth:
             room_updates = self._complete_oldest()
             if room_updates:
@@ -1968,6 +2064,38 @@ class ColocatedVectorEngine(VectorStepEngine):
         )
         # raftlint: ignore[sync-budget] host-built index array, not a device readback
         prop_gs = np.asarray(prop_rows, np.int64)
+        # ---- fused commit wave decision (ISSUE 15) ------------------
+        # Chain K rounds device-side only when the generation's pending
+        # work is ROUTABLE: there is multi-round work to do (proposals
+        # riding this launch, or routed traffic already in flight whose
+        # delivery spawns responses) and nothing in sight mutates
+        # membership — stopping rows, deferred actions, quarantined
+        # saves, quarantined row slots and escalation holds all fence
+        # to the single-round path, which keeps the PR 11 detach-race
+        # argument at its proven <=1-launch exposure (a K-round wave
+        # would widen it to K).  Tick-only generations with an idle
+        # route stay single-round: rounds 2..K would step an empty
+        # inbox for every row.
+        rounds = 1
+        if self._fuse_rounds > 1 and (len(prop_gs) or self._pending_live):
+            # multi-round work exists; fuse unless a fence condition
+            # holds.  fused_fences counts ONLY this shape — routable
+            # work forced single-round — so the stat carries fence
+            # signal instead of drowning in idle tick generations
+            # (review finding)
+            if (
+                not gen_stopping
+                and not self._deferred
+                and not self._free_pending
+                and not self._save_quarantine
+                and not self._lanes.esc_hold.any()
+            ):
+                rounds = self._fuse_rounds
+                self.stats["fused_waves"] += 1
+                self.stats["fused_rounds_stepped"] += rounds
+                _metrics.counter("fused_waves_total").add(1)
+            else:
+                self.stats["fused_fences"] += 1
         combo_np[:, _C_ALIVE] = alive_np
         combo_np[batch_gs, _C_BATCH] = 1
         combo_np[prop_gs, _C_PROP] = 1
@@ -2077,23 +2205,63 @@ class ColocatedVectorEngine(VectorStepEngine):
         try:
             with annotate("raft-colocated-select"):
                 _t1 = _time.perf_counter()
-                # the launch's one commit-proving readback, requested
-                # NOW and collected at merge time: flags + delivered +
-                # counts + row ids + vals in the head, heavy sections
-                # in the detail (see _select_and_blob) — both D2H
-                # copies ride the tunnel while the host assembles and
-                # dispatches the NEXT generation
+                # the wave's one commit-proving readback, requested NOW
+                # and collected at merge time: flags + delivered +
+                # counts + row ids + vals in each round's head, heavy
+                # sections in its detail (see _select_and_blob).  Every
+                # round's pair is requested at dispatch, so the whole
+                # wave's blobs ride the tunnel in ONE latency-floor
+                # window while the host assembles and dispatches the
+                # NEXT generation.
                 caps = self._tier_caps(self._sel_tier)
-                head_dev, detail_dev = _select_and_blob(
-                    merged, out, stats_dev, packed_dev, flags_dev,
-                    combo, CAP_B=caps["b"], CAP_SL=caps["sl"],
-                    CAP_N=caps["n"], CAP_A=caps["a"],
-                    CAP_S=caps["s"], HOST_OFF=P * B,
-                )
-                for dev in (head_dev, detail_dev):
-                    fn = getattr(dev, "copy_to_host_async", None)
-                    if fn is not None:
-                        fn()
+                merged_l, out_l = [merged], [out]
+                head_l, detail_l = [], []
+
+                def _sel(merged_k, out_k, stats_k, packed_k, flags_k):
+                    head_dev, detail_dev = _select_and_blob(
+                        merged_k, out_k, stats_k, packed_k, flags_k,
+                        combo, CAP_B=caps["b"], CAP_SL=caps["sl"],
+                        CAP_N=caps["n"], CAP_A=caps["a"],
+                        CAP_S=caps["s"], HOST_OFF=P * B,
+                    )
+                    for dev in (head_dev, detail_dev):
+                        fn = getattr(dev, "copy_to_host_async", None)
+                        if fn is not None:
+                            fn()
+                    head_l.append(head_dev)
+                    detail_l.append(detail_dev)
+
+                _sel(merged, out, stats_dev, packed_dev, flags_dev)
+                # ---- fused wave: rounds 2..K, dispatched back-to-back
+                # with NO host sync between rounds.  Each round is the
+                # exact single-round program chain (assemble over the
+                # previous round's routed regions with an EMPTY host
+                # inbox — ticks and proposals fed once, in round 1 —
+                # then step, route, select), so a K-round wave is
+                # bit-exact with K serial launches by construction and
+                # reuses the warmed executables: no new XLA programs,
+                # no tier recompiles (the r5 compile-time finding rules
+                # out a monolithic K-round mega-program here).
+                for _k in range(1, rounds):
+                    host_k = _host_inbox_from_ticks(
+                        self._zero_combo, M=M, E=E
+                    )
+                    new_k, out_k = _assemble_and_step(
+                        self._state, host_k, self._pending, combo,
+                        out_capacity=self.O,
+                    )
+                    merged_k, regions_k, stats_k, packed_k, flags_k = (
+                        _route_step(
+                            self._state, new_k, out_k, self._dest_dev,
+                            self._rank_dev, combo, PB=P * B, E=E,
+                            budget=B,
+                        )
+                    )
+                    self._pending = regions_k
+                    self._state = merged_k
+                    merged_l.append(merged_k)
+                    out_l.append(out_k)
+                    _sel(merged_k, out_k, stats_k, packed_k, flags_k)
                 self.stats["t_dev_sel_ms"] = self.stats.get(
                     "t_dev_sel_ms", 0
                 ) + int((_time.perf_counter() - _t1) * 1000)
@@ -2102,61 +2270,34 @@ class ColocatedVectorEngine(VectorStepEngine):
             raise
         self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
         self.stats["launches"] += 1
-        self.stats["device_steps"] += 1
+        self.stats["device_steps"] += rounds
         self.stats["device_rows_stepped"] += len(batch)
         if _DEBUG_LAUNCH:
             import sys as _sys
 
             print(
                 f"[launch {self.stats['launches']}] tier="
-                f"{self._sel_tier} batch={len(batch)} "
+                f"{self._sel_tier} batch={len(batch)} rounds={rounds} "
                 f"inflight={len(self._inflight) + 1}",
                 file=_sys.stderr, flush=True,
             )
         self._inflight.append(_InFlightGen(
             batch=batch, staging=staging, alive_np=alive_np,
             batch_gs=batch_gs, prop_gs=prop_gs, caps=caps,
-            merged=merged, out=out, head_dev=head_dev,
-            detail_dev=detail_dev, t_req=_time.monotonic(),
-            tick_fed=tick_fed,
+            merged=merged_l, out=out_l, head_dev=head_l,
+            detail_dev=detail_l, t_req=_time.monotonic(),
+            tick_fed=tick_fed, rounds=rounds,
         ))
 
-    def _complete_generation(self, rec: _InFlightGen) -> List[Tuple]:  # sync-hot
-        """Merge one in-flight generation: collect the head (the
-        earliest commit-proving sync), complete commit-only rows from
-        it immediately, then collect the detail payload (already in
-        flight since dispatch) for the append/message merge tail.
-        Caller holds the core lock; generations complete in dispatch
-        order (_complete_oldest)."""
-        import time as _time
-
-        G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
-        batch, staging, caps = rec.batch, rec.staging, rec.caps
-        alive_np, batch_gs, prop_gs = (
-            rec.alive_np, rec.batch_gs, rec.prop_gs
-        )
-        nw = (self.O + 31) // 32
-        _t0 = _time.perf_counter()
-        _tc = _time.monotonic()
-        head = self._collect_blob(rec.head_dev, rec.t_req)
-        if self._pipeline_depth > 1:
-            # host-side work done between the D2H request (dispatch)
-            # and this collect ran concurrently with the readback —
-            # the double-buffering win, visible without hardware
-            overlap = max(0.0, _tc - rec.t_req)
-            if self._sync_floor_s > 0:
-                overlap = min(overlap, self._sync_floor_s)
-            self.stats["pipeline_overlap_s"] += overlap
-            _metrics.counter("pipeline_overlap_seconds_total").add(overlap)
-        self.stats["t_dev_blob_ms"] = self.stats.get(
-            "t_dev_blob_ms", 0
-        ) + int((_time.perf_counter() - _t0) * 1000)
-        self.stats["t_device_ms"] += int((_time.perf_counter() - _t0) * 1000)
+    def _parse_head(self, head, caps, G: int, nw: int):  # sync-hot
+        """Host-side parse of one round's head blob (_select_and_blob's
+        head layout): flags, packed delivered bits, route stats, the
+        five section counts, the five selected-row-id sections and the
+        values block."""
         flags = head[:G]
         delivered_bits = (
             head[G:G + G * nw].view(np.uint32).reshape(G, nw)
         )  # [G, ceil(O/32)] u32
-        self._behind = (flags & _F_PEERS_BEHIND) != 0
         _parse = [G + G * nw]
 
         def take(n, shape=None):
@@ -2166,84 +2307,496 @@ class ColocatedVectorEngine(VectorStepEngine):
 
         rstats = take(6)
         sel_counts = take(5)
-        sel_rows_buf = take(caps["b"])
-        sel_rows_slot = take(caps["sl"])
-        sel_rows_need = take(caps["n"])
-        sel_rows_append = take(caps["a"])
-        sel_rows_sum = take(caps["s"])
-        sel_vals = take(caps["s"] * N_VALS, (caps["s"], N_VALS))
-        self._pending_live = int(rstats[0]) > 0
-        self.stats["routed_delivered"] += int(rstats[0])
-        self.stats["routed_host_carried"] += int(rstats[5])
-        self.stats["routed_dropped"] += int(rstats[1] + rstats[2] + rstats[3])
-        # per-cause breakdown (RouteStats order; r4 verdict weak #5:
-        # the aggregate hid which drop class dominates at scale)
-        self.stats["routed_dropped_off_device"] = self.stats.get(
-            "routed_dropped_off_device", 0
-        ) + int(rstats[1])
-        self.stats["routed_dropped_budget"] = self.stats.get(
-            "routed_dropped_budget", 0
-        ) + int(rstats[2])
-        self.stats["routed_dropped_ring"] = self.stats.get(
-            "routed_dropped_ring", 0
-        ) + int(rstats[3])
-
-        # ---- merge row sets (array-at-once) --------------------------
-        # ONE vectorized pass over the [G] flags word classifies every
-        # row of the launch (ops/hostplane.py): escalations, live rows,
-        # and the buf/append/need/slot/sum sets that used to be per-row
-        # list/dict comprehensions over the whole meta table (~1-2 µs a
-        # row — the dominant share of t_updates at 250k rows, r5
-        # ledger).  The scalar twins remain the parity oracle
-        # (DRAGONBOAT_TPU_HOSTPLANE_PARITY runs both every launch).
-        sets = hostplane.build_merge_sets(
-            flags, alive_np, batch_gs, prop_gs, G=G
+        sel_rows = (
+            take(caps["b"]), take(caps["sl"]), take(caps["n"]),
+            take(caps["a"]), take(caps["s"]),
         )
-        hostplane.record_generation(flags, alive_np, batch_gs, prop_gs, G)
-        if hostplane.PARITY:
-            hostplane.check_merge_parity(
-                flags, alive_np, batch_gs, prop_gs, sets, G=G
+        sel_vals = take(caps["s"] * N_VALS, (caps["s"], N_VALS))
+        return flags, delivered_bits, rstats, sel_counts, sel_rows, sel_vals
+
+    def _parse_detail(self, det, caps):  # sync-hot
+        """Host-side parse of one round's detail blob, re-padding the
+        routed-region slot columns the device omitted (always unused
+        for slot bookkeeping — forwarded PROPOSE never rides the
+        routed regions)."""
+        O, W, M, E = self.O, self.W, self.M, self.E
+        PB = self.P * self.budget
+        _dp = [0]
+
+        def dtake(n, shape):
+            part = det[_dp[0]:_dp[0] + n]
+            _dp[0] += n
+            return part.reshape(shape)
+
+        buf_np = dtake(
+            caps["b"] * O * N_FIELDS_BUF, (caps["b"], O, N_FIELDS_BUF)
+        )
+        sel_slot_base = dtake(caps["sl"] * M, (caps["sl"], M))
+        sel_slot_term = dtake(caps["sl"] * M, (caps["sl"], M))
+        sel_ent_drop = dtake(caps["sl"] * M * E, (caps["sl"], M, E))
+        need_np = dtake(caps["n"] * self.P, (caps["n"], self.P))
+        ring_t = dtake(caps["a"] * W, (caps["a"], W))
+        ring_c = dtake(caps["a"] * W, (caps["a"], W))
+        slot_base = np.concatenate([
+            np.full((caps["sl"], PB), SLOT_UNUSED_I, np.int32),
+            sel_slot_base,
+        ], axis=1)
+        slot_term = np.concatenate([
+            np.zeros((caps["sl"], PB), np.int32), sel_slot_term
+        ], axis=1)
+        ent_drop = np.concatenate([
+            np.zeros((caps["sl"], PB, E), np.int32), sel_ent_drop
+        ], axis=1)
+        return (buf_np, slot_base, slot_term, ent_drop, need_np,
+                ring_t, ring_c)
+
+    def _merge_intermediate_round(  # sync-hot
+        self, rec, rnd, caps, sets, flags, delivered_bits, sel_counts,
+        sel_rows, sel_vals, needs_max, touched, esc_seen,
+    ) -> None:
+        """Merge ONE intermediate round of a fused wave, in two legs:
+
+        * HEAVY rows (appends, host-visible outbox bytes, round-1
+          proposal slots, snapshot-needing rows) take the per-row
+          merge: scalar sync from THIS round's values, append
+          reconstruction against THIS round's ring (entries published
+          to the shard cache round-by-round so a receiver's round k+1
+          reconstructs exactly as across k+1 serial launches), message
+          attachment against THIS round's delivered bits.  Their ONE
+          get_update rides the final round (``touched``).  The
+          snapshot-need SECTION itself is final-round-only — the need
+          flag re-fires while the condition persists (benign refire) —
+          but need-flagged rows still sync state here.
+        * every other row of the round's values block takes the LANE
+          pass — the same ``_lane_commit_pass`` a single-round
+          generation runs.  This is load-bearing, not an optimization:
+          the flags word's F_CHANGED is a per-ROUND delta, so a commit
+          advance or granted vote landing in an intermediate round is
+          INVISIBLE to the final round's flags — only the lane diff
+          (new words vs last HOST sync) sees it.  Skipping this leg
+          stranded mid-wave commits' futures forever (found by the
+          one-readback test's first soak)."""
+        import time as _time
+
+        G = self.capacity
+        n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d = (
+            int(x) for x in sel_counts
+        )
+        for key, need in (
+            ("b", max(n_buf_d, len(sets.buf_rows))),
+            ("sl", max(n_slot_d, len(sets.slot_rows))),
+            ("n", max(n_need_d, len(sets.need_rows))),
+            ("a", max(n_append_d, len(sets.append_rows))),
+            ("s", max(n_sum_d, len(sets.sum_rows))),
+        ):
+            needs_max[key] = max(needs_max[key], need)
+        slot_live = len(sets.slot_rows) if rnd == 0 else 0
+        has_heavy = bool(
+            len(sets.buf_rows) or len(sets.append_rows) or slot_live
+        )
+        if not has_heavy and not len(sets.sum_rows):
+            # nothing host-visible happened this round: its detail
+            # payload is never read (same contract as a pure
+            # commit/tick generation)
+            self.stats["detail_skipped"] = self.stats.get(
+                "detail_skipped", 0
+            ) + 1
+            return
+        _t0 = _time.perf_counter()
+        cover = self._sel_cover(
+            G, caps,
+            (n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d),
+            sel_rows, sets,
+        )
+        if cover is not None:
+            pos_buf, pos_slot, pos_need, pos_ring, pos_sum, _src = cover
+            vals_np = sel_vals[:n_sum_d]
+            if has_heavy:
+                det = self._collect_blob(rec.detail_dev[rnd], rec.t_req)
+                (buf_np, slot_base, slot_term, ent_drop, _need_np,
+                 ring_t, ring_c) = self._parse_detail(det, caps)
+            else:
+                buf_np = slot_base = slot_term = ent_drop = None
+                ring_t = ring_c = None
+                self.stats["detail_skipped"] = self.stats.get(
+                    "detail_skipped", 0
+                ) + 1
+        else:
+            # exact host-side selection for this round (capacity
+            # overflow): one extra sync round trip, charged one fresh
+            # floor — identical to the single-round fallback
+            self.stats["sel_fallbacks"] = (
+                self.stats.get("sel_fallbacks", 0) + 1
             )
+            self.stats["readback_windows"] += 1
+            idx4 = _build_idx4(
+                sets.buf_rows.tolist(), sets.slot_rows.tolist(),
+                sets.need_rows.tolist(), sets.append_rows.tolist(),
+            )
+            _tq = _time.monotonic()
+            detail, vals_np = _fetch_detail_vals(
+                rec.merged[rnd], rec.out[rnd], idx4,
+                sets.sum_rows.tolist(), self._put, self.O,
+                self.M + self.P * self.budget, self.E, self.P, self.W,
+                allow_fused=False,
+            )
+            self._floor_wait(_tq)
+            if detail is not None:
+                (buf_np, slot_base, slot_term, ent_drop, _need_np,
+                 ring_t, ring_c) = detail
+            else:
+                buf_np = slot_base = slot_term = ent_drop = None
+                ring_t = ring_c = None
+            pos_buf = hostplane.pos_of(G, sets.buf_rows)
+            pos_ring = hostplane.pos_of(G, sets.append_rows)
+            pos_slot = hostplane.pos_of(G, sets.slot_rows)
+            pos_need = hostplane.pos_of(G, sets.need_rows)
+            pos_sum = hostplane.pos_of(G, sets.sum_rows)
+        from .engine import SLOT_DROPPED
 
-        # ---- escalations: DEFERRED to the pipeline drain -------------
-        # The device already restored escalated rows (suppress mask in
-        # _route_step) and suppressed their outboxes, so there are no
-        # gen-N effects to merge; but a LATER in-flight generation may
-        # have re-stepped them from the restored state with delivered
-        # acks, so the recovery (evict + scalar replay of this
-        # generation's inputs) runs only at depth 0 — after every such
-        # generation has merged (see _apply_escalation).
-        updates: List[Tuple] = []
-        n_esc = len(sets.esc_batch_pos) + len(sets.esc_other)
-        if n_esc:
-            self.stats["escalations"] += n_esc
-            for i in sets.esc_batch_pos.tolist():
-                node, g, si, _plan = batch[i]
-                self._deferred.append(("esc", node, g, si))
-            for g in sets.esc_other.tolist():
-                meta = self._meta.get(g)
+        stage_map = rec.staging if rnd == 0 else {}
+        vals_l = vals_np.tolist() if vals_np is not None else None
+        heavy_gs = set(sets.buf_rows.tolist())
+        heavy_gs.update(sets.append_rows.tolist())
+        # need-flagged rows sync state here (their SECTION waits for
+        # the final round — benign refire); without this a
+        # need-only row's mid-wave state change would strand like any
+        # other non-final F_CHANGED
+        heavy_gs.update(sets.need_rows.tolist())
+        if rnd == 0:
+            heavy_gs.update(sets.slot_rows.tolist())
+        for g in sorted(heavy_gs):
+            meta = self._meta.get(g)
+            if meta is None or meta.node.stopped or vals_l is None:
+                continue
+            node = meta.node
+            r = node.peer.raft
+            base = int(self._base[g])
+            k = int(pos_sum[g])
+            if k < 0:
+                continue  # heavy rows always carry values; defense
+            sv = vals_l[k]
+            term, vote, committed, leader, role, last = sv[:6]
+            committed += base
+            last += base
+            # scalar sync BEFORE the merge — same order as the final
+            # round's loop (see the noop-barrier note there)
+            r.term, r.vote, r.leader_id = term, vote, leader
+            r.role = _ROLE_OF[role]
+            if (flags[g] & _F_APPEND) and int(pos_ring[g]) >= 0:
+                try:
+                    stamped = self._merge_appends(
+                        r, g, int(sv[_R_APPEND_LO]) + base, last,
+                        stage_map.get(g, {}),
+                        int(pos_slot[g]) if rnd == 0 else -1,
+                        slot_base, slot_term, ent_drop,
+                        ring_t[int(pos_ring[g])],
+                        ring_c[int(pos_ring[g])],
+                        fallback=self._cache_lookup,
+                        barrier=(
+                            int(sv[_R_BARRIER_IDX]) + base,
+                            int(sv[_R_BARRIER_TERM]),
+                        ),
+                        base=base,
+                    )
+                except RuntimeError:
+                    od = self._entry_cache.get(r.shard_id)
+                    _log.critical(
+                        "[%d:%d] routed append reconstruction failed "
+                        "in fused round %d; halting replica (cache "
+                        "keys tail: %s)",
+                        r.shard_id, r.replica_id, rnd,
+                        list(od.keys())[-12:] if od else [],
+                        exc_info=True,
+                    )
+                    self._halt_replica(g)
+                    continue
+                self._cache_put(r.shard_id, stamped)
+            if committed > r.log.committed:
+                r.log.commit_to(committed)
+            if (
+                role != int(RaftRole.LEADER)
+                and node.device_reads.has_pending()
+            ):
+                node.drop_device_reads()
+            if int(pos_buf[g]) >= 0 and buf_np is not None:
+                bits = delivered_bits[g]
+                dr = (
+                    (bits[self._dw_word] >> self._dw_shift) & 1
+                ).astype(bool)
+                self._attach_messages(
+                    r, node, buf_np[int(pos_buf[g])], int(sv[_R_COUNT]),
+                    stage_map.get(g, {}), delivered_row=dr, base=base,
+                )
+            sk = int(pos_slot[g]) if rnd == 0 else -1
+            if sk >= 0 and slot_base is not None:
+                sb = slot_base[sk]
+                drop = ent_drop[sk]
+                for slot, ents in stage_map.get(g, {}).items():
+                    if sb[slot] == SLOT_DROPPED:
+                        r.dropped_entries.extend(ents)
+                    elif sb[slot] >= 0:
+                        r.dropped_entries.extend(
+                            e for i_e, e in enumerate(ents)
+                            if drop[slot, i_e]
+                        )
+            touched[g] = node
+        # ---- lane leg: every OTHER row with values this round --------
+        # The same lane commit pass a single-round generation runs —
+        # heavy rows fall out of its eligibility mask by construction
+        # (append flag / buf / slot / need positions), rows already
+        # deferred to escalation recovery are excluded, and rows it
+        # syncs update the lanes so the NEXT round's diff composes.
+        if vals_np is not None and len(sets.sum_rows):
+            live_k: List[Tuple] = [
+                (node, g, si)
+                for node, g, si, _plan in rec.batch
+                if g not in esc_seen
+            ]
+            live_set = {g for _, g, _ in live_k}
+            meta_get = self._meta.get
+            for g in sets.live_other.tolist():
+                if g in esc_seen or g in live_set:
+                    continue
+                meta = meta_get(g)
                 if meta is not None:
-                    # routed-only inputs: discarded (raft-safe to lose)
-                    self._deferred.append(("esc", meta.node, g, None))
+                    live_k.append((meta.node, g, None))
+            pos_slot_k = (
+                pos_slot if rnd == 0
+                else hostplane.pos_of(G, sets.slot_rows)
+            )
+            self._lane_commit_pass(
+                live_k, flags, pos_sum, pos_buf, pos_slot_k, pos_need,
+                vals_np, np.zeros((len(live_k),), bool),
+            )
+            # bulk mirror + update-lane write for the round's sum rows
+            # — the final round's bulk write only covers rows flagged
+            # in the FINAL round, and F_CHANGED is a per-round delta:
+            # without this, a leader elected mid-wave left a
+            # permanently stale leader=0 mirror, which blocked quiesce
+            # parking on the whole shard (found by test_scale's
+            # cold-kill gate).  Lane-pass rows were already written —
+            # identical values, idempotent; heavy rows sync here.
+            gs_sum = sets.sum_rows
+            sum_pos = pos_sum[gs_sum]
+            ok = sum_pos >= 0
+            if ok.any():
+                gs_ok = gs_sum[ok].astype(np.int64)
+                w = vals_np[sum_pos[ok], :6].T
+                # lease arm/disarm on role transitions observed THIS
+                # round, probed against the PRE-write mirror — the
+                # final _lease_pass compares against the mirror too,
+                # and this write is about to refresh it, so a mid-wave
+                # election win would otherwise never arm its
+                # CheckQuorum lease (found by
+                # test_device_lease_reads_colocated: a resident leader
+                # whose win landed inside a wave held lease 0 forever)
+                chg = np.nonzero(
+                    w[_R_ROLE] != self._mirror[_R_ROLE, gs_ok]
+                )[0]
+                for i in chg.tolist():
+                    g2 = int(gs_ok[i])
+                    meta2 = self._meta.get(g2)
+                    if meta2 is None or meta2.node.stopped:
+                        continue
+                    r2 = meta2.node.peer.raft
+                    if (
+                        int(w[_R_ROLE, i]) == _ROLE_LEADER_I
+                        and r2.check_quorum
+                    ):
+                        self._lease.arm(g2, r2.election_timeout, 0)
+                    else:
+                        self._lease.disarm(g2)
+                self._mirror[:6, gs_ok] = w
+                w_abs = w.astype(np.int64)
+                b_abs = self._base[gs_ok]
+                w_abs[_R_COMMIT] += b_abs
+                w_abs[_R_LAST] += b_abs
+                self._ulanes.words[:, gs_ok] = w_abs
+        self.stats["t_updates_ms"] += int(
+            (_time.perf_counter() - _t0) * 1000
+        )
 
+    def _complete_generation(self, rec: _InFlightGen) -> List[Tuple]:  # sync-hot
+        """Merge one in-flight generation: collect each round's head
+        (the earliest commit-proving sync), complete commit-only rows
+        straight off the FINAL round's head, and read detail payloads
+        (all in flight since dispatch) only for rounds with heavy
+        sections.  A fused wave (rec.rounds > 1, ISSUE 15) unpacks its
+        per-round delivered bits and heavy sections round by round —
+        intermediate rounds merge appends/outboxes/round-1 slots into
+        the scalar rafts, the final round runs the full single-round
+        tail (lease, bookkeeping, lane commit pass, get_update) over
+        the wave's end state, so every row emits at most ONE update
+        per wave.  Caller holds the core lock; generations complete in
+        dispatch order (_complete_oldest)."""
+        import time as _time
+
+        G, M, E, P, B = self.capacity, self.M, self.E, self.P, self.budget
+        batch, staging, caps = rec.batch, rec.staging, rec.caps
+        alive_np, batch_gs, prop_gs = (
+            rec.alive_np, rec.batch_gs, rec.prop_gs
+        )
+        K = rec.rounds
+        nw = (self.O + 31) // 32
+        updates: List[Tuple] = []
+        esc_seen: set = set()
+        # rows whose scalar state an intermediate round already
+        # mutated: they owe ONE get_update at the end of the wave even
+        # if the final round left them quiet
+        touched: Dict[int, object] = {}
+        needs_max = {"b": 0, "sl": 0, "n": 0, "a": 0, "s": 0}
+        empty_gs = np.zeros((0,), np.int64)
+        # ONE readback window per generation: every round's blobs were
+        # requested together at dispatch and share rec.t_req, so the
+        # first collect pays the floor remainder and the rest land in
+        # the same round trip — the one-readback-per-wave budget the
+        # fused-round smoke asserts
+        self.stats["readback_windows"] += 1
+        for rnd in range(K):
+            final = rnd == K - 1
+            round_props = prop_gs if rnd == 0 else empty_gs
+            _t0 = _time.perf_counter()
+            _tc = _time.monotonic()
+            head = self._collect_blob(rec.head_dev[rnd], rec.t_req)
+            if rnd == 0 and self._pipeline_depth > 1:
+                # host-side work done between the D2H request
+                # (dispatch) and this collect ran concurrently with
+                # the readback — the double-buffering win, visible
+                # without hardware
+                overlap = max(0.0, _tc - rec.t_req)
+                if self._sync_floor_s > 0:
+                    overlap = min(overlap, self._sync_floor_s)
+                self.stats["pipeline_overlap_s"] += overlap
+                _metrics.counter(
+                    "pipeline_overlap_seconds_total"
+                ).add(overlap)
+            self.stats["t_dev_blob_ms"] = self.stats.get(
+                "t_dev_blob_ms", 0
+            ) + int((_time.perf_counter() - _t0) * 1000)
+            self.stats["t_device_ms"] += int(
+                (_time.perf_counter() - _t0) * 1000
+            )
+            (flags, delivered_bits, rstats, sel_counts, sel_rows,
+             sel_vals) = self._parse_head(head, caps, G, nw)
+            (sel_rows_buf, sel_rows_slot, sel_rows_need,
+             sel_rows_append, sel_rows_sum) = sel_rows
+            if final:
+                self._behind = (flags & _F_PEERS_BEHIND) != 0
+                self._pending_live = int(rstats[0]) > 0
+            self.stats["routed_delivered"] += int(rstats[0])
+            self.stats["routed_host_carried"] += int(rstats[5])
+            self.stats["routed_dropped"] += int(
+                rstats[1] + rstats[2] + rstats[3]
+            )
+            # per-cause breakdown (RouteStats order; r4 verdict weak
+            # #5: the aggregate hid which drop class dominates)
+            self.stats["routed_dropped_off_device"] = self.stats.get(
+                "routed_dropped_off_device", 0
+            ) + int(rstats[1])
+            self.stats["routed_dropped_budget"] = self.stats.get(
+                "routed_dropped_budget", 0
+            ) + int(rstats[2])
+            self.stats["routed_dropped_ring"] = self.stats.get(
+                "routed_dropped_ring", 0
+            ) + int(rstats[3])
+
+            # ---- merge row sets (array-at-once) ----------------------
+            # ONE vectorized pass over the [G] flags word classifies
+            # every row of the round (ops/hostplane.py).  The scalar
+            # twins remain the parity oracle
+            # (DRAGONBOAT_TPU_HOSTPLANE_PARITY runs both every round).
+            sets = hostplane.build_merge_sets(
+                flags, alive_np, batch_gs, round_props, G=G
+            )
+            hostplane.record_generation(
+                flags, alive_np, batch_gs, round_props, G
+            )
+            if hostplane.PARITY:
+                hostplane.check_merge_parity(
+                    flags, alive_np, batch_gs, round_props, sets, G=G
+                )
+
+            # ---- escalations: DEFERRED to the pipeline drain ---------
+            # The device already restored escalated rows (suppress mask
+            # in _route_step) and suppressed their outboxes; later
+            # rounds/generations re-stepped them from the restored
+            # state, so the recovery (evict + scalar replay) runs only
+            # at depth 0 (see _apply_escalation).  A wave records each
+            # escalated row ONCE: the batch inputs are replayed only
+            # when round 1 suppressed them — a row escalating first in
+            # a LATER round consumed its inputs in round 1, so only
+            # the routed-only (input-less) recovery applies, exactly
+            # the cross-generation contract.
+            n_esc = len(sets.esc_batch_pos) + len(sets.esc_other)
+            if n_esc:
+                self.stats["escalations"] += n_esc
+                for i in sets.esc_batch_pos.tolist():
+                    node, g, si, _plan = batch[i]
+                    if g in esc_seen:
+                        continue
+                    esc_seen.add(g)
+                    self._deferred.append(
+                        ("esc", node, g, si if rnd == 0 else None)
+                    )
+                for g in sets.esc_other.tolist():
+                    if g in esc_seen:
+                        continue
+                    meta = self._meta.get(g)
+                    if meta is not None:
+                        esc_seen.add(g)
+                        # routed-only inputs: discarded (raft-safe)
+                        self._deferred.append(("esc", meta.node, g, None))
+
+            if not final:
+                self._merge_intermediate_round(
+                    rec, rnd, caps, sets, flags, delivered_bits,
+                    sel_counts, sel_rows, sel_vals, needs_max, touched,
+                    esc_seen,
+                )
+                continue
+
+            # ================= FINAL round ===========================
+            break  # fall through to the final-round tail below
+
+        stage_map = staging if K == 1 else {}
+        rnd = K - 1
         # ---- live rows: batch rows + any resident row with effects ----
         esc_keep = np.ones((len(batch),), bool)
-        esc_keep[sets.esc_batch_pos] = False
+        # every batch row whose device row escalated in ANY round of
+        # the wave is excluded from the final merge (its recovery is
+        # the deferred evict+replay above)
+        esc_keep[[
+            i for i, (_n, g, _s, _p) in enumerate(batch)
+            if g in esc_seen
+        ]] = False
         live: List[Tuple] = [
             (node, g, si)
             for (node, g, si, plan), k in zip(batch, esc_keep.tolist())
             if k
         ]
+        live_gs = {g for _, g, _ in live}
         for g in sets.live_other.tolist():
             meta = self._meta.get(g)
             if meta is not None:
                 live.append((meta.node, g, None))
+                live_gs.add(g)
+        # rows an intermediate round touched that the final round left
+        # quiet still owe their get_update (merged appends/messages
+        # must persist and dispatch)
+        for g, node in touched.items():
+            if g not in live_gs and g not in esc_seen:
+                live.append((node, g, None))
+                live_gs.add(g)
 
         buf_rows = sets.buf_rows
         append_rows = sets.append_rows
         slot_rows = sets.slot_rows
         need_rows = sets.need_rows
         sum_rows = sets.sum_rows
+        n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d = (
+            int(x) for x in sel_counts
+        )
         _t0 = _time.perf_counter()
         # device-selected detail (the split-blob fast path): the head
         # already carries counts/row-ids/vals for the rows the DEVICE
@@ -2253,9 +2806,6 @@ class ColocatedVectorEngine(VectorStepEngine):
         # missed).  Coverage and row->gather-position maps are index
         # arrays (hostplane.pos_of/covered) — the old per-row dict
         # builds and `all(g in …)` membership scans were O(rows) Python
-        n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d = (
-            int(x) for x in sel_counts
-        )
         cover = self._sel_cover(
             G, caps,
             (n_buf_d, n_slot_d, n_need_d, n_append_d, n_sum_d),
@@ -2264,10 +2814,18 @@ class ColocatedVectorEngine(VectorStepEngine):
             sets,
         )
         dev_ok = cover is not None
-        early_done = np.zeros((len(batch) + len(sets.live_other),), bool)
+        early_done = np.zeros((len(live),), bool)
         lease_done = False
         if dev_ok:
             pos_buf, pos_slot, pos_need, pos_ring, pos_sum, sum_src = cover
+            if K > 1:
+                # the DEVICE's slot selection keys off the wave-wide
+                # prop mask (combo rides every round), but host slot
+                # bookkeeping is round-1-only and round 1's
+                # intermediate merge already consumed it — the final
+                # round's host semantics (empty slot set) rule, or the
+                # loop would index slot sections it never collected
+                pos_slot = hostplane.pos_of(G, slot_rows)
             # live rows only: the padded capacity tail is garbage the
             # merge loop never indexes, and converting it cost tens of
             # ms/launch at storm-tier capacities (review finding)
@@ -2298,43 +2856,9 @@ class ColocatedVectorEngine(VectorStepEngine):
                 or len(slot_rows) or len(need_rows)
             )
             if need_detail:
-                det = self._collect_blob(rec.detail_dev, rec.t_req)
-                O, W = self.O, self.W
-                _dp = [0]
-
-                def dtake(n, shape):
-                    part = det[_dp[0]:_dp[0] + n]
-                    _dp[0] += n
-                    return part.reshape(shape)
-
-                buf_np = dtake(
-                    caps["b"] * O * N_FIELDS_BUF,
-                    (caps["b"], O, N_FIELDS_BUF),
-                )
-                # slot sections carry HOST-region columns only (see
-                # _select_and_blob); re-pad the routed-region prefix
-                # the device omitted: those columns are ALWAYS unused
-                # for slot bookkeeping (forwarded PROPOSE never rides
-                # the routed regions)
-                sel_slot_base = dtake(caps["sl"] * M, (caps["sl"], M))
-                sel_slot_term = dtake(caps["sl"] * M, (caps["sl"], M))
-                sel_ent_drop = dtake(
-                    caps["sl"] * M * E, (caps["sl"], M, E)
-                )
-                need_np = dtake(caps["n"] * P, (caps["n"], P))
-                ring_t = dtake(caps["a"] * W, (caps["a"], W))
-                ring_c = dtake(caps["a"] * W, (caps["a"], W))
-                PB = P * B
-                slot_base = np.concatenate([
-                    np.full((caps["sl"], PB), SLOT_UNUSED_I, np.int32),
-                    sel_slot_base,
-                ], axis=1)
-                slot_term = np.concatenate([
-                    np.zeros((caps["sl"], PB), np.int32), sel_slot_term
-                ], axis=1)
-                ent_drop = np.concatenate([
-                    np.zeros((caps["sl"], PB, E), np.int32), sel_ent_drop
-                ], axis=1)
+                det = self._collect_blob(rec.detail_dev[rnd], rec.t_req)
+                (buf_np, slot_base, slot_term, ent_drop, need_np,
+                 ring_t, ring_c) = self._parse_detail(det, caps)
             else:
                 # pure commit/tick generation: the detail payload is
                 # never read — on hardware its bytes still rode the
@@ -2351,6 +2875,7 @@ class ColocatedVectorEngine(VectorStepEngine):
             self.stats["sel_fallbacks"] = (
                 self.stats.get("sel_fallbacks", 0) + 1
             )
+            self.stats["readback_windows"] += 1
             idx4 = _build_idx4(
                 buf_rows.tolist(), slot_rows.tolist(),
                 need_rows.tolist(), append_rows.tolist(),
@@ -2359,7 +2884,8 @@ class ColocatedVectorEngine(VectorStepEngine):
             # the kernel ran on the ASSEMBLED inbox (host slots + routed
             # regions), so the out slot arrays are M + P*B wide
             detail, vals_np = _fetch_detail_vals(
-                rec.merged, rec.out, idx4, sum_rows.tolist(), self._put,
+                rec.merged[rnd], rec.out[rnd], idx4, sum_rows.tolist(),
+                self._put,
                 self.O, M + P * B, E, P, self.W, allow_fused=False,
             )
             self._floor_wait(_tq)
@@ -2378,15 +2904,16 @@ class ColocatedVectorEngine(VectorStepEngine):
             pos_sum = hostplane.pos_of(G, sum_rows)
             sum_src = sum_rows
         # tier selection: promote immediately to the smallest warmed
-        # tier that fits this launch's needs (overflow used the exact
-        # fallback above, once); demote only after 64 consecutive
-        # launches that would have fit the lower tier
+        # tier that fits this generation's needs — the max over EVERY
+        # round of the wave (overflow used the exact fallback above,
+        # once per overflowing round); demote only after 64
+        # consecutive launches that would have fit the lower tier
         needs = {
-            "b": max(n_buf_d, len(buf_rows)),
-            "sl": max(n_slot_d, len(slot_rows)),
-            "n": max(n_need_d, len(need_rows)),
-            "a": max(n_append_d, len(append_rows)),
-            "s": max(n_sum_d, len(sum_rows)),
+            "b": max(needs_max["b"], n_buf_d, len(buf_rows)),
+            "sl": max(needs_max["sl"], n_slot_d, len(slot_rows)),
+            "n": max(needs_max["n"], n_need_d, len(need_rows)),
+            "a": max(needs_max["a"], n_append_d, len(append_rows)),
+            "s": max(needs_max["s"], n_sum_d, len(sum_rows)),
         }
         need_tier = len(_SEL_TIERS) - 1
         for t in range(len(_SEL_TIERS)):
@@ -2524,7 +3051,19 @@ class ColocatedVectorEngine(VectorStepEngine):
             # (tick bookkeeping already ran in _bookkeeping_pass)
             k = sum_k_l[j]
             if k < 0:
-                # no flags, no slots: the row only ticked
+                # no final-round flags, no slots — but a row an
+                # intermediate round of the wave touched (merged
+                # appends, attached messages, dropped slots) still
+                # owes its ONE wave-end update: the scalar sync ran in
+                # its last heavy round, so only the emission remains
+                if g in touched:
+                    u = node.peer.get_update(
+                        last_applied=node.sm.last_applied
+                    )
+                    node.dispatch_dropped(u)
+                    updates.append((node, u))
+                    node._check_leader_change()
+                # else: the row only ticked
                 continue
             sv = vals_l[k]
             term, vote, committed, leader, role, last = sv[:6]
@@ -2540,7 +3079,7 @@ class ColocatedVectorEngine(VectorStepEngine):
                 try:
                     stamped = self._merge_appends(
                         r, g, int(sv[_R_APPEND_LO]) + base, last,
-                        staging.get(g, {}), slot_k_l[j], slot_base,
+                        stage_map.get(g, {}), slot_k_l[j], slot_base,
                         slot_term, ent_drop, ring_t[ring_k_l[j]],
                         ring_c[ring_k_l[j]],
                         fallback=self._cache_lookup,
@@ -2575,14 +3114,14 @@ class ColocatedVectorEngine(VectorStepEngine):
             if buf_k_l[j] >= 0:
                 self._attach_messages(
                     r, node, buf_np[buf_k_l[j]], int(sv[_R_COUNT]),
-                    staging.get(g, {}), delivered_row=dr_pack[dr_at_l[j]],
+                    stage_map.get(g, {}), delivered_row=dr_pack[dr_at_l[j]],
                     base=base,
                 )
             sk = slot_k_l[j]
             if sk >= 0:
                 sb = slot_base[sk]
                 drop = ent_drop[sk]
-                for slot, ents in staging.get(g, {}).items():
+                for slot, ents in stage_map.get(g, {}).items():
                     if sb[slot] == SLOT_DROPPED:
                         r.dropped_entries.extend(ents)
                     elif sb[slot] >= 0:

@@ -506,7 +506,7 @@ def _plan_lane_words(  # hostplane-hot
     return uplan
 
 
-def _apply_lane_commit(node, ce) -> None:
+def _apply_lane_commit(node, ce, notify: bool = True) -> None:
     """The lane rows' post-save apply handoff — one definition for the
     slot-batched and list-fallback persist paths (both MUST run it
     only after the row's save landed: persist-before-apply,
@@ -515,7 +515,10 @@ def _apply_lane_commit(node, ce) -> None:
     in-mem GC: ``applied_log_to`` slices the entry list (O(live
     entries)) every call, so sweep once per ~32 applied entries
     instead of per commit — bounded residency (<=32 applied entries
-    linger), 32x fewer slices on the commit-wave path."""
+    linger), 32x fewer slices on the commit-wave path.
+
+    ``notify=False`` defers the apply-worker wakeup to the caller —
+    the batched per-SM-worker handoff (:func:`_apply_lane_commits`)."""
     if node._trace_spans:
         node._trace_committed(ce)
     node.sm.task_queue.add(Task(type=TaskType.ENTRIES, entries=ce))
@@ -524,8 +527,40 @@ def _apply_lane_commit(node, ce) -> None:
     im = log.inmem
     if log.processed - im.marker >= 32:
         im.applied_log_to(log.processed)
-    if node.engine_apply_ready is not None:
+    if notify and node.engine_apply_ready is not None:
         node.engine_apply_ready(node.shard_id)
+
+
+def _apply_lane_commits(handoffs) -> None:
+    """BATCHED apply handoff per SM worker per generation (ROADMAP
+    item 1's named next cut for the commit-wave split): enqueue every
+    commit row's Task/cursor-advance, then wake each apply-worker
+    partition ONCE via ``WorkReady.notify_all`` instead of per row.
+
+    The per-row ``engine_apply_ready`` closure takes its partition's
+    condition lock on every call — at a commit wave touching thousands
+    of rows that is thousands of interleaved lock acquisitions against
+    the very apply workers the wakeups target.  ``notify_all`` groups
+    the shard ids by partition host-side and takes each partition's
+    lock exactly once per generation.  Nodes registered before the
+    batched hook existed (``apply_work_ready`` is None — bespoke
+    engines, tests driving nodes directly) keep the per-row path.
+
+    ``handoffs`` is ``[(node, committed-entries)]`` for rows whose
+    batched save ALREADY landed — the persist-before-apply order is
+    the caller's contract, unchanged."""
+    by_wr: Dict[int, Tuple] = {}
+    for node, ce in handoffs:
+        _apply_lane_commit(node, ce, notify=False)
+        # getattr: bespoke node doubles (bench twins, direct-drive
+        # tests) predate the hook and keep the per-row path
+        wr = getattr(node, "apply_work_ready", None)
+        if wr is not None:
+            by_wr.setdefault(id(wr), (wr, []))[1].append(node.shard_id)
+        elif node.engine_apply_ready is not None:
+            node.engine_apply_ready(node.shard_id)
+    for wr, shard_ids in by_wr.values():
+        wr.notify_all(shard_ids)
 
 
 class _RowMeta:
@@ -1522,6 +1557,7 @@ class VectorStepEngine(IStepEngine):
             return
         n = 0
         n_commit = 0
+        handoffs: List[Tuple] = []
         for db, slots, terms, votes, commits, live, js, applies \
                 in batches:
             n += len(slots)
@@ -1543,9 +1579,13 @@ class VectorStepEngine(IStepEngine):
                 self._on_save_ok(
                     [(live[j][0], None) for j in js.tolist()]
                 )
-            for node, ce in applies:
-                n_commit += 1
-                _apply_lane_commit(node, ce)
+            # collected, not applied inline: the whole generation's
+            # commit rows hand off in ONE batched per-SM-worker pass
+            # below (each row still strictly after ITS batch's save
+            # landed — failed batches never reach this list)
+            handoffs.extend(applies)
+            n_commit += len(applies)
+        _apply_lane_commits(handoffs)
         if n:
             self.stats["lane_rows"] = (
                 self.stats.get("lane_rows", 0) + n
@@ -1586,6 +1626,7 @@ class VectorStepEngine(IStepEngine):
             db = t[0].logdb
             by_db.setdefault(id(db), (db, []))[1].append(t)
         n_commit = 0
+        handoffs: List[Tuple] = []
         for db, rs in by_db.values():
             try:
                 save_slots = getattr(db, "save_state_slots", None)
@@ -1633,7 +1674,8 @@ class VectorStepEngine(IStepEngine):
                 if not ce:
                     continue
                 n_commit += 1
-                _apply_lane_commit(node, ce)
+                handoffs.append((node, ce))
+        _apply_lane_commits(handoffs)
         if n_commit:
             self.stats["lane_commit_rows"] = (
                 self.stats.get("lane_commit_rows", 0) + n_commit

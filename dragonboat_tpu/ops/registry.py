@@ -209,6 +209,19 @@ _routed_round_audit = functools.partial(
     ),
 )(R.routed_round)
 
+# audit-only jit of the fused commit wave (ISSUE 15): K routed rounds
+# chained inside one program.  rounds=2 at the canonical geometry keeps
+# the trace cheap while exercising the round-to-round chaining (the
+# dtype/transfer findings of any K>1 are identical — the body is K
+# copies of the same round program).
+_fused_rounds_audit = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rounds", "out_capacity", "budget", "base", "propose_leaders",
+        "propose_n",
+    ),
+)(R.fused_rounds)
+
 # routed_round inbox width must satisfy base + P*budget == M
 _M_ROUTE = CANON["M_ASM"]
 _BASE_ROUTE = _M_ROUTE - CANON["PB"]
@@ -221,6 +234,17 @@ def _b_routed_round():
         _state(), _inbox(_M_ROUTE), dest, rank,
     ), dict(
         out_capacity=CANON["O"], budget=CANON["budget"],
+        base=_BASE_ROUTE, propose_leaders=True,
+    )
+
+
+def _b_fused_rounds():
+    dest = jnp.full((_g(), CANON["P"]), -1, I32)
+    rank = jnp.zeros((_g(), CANON["P"]), I32)
+    return (
+        _state(), _inbox(_M_ROUTE), dest, rank,
+    ), dict(
+        rounds=2, out_capacity=CANON["O"], budget=CANON["budget"],
         base=_BASE_ROUTE, propose_leaders=True,
     )
 
@@ -280,9 +304,13 @@ ENTRY_POINTS: Tuple[EntryPoint, ...] = (
         C._scatter_inbox_rows,
         _b_scatter_inbox_rows,
     ),
-    # route (audit-only jit wrapper; bench jits its own copy)
+    # route (audit-only jit wrappers; bench jits its own copies)
     EntryPoint(
         "route.routed_round", _routed_round_audit, _b_routed_round,
+        runtime=False,
+    ),
+    EntryPoint(
+        "route.fused_rounds", _fused_rounds_audit, _b_fused_rounds,
         runtime=False,
     ),
 )

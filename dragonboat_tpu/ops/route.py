@@ -497,6 +497,65 @@ def routed_round(
     )
 
 
+def fused_rounds(
+    state: DeviceState,
+    inbox: Inbox,
+    dest_row: jnp.ndarray,
+    rank_in_dest: jnp.ndarray,
+    *,
+    rounds: int,
+    out_capacity: int,
+    budget: int,
+    base: int,
+    propose_leaders: bool = False,
+    propose_n: int = 1,
+) -> Tuple[DeviceState, Inbox, jnp.ndarray, jnp.ndarray]:
+    """``rounds`` consecutive consensus rounds chained INSIDE one
+    program — the fused commit wave (ISSUE 15 / ROADMAP item 2).
+
+    Each round is exactly :func:`routed_round`: step, discard escalated
+    rows, route the outboxes into the next round's inbox.  Chaining
+    them device-side means a quiet-path propose -> replicate/ack ->
+    commit/deliver sequence (``rounds=3``, the default wave) completes
+    in ONE launch with no host round trip between rounds — on the
+    remote-device tunnel each round trip is ~100-214 ms of latency
+    (docs/BENCH_NOTES_r05.md), so a 3-round commit collapses from three
+    floors to one.
+
+    UNROLLED, not ``lax.scan``: ``rounds`` is static and small (2-4),
+    per-round stats fall out of the unrolled loop for free, and the
+    compile cost is ``rounds`` copies of one round's program — NOT the
+    pathological step+route mega-fusion the r5 compile-time finding
+    rules out (bench.py keeps step and route as separate jit units at
+    scale geometry for exactly that reason; a K-chain of the SAME
+    round program reuses its fusion decisions and stays linear).
+
+    Bit-exactness contract: ``fused_rounds(..., rounds=K)`` must equal
+    K sequential ``routed_round`` calls, state and inbox, bit for bit
+    — the serial-K parity oracle (tests/test_hostplane.py, armed live
+    under ``DRAGONBOAT_TPU_HOSTPLANE_PARITY`` in the bench's fused
+    split).
+
+    Returns ``(state', inbox', stats [rounds, 6], n_esc [rounds])`` —
+    per-round RouteStats rows and escalation counts (an escalated
+    row's effects are discarded in ITS round and the row re-steps in
+    later rounds, the same restore-and-continue contract the launch
+    pipeline applies across generations)."""
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    stats_l = []
+    esc_l = []
+    for _ in range(rounds):
+        state, inbox, stats, n_esc = routed_round(
+            state, inbox, dest_row, rank_in_dest,
+            out_capacity=out_capacity, budget=budget, base=base,
+            propose_leaders=propose_leaders, propose_n=propose_n,
+        )
+        stats_l.append(jnp.stack(list(stats)))
+        esc_l.append(n_esc)
+    return state, inbox, jnp.stack(stats_l), jnp.stack(esc_l)
+
+
 # ---------------------------------------------------------------------------
 # multi-chip device plane: sharded tables + the collective exchange lane
 # (ROADMAP item 3 / docs/MULTICHIP.md)
@@ -799,6 +858,7 @@ def make_sharded_round(  # mesh-hot
     base: int,
     propose_leaders: bool = False,
     propose_n: int = 1,
+    rounds: int = 1,
 ):
     """Build the jitted shard_map'd consensus round for a 1-D groups
     mesh: per-device step over the local G-slice, intra-device routing
@@ -808,14 +868,26 @@ def make_sharded_round(  # mesh-hot
     steady loop (pinned by the jaxcheck transfer audit over
     ``registry.mesh_entry_points``).
 
+    ``rounds > 1`` fuses consecutive rounds INSIDE the shard-mapped
+    program (the mesh form of :func:`fused_rounds`): the ppermute
+    collective lane fires BETWEEN fused rounds — cross-chip raft
+    traffic sent in round k is scattered into round k+1's inbox
+    regions before that round steps, never deferred to the end of the
+    wave — so a sharded fused wave is bit-exact with ``rounds``
+    sequential sharded rounds AND with the single-device
+    ``fused_rounds`` over the same global topology
+    (tests/test_pipeline.py mesh parity).
+
     Returns ``round_fn(state, inbox, dest_local, dest_dev, rank) ->
-    (state', inbox', route_stats [D, 6], lane_stats [D, 7])`` where all
-    row-axis operands are sharded over the mesh (jit re-shards
-    uncommitted inputs automatically) and the per-device stats lanes
-    are: RouteStats order for the local router, then [sent, delivered,
-    dropped_budget, dropped_xlane, dropped_ring, escalated, rows_live]
-    for the lane/step — the per-device split ``bench.py
-    phase_multichip`` balances and records.
+    (state', inbox', route_stats [D*rounds, 6], lane_stats
+    [D*rounds, 7])`` where all row-axis operands are sharded over the
+    mesh (jit re-shards uncommitted inputs automatically) and the
+    per-device stats lanes are: RouteStats order for the local router,
+    then [sent, delivered, dropped_budget, dropped_xlane, dropped_ring,
+    escalated, rows_live] for the lane/step, one row per (device,
+    round) — the per-device split ``bench.py phase_multichip``
+    balances and records (``rounds=1``, the default, keeps the
+    historical [D, 6]/[D, 7] shape).
     """
     import jax as _jax
 
@@ -831,40 +903,52 @@ def make_sharded_round(  # mesh-hot
     D = mesh.size
     from . import kernel as K
 
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+
     def _local_round(state, inbox, dest_local, dest_dev, rank):
-        new_state, out = K.step(state, inbox, out_capacity=out_capacity)
-        esc = out.escalate != 0
-        n_esc = jnp.sum(esc, dtype=I32)
-        keep = ~esc
-
-        def sel(a, b):
-            m = keep.reshape((-1,) + (1,) * (a.ndim - 1))
-            return jnp.where(m, b, a)
-
-        state2 = jax.tree.map(sel, state, new_state)
-        prefill = make_prefill(
-            state2, M, E,
-            propose_leaders=propose_leaders, propose_n=propose_n,
-        )
         me = jax.lax.axis_index(axis)
         local_dest = jnp.where(
             dest_dev == me, dest_local, jnp.int32(-1)
         )
-        next_inbox, stats, _delivered = route(
-            state2, out, local_dest, rank,
-            M=M, E=E, budget=budget, base=base,
-            base_inbox=prefill, suppress=esc,
-        )
-        next_inbox, xstats = cross_exchange(
-            state2, out, next_inbox, dest_local, dest_dev, rank,
-            axis=axis, n_dev=D, budget=budget, xbudget=xbudget,
-            base=base, suppress=esc,
-        )
-        rows_live = jnp.sum(keep, dtype=I32)
-        lane = jnp.stack(
-            list(xstats) + [n_esc, rows_live]
-        )[None]  # [1, 7] per shard
-        return state2, next_inbox, jnp.stack(list(stats))[None], lane
+        stats_l = []
+        lane_l = []
+        # unrolled fused rounds: the collective lane runs INSIDE the
+        # per-round tail, so cross-chip traffic from round k feeds
+        # round k+1's step — never batched to the end of the wave
+        for _ in range(rounds):
+            new_state, out = K.step(
+                state, inbox, out_capacity=out_capacity
+            )
+            esc = out.escalate != 0
+            n_esc = jnp.sum(esc, dtype=I32)
+            keep = ~esc
+
+            def sel(a, b, keep=keep):
+                m = keep.reshape((-1,) + (1,) * (a.ndim - 1))
+                return jnp.where(m, b, a)
+
+            state2 = jax.tree.map(sel, state, new_state)
+            prefill = make_prefill(
+                state2, M, E,
+                propose_leaders=propose_leaders, propose_n=propose_n,
+            )
+            next_inbox, stats, _delivered = route(
+                state2, out, local_dest, rank,
+                M=M, E=E, budget=budget, base=base,
+                base_inbox=prefill, suppress=esc,
+            )
+            next_inbox, xstats = cross_exchange(
+                state2, out, next_inbox, dest_local, dest_dev, rank,
+                axis=axis, n_dev=D, budget=budget, xbudget=xbudget,
+                base=base, suppress=esc,
+            )
+            rows_live = jnp.sum(keep, dtype=I32)
+            stats_l.append(jnp.stack(list(stats)))
+            lane_l.append(jnp.stack(list(xstats) + [n_esc, rows_live]))
+            state, inbox = state2, next_inbox
+        # [rounds, 6]/[rounds, 7] per shard -> [D*rounds, *] global
+        return state, inbox, jnp.stack(stats_l), jnp.stack(lane_l)
 
     return _jax.jit(
         _shard_map(

@@ -17,7 +17,9 @@ default (``DRAGONBOAT_TPU_PIPELINE_DEPTH``, default 2) and the
 TPU-tunnel sync-latency model is reproducible on CPU via
 ``DRAGONBOAT_TPU_SYNC_FLOOR_MS`` (e.g. ``=100`` for the measured
 ~100 ms floor) — see docs/BENCH_NOTES_r07.md for the serial-vs-
-pipelined ledger.
+pipelined ledger.  Fused commit waves (``DRAGONBOAT_TPU_FUSED_ROUNDS``,
+default 3) then collapse a proposal's propose→commit rounds into one
+launch + one readback window — docs/BENCH_NOTES_r10.md.
 """
 from __future__ import annotations
 

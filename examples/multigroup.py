@@ -21,7 +21,11 @@ launch.  Two knobs make it reproducible without hardware:
 ``DRAGONBOAT_TPU_PIPELINE_DEPTH`` (2 = double-buffered, 1 = the old
 serial loop) and ``DRAGONBOAT_TPU_SYNC_FLOOR_MS`` (simulated-tunnel
 readback latency, e.g. 100 for the measured TPU-tunnel floor) — see
-docs/BENCH_NOTES_r07.md and ``bench.py phase_pipeline``.
+docs/BENCH_NOTES_r07.md and ``bench.py phase_pipeline``.  Routable
+generations additionally fuse ``DRAGONBOAT_TPU_FUSED_ROUNDS``
+consecutive consensus rounds device-side (default 3: a quiet-path
+proposal commits in ONE launch + ONE readback window; 1 restores the
+single-round loop) — docs/BENCH_NOTES_r10.md.
 """
 from __future__ import annotations
 

@@ -9,7 +9,10 @@
 # mid-transfer kill resumes), the launch-pipeline smoke
 # (scripts/pipeline_smoke.sh, ~5s: depth-2 double buffering at a 10ms
 # simulated sync floor, overlap counter > 0, all futures complete,
-# parity green), the update-lane smoke (scripts/updatelanes_smoke.sh,
+# parity green), the fused-round smoke (scripts/fusedround_smoke.sh,
+# ~5s: K=3 fused commit waves fire, one readback window per
+# generation, parity green, clean drain), the update-lane smoke
+# (scripts/updatelanes_smoke.sh,
 # ~5s: live cluster generations with the array-side pb.Update lanes
 # carrying rows, parity green, zero divergence halts), the multi-chip
 # smoke (scripts/multichip_smoke.sh,
@@ -38,6 +41,7 @@ timeout -k 10 120 bash scripts/obs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/gateway_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/bigstate_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/pipeline_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/fusedround_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/updatelanes_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 240 bash scripts/multichip_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/scenario_smoke.sh || rc=$((rc == 0 ? 1 : rc))

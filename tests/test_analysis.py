@@ -710,6 +710,70 @@ def test_sync_budget_real_tree_seeded_sync_is_caught():
     assert any(f.rule == "sync-budget" for f in fs)
 
 
+# fused commit waves (ISSUE 15): a K-round wave's budget is still ONE
+# sanctioned readback window — a stray sync BETWEEN fused rounds pays
+# a fresh tunnel floor per wave and silently reverts the wave to the
+# 3-floor commit it exists to kill.
+FUSED_WAVE_SRC = '''
+import numpy as np
+
+def _launch_wave(state, pending, rounds):  # sync-hot
+    for _k in range(rounds):
+        state, out = _step(state, pending)
+        pending = _route(state, out)
+    return state, pending
+
+def _launch_wave_with_stray_sync(state, pending, rounds):  # sync-hot
+    for _k in range(rounds):
+        state, out = _step(state, pending)
+        probe = np.asarray(out)        # stray mid-wave sync: flagged
+        pending = _route(state, out)
+    return state, pending
+
+def _complete_wave(heads, t_req):  # sync-hot
+    out = []
+    for dev in heads:
+        # raftlint: ignore[sync-budget] the wave's sanctioned collect
+        out.append(np.asarray(dev))
+    return out
+'''
+
+
+def test_sync_budget_fused_wave_with_stray_sync_fails():
+    """The fused-wave shape: a clean K-round dispatch loop lints green,
+    the same loop with a mid-wave sync is flagged, and the wave's ONE
+    sanctioned collect (point-ignored) passes."""
+    fs = lint_source(FUSED_WAVE_SRC, "dragonboat_tpu/ops/colocated.py")
+    assert rules_of(fs) == {"sync-budget"} and len(fs) == 1, fs
+    line = FUSED_WAVE_SRC.splitlines()[fs[0].line - 1]
+    assert "stray mid-wave sync" in line, line
+    # stripping the sanctioned collect's ignore surfaces it too
+    stripped = FUSED_WAVE_SRC.replace("# raftlint: ignore[sync-budget]",
+                                      "# nope")
+    fs2 = lint_source(stripped, "dragonboat_tpu/ops/colocated.py")
+    assert len(fs2) == 2, fs2
+
+
+def test_sync_budget_real_fused_round_loop_is_marked():
+    """The real fused-wave dispatch loop and round-major merge carry
+    the # sync-hot discipline: the functions exist, are marked, and
+    seeding a stray sync between dispatched rounds is caught."""
+    path = os.path.join(REPO, "dragonboat_tpu/ops/colocated.py")
+    src = open(path).read()
+    assert "def _merge_intermediate_round(  # sync-hot" in src
+    needle = "                for _k in range(1, rounds):"
+    assert needle in src
+    seeded = src.replace(
+        needle,
+        "                junk = jax.device_get(merged_l[0])\n" + needle,
+        1,
+    )
+    fs = lint_source(seeded, "dragonboat_tpu/ops/colocated.py")
+    assert any(f.rule == "sync-budget" for f in fs), (
+        "a stray sync between fused rounds went unflagged"
+    )
+
+
 # ---------------------------------------------------------------------------
 # hygiene: import-hot, bare-except, thread-discipline
 # ---------------------------------------------------------------------------

@@ -300,3 +300,150 @@ class TestLiveClusterParity:
             hp.PARITY, hp.RECORD = old_parity, old_record
             hp.TRACE.clear()
             cluster.close()
+
+
+_FUSED_GEOM = dict(P=3, W=8, E=1, O=8, BUD=2, BASE=2)
+
+
+def _fused_oracle_fns(K):
+    """Shared compiled (serial, fused) pair per K — the fused program
+    is K copies of the round body, so one compile per K serves every
+    test in the class (tier-1 budget: compiles dominate here)."""
+    import functools
+
+    import jax
+
+    from dragonboat_tpu.ops import route as R
+
+    g = _FUSED_GEOM
+    if K not in _fused_oracle_fns._cache:
+        serial = jax.jit(functools.partial(
+            R.routed_round, out_capacity=g["O"], budget=g["BUD"],
+            base=g["BASE"], propose_leaders=True,
+        ))
+        fused = jax.jit(functools.partial(
+            R.fused_rounds, rounds=K, out_capacity=g["O"],
+            budget=g["BUD"], base=g["BASE"], propose_leaders=True,
+        ))
+        _fused_oracle_fns._cache[K] = (serial, fused)
+    return _fused_oracle_fns._cache[K]
+
+
+_fused_oracle_fns._cache = {}
+
+
+class TestFusedRoundOracle:
+    """Serial-K-rounds parity oracle for the fused commit wave
+    (ISSUE 15): ``route.fused_rounds(..., rounds=K)`` must equal K
+    sequential ``routed_round`` calls BIT FOR BIT — state, next inbox,
+    per-round route stats and per-round escalation counts — over mixed
+    election/commit scripts, including a membership change applied at
+    a wave boundary (the fence point: waves never straddle membership
+    mutations, so parity across the boundary is the whole contract)."""
+
+    GEOM = _FUSED_GEOM
+
+    def _population(self, groups=6):
+        import jax.numpy as jnp
+
+        from dragonboat_tpu.ops import route as R
+        from dragonboat_tpu.ops.types import make_state
+
+        g = self.GEOM
+        REPL = 3
+        G = groups * REPL
+        M = g["BASE"] + g["P"] * g["BUD"]
+        shard_ids = np.tile(
+            np.arange(1, groups + 1, dtype=np.int32), REPL
+        )
+        replica_ids = np.repeat(
+            np.arange(1, REPL + 1, dtype=np.int32), groups
+        )
+        peer_ids = np.broadcast_to(
+            np.arange(1, REPL + 1, dtype=np.int32), (G, g["P"])
+        ).copy()
+        dest, rank = R.build_route_tables(
+            shard_ids, replica_ids, peer_ids
+        )
+        st = make_state(
+            G, g["P"], g["W"], shard_ids=shard_ids,
+            replica_ids=replica_ids, peer_ids=peer_ids,
+            election_timeout=10, heartbeat_timeout=2,
+        )
+        ib = R.make_prefill(st, M, g["E"])
+        return (st, ib, jnp.asarray(dest), jnp.asarray(rank),
+                shard_ids, peer_ids)
+
+    @staticmethod
+    def _trees_equal(a, b, what):
+        for f in a._fields:
+            x = np.asarray(getattr(a, f))
+            y = np.asarray(getattr(b, f))
+            assert np.array_equal(x, y), (
+                f"{what}.{f} diverged at "
+                f"{np.argwhere(x != y)[:5].tolist()}"
+            )
+
+    @pytest.mark.parametrize("K", [2, 3])
+    def test_fused_equals_serial_rounds(self, K):
+        import jax
+
+        st, ib, dest, rank, _s, _p = self._population()
+        serial, fused = _fused_oracle_fns(K)
+        sa, ia = st, ib
+        # ~24 total rounds so the script spans election (early waves)
+        # and steady leader-commit rounds (propose_leaders keeps
+        # proposals flowing once rows lead), whatever K divides it into
+        for _wave in range((24 + K - 1) // K):
+            stats_serial, esc_serial = [], []
+            for _ in range(K):
+                sa, ia, s, n = serial(sa, ia, dest, rank)
+                stats_serial.append(np.asarray(jax.numpy.stack(list(s))))
+                esc_serial.append(int(n))
+            st, ib, stats_f, esc_f = fused(st, ib, dest, rank)
+            self._trees_equal(sa, st, "state")
+            self._trees_equal(ia, ib, "inbox")
+            assert np.array_equal(
+                np.stack(stats_serial), np.asarray(stats_f)
+            ), "per-round route stats diverged"
+            assert esc_serial == np.asarray(esc_f).tolist()
+        # the script actually advanced consensus (not a no-op parity)
+        assert (np.asarray(st.committed) > 0).any()
+
+    def test_membership_change_at_wave_boundary(self):
+        """Peer tables mutate BETWEEN waves (the colocated engine
+        fences fused waves to single-round around membership mutation,
+        so a wave never sees a mid-wave table change): parity holds
+        across the boundary and the mutated group keeps committing."""
+        import jax.numpy as jnp
+
+        from dragonboat_tpu.ops import route as R
+
+        K = 3
+        st, ib, dest, rank, shard_ids, peer_ids = self._population()
+        serial, fused = _fused_oracle_fns(K)
+        sa, ia = st, ib
+        for wave in range(8):
+            if wave == 4:
+                # group 1 drops replica 3 at the wave boundary
+                peer_ids[shard_ids == 1, 2] = 0
+
+                def drop(stx):
+                    pid = np.array(np.asarray(stx.peer_id))
+                    pid[shard_ids == 1, 2] = 0
+                    return stx._replace(peer_id=jnp.asarray(pid))
+
+                sa, st = drop(sa), drop(st)
+                d2, r2 = R.build_route_tables(
+                    shard_ids,
+                    np.repeat(np.arange(1, 4, dtype=np.int32), 6),
+                    peer_ids,
+                )
+                dest, rank = jnp.asarray(d2), jnp.asarray(r2)
+            for _ in range(K):
+                sa, ia, _s, _n = serial(sa, ia, dest, rank)
+            st, ib, _sf, _ef = fused(st, ib, dest, rank)
+            self._trees_equal(sa, st, "state")
+            self._trees_equal(ia, ib, "inbox")
+        committed = np.asarray(st.committed).reshape(3, 6).max(0)
+        assert committed[0] > 0, "mutated group stopped committing"

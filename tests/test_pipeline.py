@@ -161,13 +161,10 @@ class TestPipelineFences:
             AuditKV, "stop", shards=(1, 2), sync_floor_ms=100.0
         )
         try:
-            wait_for_leader(nhs, shard_id=1)
+            lead = wait_for_leader(nhs, shard_id=1)
             wait_for_leader(nhs, shard_id=2)
             core = group.core
             violations = arm_fence_probe(core)
-            lead = next(
-                r for r, nh in nhs.items() if nh.is_leader_of(1)
-            )
             nh = nhs[lead]
             sess = nh.get_noop_session(1)
             pending = []
@@ -219,12 +216,9 @@ class TestPipelineFences:
         the committed value, and proposals before/after all complete."""
         group, nhs = make_cluster(KVStore, "evict")
         try:
-            wait_for_leader(nhs)
+            lead = wait_for_leader(nhs)
             core = group.core
             violations = arm_fence_probe(core)
-            lead = next(
-                r for r, nh in nhs.items() if nh.is_leader_of(1)
-            )
             nh = nhs[lead]
             sess = nh.get_noop_session(1)
             pending = [
@@ -335,10 +329,11 @@ class TestPipelineFences:
         generation nobody completes."""
         group, nhs = make_cluster(KVStore, "idle")
         try:
-            wait_for_leader(nhs)
-            lead = next(
-                r for r, nh in nhs.items() if nh.is_leader_of(1)
-            )
+            lead = wait_for_leader(nhs)
+            # wait_for_leader returns the AGREED leader's replica id
+            # (== its host key here): re-probing is_leader_of after
+            # the wait raced suite-load leadership blips into a
+            # StopIteration (tier-1 flake)
             nh = nhs[lead]
             sess = nh.get_noop_session(1)
             for i in range(5):
@@ -370,10 +365,11 @@ class TestPipelineKnobs:
         the in-flight deque never survives a step."""
         group, nhs = make_cluster(KVStore, "serial", pipeline_depth=1)
         try:
-            wait_for_leader(nhs)
-            lead = next(
-                r for r, nh in nhs.items() if nh.is_leader_of(1)
-            )
+            lead = wait_for_leader(nhs)
+            # wait_for_leader returns the AGREED leader's replica id
+            # (== its host key here): re-probing is_leader_of after
+            # the wait raced suite-load leadership blips into a
+            # StopIteration (tier-1 flake)
             nh = nhs[lead]
             sess = nh.get_noop_session(1)
             for i in range(6):
@@ -382,3 +378,232 @@ class TestPipelineKnobs:
             assert group.core.stats["pipeline_overlap_s"] == 0.0
         finally:
             close_all(nhs)
+
+
+class TestFusedWaves:
+    """Fused commit rounds (ISSUE 15): a routable generation chains
+    K=3 consensus rounds device-side and commits quiet-path proposals
+    in ONE launch + ONE readback window.  Contracts:
+
+      W1 (one readback): readback_windows counts exactly one collect
+         window per completed generation (plus one per exact-gather
+         fallback round) — a fused wave never pays K floors;
+      W2 (fence): non-routable generations (escalation holds, stopping
+         rows, deferred membership actions) dispatch single-round —
+         the PR 11 fence argument keeps its <=1-launch exposure;
+      W3 (exactly-once): the fused path inherits F3 — every acked
+         proposal applies exactly once on every replica (the parity
+         fixture of this module stays armed throughout).
+    """
+
+    def test_fused_wave_one_readback_per_wave(self):
+        group, nhs = make_cluster(
+            AuditKV, "fused", sync_floor_ms=5.0, fused_rounds=3,
+        )
+        try:
+            lead = wait_for_leader(nhs)
+            core = group.core
+            assert core._fuse_rounds == 3
+            # wait_for_leader returns the AGREED leader's replica id
+            # (== its host key here): re-probing is_leader_of after
+            # the wait raced suite-load leadership blips into a
+            # StopIteration (tier-1 flake)
+            nh = nhs[lead]
+            sess = nh.get_noop_session(1)
+            keys = set()
+            pending = []
+            for i in range(24):
+                k = f"fw{i}"
+                keys.add(k)
+                pending.append(
+                    (k, nh.propose(sess, audit_set_cmd(k, i), 20.0))
+                )
+            for k, rs in pending:
+                rs._event.wait(20.0)
+                assert rs.code == 1, f"future lost for {k}: {rs.code}"
+            # W1: one readback window per completed generation (+1 per
+            # exact-gather fallback round), snapshotted under the core
+            # lock: every launched generation is either completed or
+            # still in flight, so the identity is exact even while
+            # tick generations keep dispatching
+            with core._lock:
+                st = dict(core.stats)
+                inflight = len(core._inflight)
+            assert st["fused_waves"] > 0, st
+            assert st["fused_rounds_stepped"] >= 3 * st["fused_waves"]
+            assert st["readback_windows"] + inflight == (
+                st["launches"] + st.get("sel_fallbacks", 0)
+            ), (st, inflight)
+            # W3: exactly-once applies on every replica
+            journals = settle_journals(nhs, 1, keys)
+            assert len(journals) == 3
+            for rid, j in journals.items():
+                applied = [k for _, k, _ in j if k in keys]
+                assert len(applied) == len(keys), (
+                    f"replica {rid}: {len(applied)} applies for "
+                    f"{len(keys)} acked keys"
+                )
+        finally:
+            close_all(nhs)
+        assert not group.core._inflight and not group.core._deferred
+
+    def test_escalation_hold_fences_to_single_round(self):
+        """W2: an armed escalation hold on ANY resident row keeps new
+        generations single-round (fused_fences counts them) until the
+        hold drains; fusing resumes afterwards."""
+        group, nhs = make_cluster(
+            KVStore, "fusedesc", fused_rounds=3,
+        )
+        try:
+            lead = wait_for_leader(nhs)
+            core = group.core
+            # wait_for_leader returns the AGREED leader's replica id
+            # (== its host key here): re-probing is_leader_of after
+            # the wait raced suite-load leadership blips into a
+            # StopIteration (tier-1 flake)
+            nh = nhs[lead]
+            sess = nh.get_noop_session(1)
+            propose_r(nh, sess, set_cmd("warm", b"1"))
+            with core._lock:
+                alive = np.nonzero(core._lanes.alive_mask())[0]
+                assert len(alive), "no resident rows"
+                g = int(alive[0])
+                core._lanes.esc_hold[g] = 10_000
+            fences0 = core.stats["fused_fences"]
+            waves0 = core.stats["fused_waves"]
+            for i in range(6):
+                propose_r(nh, sess, set_cmd(f"held{i}", b"1"))
+            assert core.stats["fused_fences"] > fences0, core.stats
+            assert core.stats["fused_waves"] == waves0, (
+                "a wave dispatched under an escalation hold"
+            )
+            with core._lock:
+                core._lanes.esc_hold[g] = 0
+            for i in range(6):
+                propose_r(nh, sess, set_cmd(f"free{i}", b"1"))
+            assert core.stats["fused_waves"] > waves0, (
+                "fusing never resumed after the hold drained"
+            )
+        finally:
+            close_all(nhs)
+
+    def test_fused_disabled_by_knob(self):
+        """fused_rounds=1 is the PR 11 single-round loop: zero waves,
+        env/kwarg kill switch proven."""
+        group, nhs = make_cluster(
+            KVStore, "fusedoff", fused_rounds=1,
+        )
+        try:
+            lead = wait_for_leader(nhs)
+            # wait_for_leader returns the AGREED leader's replica id
+            # (== its host key here): re-probing is_leader_of after
+            # the wait raced suite-load leadership blips into a
+            # StopIteration (tier-1 flake)
+            nh = nhs[lead]
+            sess = nh.get_noop_session(1)
+            for i in range(6):
+                propose_r(nh, sess, set_cmd(f"k{i}", b"x"))
+            assert group.core.stats["fused_waves"] == 0
+            assert group.core.stats["fused_fences"] == 0  # knob, not fence
+        finally:
+            close_all(nhs)
+
+
+class TestFusedShardedRounds:
+    """Forced-multi-host-device mesh run (ISSUE 15 satellite): the
+    fused sharded round (``make_sharded_round(rounds=K)``) is
+    bit-exact with K sequential sharded rounds AND with the
+    single-device ``fused_rounds`` over the same global topology —
+    proving the cross-chip ppermute lane fires BETWEEN fused rounds,
+    not after the wave (a lane deferred to the wave end would diverge
+    the serial legs on the first cross-device ack)."""
+
+    def test_fused_sharded_parity_cross_device(self):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from dragonboat_tpu.ops import route as R
+        from dragonboat_tpu.ops.types import make_state
+
+        devs = [d for d in jax.devices() if d.platform == "cpu"]
+        if len(devs) < 2:
+            pytest.skip("needs 2 forced host devices")
+        mesh = Mesh(np.asarray(devs[:2]), ("groups",))
+        P, W, E, O, BUD, BASE, K = 3, 16, 2, 16, 4, 2, 3
+        M = BASE + P * BUD
+        groups, REPL = 4, 3
+        G = groups * REPL
+        # replica-major: every group's replicas straddle device blocks
+        shard_ids = np.tile(
+            np.arange(1, groups + 1, dtype=np.int32), REPL
+        )
+        replica_ids = np.repeat(
+            np.arange(1, REPL + 1, dtype=np.int32), groups
+        )
+        peer_ids = np.broadcast_to(
+            np.arange(1, REPL + 1, dtype=np.int32), (G, P)
+        ).copy()
+        tabs = R.build_route_tables_mesh(
+            shard_ids, replica_ids, peer_ids, 2
+        )
+        XB = R.xbudget_for(tabs, BUD, 2)
+        dest, rank = R.build_route_tables(
+            shard_ids, replica_ids, peer_ids
+        )
+        st = make_state(
+            G, P, W, shard_ids=shard_ids, replica_ids=replica_ids,
+            peer_ids=peer_ids, election_timeout=10,
+            heartbeat_timeout=2,
+        )
+        ib = R.make_prefill(st, M, E)
+        round_shard = R.make_sharded_round(
+            mesh, M=M, E=E, out_capacity=O, budget=BUD, xbudget=XB,
+            base=BASE, propose_leaders=True,
+        )
+        wave_shard = R.make_sharded_round(
+            mesh, M=M, E=E, out_capacity=O, budget=BUD, xbudget=XB,
+            base=BASE, propose_leaders=True, rounds=K,
+        )
+        fused_single = jax.jit(functools.partial(
+            R.fused_rounds, rounds=K, out_capacity=O, budget=BUD,
+            base=BASE, propose_leaders=True,
+        ))
+        args_s = [jnp.asarray(t) for t in (
+            tabs.dest_local, tabs.dest_dev, tabs.rank_in_dest
+        )]
+        args_r = [jnp.asarray(dest), jnp.asarray(rank)]
+        st_serial = st_wave = st_single = st
+        ib_serial = ib_wave = ib_single = ib
+        lane_tot = np.zeros((7,), np.int64)
+        for _ in range(8):  # 8 waves = 24 rounds: election + commits
+            for _k in range(K):
+                st_serial, ib_serial, _s, _l = round_shard(
+                    st_serial, ib_serial, *args_s
+                )
+            st_wave, ib_wave, _sw, lane = wave_shard(
+                st_wave, ib_wave, *args_s
+            )
+            assert np.asarray(lane).shape == (2 * K, 7)
+            lane_tot += np.asarray(lane, np.int64).sum(0)
+            st_single, ib_single, _sf, _ef = fused_single(
+                st_single, ib_single, *args_r
+            )
+            for f in st_serial._fields:
+                a = np.asarray(getattr(st_serial, f))
+                b = np.asarray(getattr(st_wave, f))
+                c = np.asarray(getattr(st_single, f))
+                assert np.array_equal(a, b), f"wave-vs-serial {f}"
+                assert np.array_equal(a, c), f"wave-vs-single {f}"
+            for f in ib_serial._fields:
+                a = np.asarray(getattr(ib_serial, f))
+                b = np.asarray(getattr(ib_wave, f))
+                assert np.array_equal(a, b), f"inbox {f}"
+        # real cross-device traffic rode the lane mid-wave, none lost
+        assert lane_tot[1] > 0, "no cross-device traffic on the lane"
+        assert lane_tot[3] == 0, f"xlane drops at sized budget: {lane_tot}"
+        from dragonboat_tpu.ops.types import ROLE_LEADER
+
+        assert (np.asarray(st_wave.role) == ROLE_LEADER).sum() >= groups - 1
