@@ -1590,19 +1590,23 @@ def phase_updatelanes(rows_list=None, reps: int = 3) -> dict:
         # full-population parity OUTSIDE the timed windows: both
         # loops must leave identical raft words + identical apply
         # handoff counts
-        diverged = sum(
-            1
-            for a, b in zip(nodes_s, nodes_l)
-            if (
+        diverged = 0
+        for g, (a, b) in enumerate(zip(nodes_s, nodes_l)):
+            ta = (
                 a.peer.raft.term, a.peer.raft.vote,
                 a.peer.raft.log.committed, a.peer.raft.leader_id,
                 a.peer.raft.role, a.peer.raft.log.processed,
-            ) != (
+            )
+            tb = (
                 b.peer.raft.term, b.peer.raft.vote,
                 b.peer.raft.log.committed, b.peer.raft.leader_id,
                 b.peer.raft.role, b.peer.raft.log.processed,
             )
-        )
+            if ta != tb:
+                diverged += 1
+                if os.environ.get("BENCH_UL_PARITY_DEBUG") and diverged <= 8:
+                    print(f"BENCHUL-DIVERGE g={g} scalar={ta} lane={tb}",
+                          flush=True)
         tasks_s = sum(nd.sm.task_queue.n for nd in nodes_s)
         tasks_l = sum(nd.sm.task_queue.n for nd in nodes_l)
         # persisted hard state must match too (the lane path's batched
@@ -1618,6 +1622,10 @@ def phase_updatelanes(rows_list=None, reps: int = 3) -> dict:
             sb = rb.state if rb is not None else None
             ta = (sa.term, sa.vote, sa.commit) if sa else None
             tb = (sb.term, sb.vote, sb.commit) if sb else None
+            if ta != tb and os.environ.get("BENCH_UL_PARITY_DEBUG"):
+                if db_diverged < 8:
+                    print(f"BENCHUL-DB-DIVERGE g={i} scalar={ta} lane={tb}",
+                          flush=True)
             db_diverged += ta != tb
         diverged += db_diverged
         tier = {

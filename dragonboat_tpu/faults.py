@@ -84,7 +84,20 @@ WIRE_KINDS = (
     "duplicate",
     "reorder",
     "chunk_corrupt",
+    # directional kinds: targets are "src->dst" PAIRS (asym_pair), not
+    # source addresses — A's sends to B suffer while B's to A flow
+    # clean, the one-way partition / one-way delay the symmetric kinds
+    # above cannot express (A sees B but B drops A).  Both the in-proc
+    # and the TCP transport consume them through the same on_wire hook.
+    "asym_drop",
+    "asym_delay",
 )
+ASYM_KINDS = ("asym_drop", "asym_delay")
+
+
+def asym_pair(src: str, dst: str) -> str:
+    """Canonical target form for the directional wire kinds."""
+    return f"{src}->{dst}"
 FS_KINDS = ("fsync_err", "torn_write", "write_err")
 ENGINE_KINDS = ("escalate",)
 PROCESS_KINDS = ("crash",)
@@ -196,6 +209,7 @@ class FaultPlan:
         churn_shards: Sequence[int] = (),
         stream_addrs: Sequence[str] = (),
         stream_recv_addrs: Sequence[str] = (),
+        asym_pairs: Sequence[str] = (),
         rounds: int = 8,
         mean_gap: float = 0.8,
         mean_duration: float = 0.8,
@@ -213,7 +227,11 @@ class FaultPlan:
         stream going TO a witness/dummy or laggard replica no matter
         which voter is the current sender; passing only
         ``stream_addrs`` keeps the drawn plan byte-identical to
-        pre-``stream_recv_addrs`` trees (same pool, same draws)."""
+        pre-``stream_recv_addrs`` trees (same pool, same draws).
+        ``asym_pairs`` (``asym_pair(src, dst)`` strings) adds the
+        directional wire kinds to the pool — same opt-in discipline:
+        omitting it keeps every pre-existing seeded schedule
+        byte-identical."""
         rng = Random(seed)
         addrs = list(addrs)
         stream_pool = list(stream_addrs) + [
@@ -230,6 +248,8 @@ class FaultPlan:
             kinds += ["leader_kill", "leader_transfer", "member_cycle"]
         if stream_pool:
             kinds += ["snapshot_stream_kill", "snapshot_stream_stall"]
+        if asym_pairs:
+            kinds += ["asym_drop", "asym_delay"]
         t = 0.0
         faults: List[Fault] = []
         for _ in range(rounds):
@@ -289,6 +309,17 @@ class FaultPlan:
                         duration=dur,
                         targets=(rng.choice(stream_pool),),
                         p=round(rng.uniform(0.05, 0.3), 3),
+                        delay=round(rng.uniform(0.01, 0.1), 3),
+                    )
+                )
+            elif kind in ASYM_KINDS:
+                faults.append(
+                    Fault(
+                        kind,
+                        at=t,
+                        duration=dur,
+                        targets=(rng.choice(list(asym_pairs)),),
+                        p=round(rng.uniform(0.2, 0.8), 3),
                         delay=round(rng.uniform(0.01, 0.1), 3),
                     )
                 )
@@ -931,6 +962,20 @@ class FaultController:
                 if cut:
                     self._count("wire_partitioned")
                     out = []
+            elif f.kind in ("asym_drop", "asym_delay"):
+                # directional: matched by the (source, target) PAIR —
+                # this must precede the generic source filter below,
+                # which would mis-read the "src->dst" targets as
+                # source addresses and skip every payload
+                if f.targets and f"{source}->{target}" not in f.targets:
+                    continue
+                if f.kind == "asym_drop":
+                    if self._draw("asym_drop", source, target, lane[2]) < f.p:
+                        self._count("wire_asym_dropped")
+                        out = []
+                elif self._draw("asym_delay", source, target, lane[2]) < f.p:
+                    self._count("wire_asym_delayed")
+                    time.sleep(f.delay)
             elif f.targets and source not in f.targets:
                 continue
             elif f.kind == "drop":

@@ -20,6 +20,7 @@ from .gateway import (
     GatewayFuture,
 )
 from .routing import RoutingCache
+from .rpc import RemoteHostHandle, RouteFeeder, RpcServer
 
 __all__ = [
     "AdmissionController",
@@ -29,5 +30,8 @@ __all__ = [
     "GatewayClosed",
     "GatewayConfig",
     "GatewayFuture",
+    "RemoteHostHandle",
+    "RouteFeeder",
     "RoutingCache",
+    "RpcServer",
 ]

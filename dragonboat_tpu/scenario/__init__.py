@@ -20,8 +20,14 @@ deterministic, seeded day schedule:
   any SLA miss with a flight-recorder timeline;
 * :class:`DayReport` — the per-phase ledger + per-fault-class
   recovery/dip table (JSON + printable).
+
+The multi-process gear (:mod:`.multiproc`) lifts the same shape across
+OS-process boundaries: :class:`ProcFleet` runs each host as a separate
+process behind the RPC/TCP ingress with real gossip liveness, so the
+nemesis's whole-host kill is a true ``SIGKILL``.
 """
 from .fleet import CORE, LAGGARD, SPARE, WITNESS, DayFleet
+from .multiproc import ProcFleet, run_mini_multiproc_day, run_rpc_smoke
 from .plan import DISTURBANCE_CLASSES, DayPlan, Phase, SH_DISK, SH_MEM
 from .report import DayReport
 from .runner import ScenarioRunner
@@ -34,9 +40,12 @@ __all__ = [
     "DayReport",
     "LAGGARD",
     "Phase",
+    "ProcFleet",
     "SH_DISK",
     "SH_MEM",
     "SPARE",
     "ScenarioRunner",
     "WITNESS",
+    "run_mini_multiproc_day",
+    "run_rpc_smoke",
 ]

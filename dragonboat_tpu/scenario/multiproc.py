@@ -1,0 +1,431 @@
+"""Multi-process fleet harness: separate OS processes, TCP + gossip +
+RPC only, zero shared memory (docs/SCENARIO.md "Multi-process gear").
+
+:class:`ProcFleet` spawns ``scenario/procworker.py`` children, fronts
+them with the ordinary :class:`~dragonboat_tpu.gateway.Gateway` over
+:class:`~dragonboat_tpu.gateway.rpc.RemoteHostHandle` clients, joins
+their gossip mesh as an observer and runs a
+:class:`~dragonboat_tpu.gateway.rpc.RouteFeeder` so leader routing
+converges with no in-proc tap.  The nemesis is REAL: ``kill()`` is
+``SIGKILL`` on the worker's process, and the asymmetric wire faults
+go over the RPC fault op to the victim's own FaultController.
+
+Two entry points ride it:
+
+* :func:`run_rpc_smoke` — the ~5s CI gate (scripts/rpc_smoke.sh): a
+  2-process fleet commits over the wire, the leader's process is
+  SIGKILLed mid-service, a restart over the same dirs recovers within
+  ``assert_recovery_sla``, and post-recovery commits + reroutes pass.
+* :func:`run_mini_multiproc_day` — the 3-process mini production day
+  (``DRAGONBOAT_MULTIPROC=1`` tier-1 gear): open-loop audited traffic
+  through the gateway, a real leader SIGKILL + restart, an asymmetric
+  one-way drop injected and healed, routing reconvergence, and the
+  Wing–Gong client-history audit over everything that happened.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..audit.checker import check_linearizable, check_stale_reads
+from ..audit.history import HistoryRecorder
+from ..audit.model import audit_set_cmd
+from ..faults import assert_recovery_sla, asym_pair
+from ..gateway import Gateway, GatewayBusy, GatewayConfig
+from ..gateway.rpc import RemoteHostHandle, RouteFeeder
+from ..logger import get_logger
+from ..transport.gossip import GossipManager
+
+_log = get_logger("scenario")
+
+SHARD = 1
+
+
+class ProcFleet:
+    """N procworker children + the client-side planes over them."""
+
+    def __init__(self, n: int = 3, *, workdir: str = "/tmp/mpday",
+                 base_port: int = 29650, fresh: bool = True):
+        self.n = n
+        self.workdir = workdir
+        self.base_port = base_port
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.handles: Dict[str, RemoteHostHandle] = {}
+        self.ready: Dict[int, dict] = {}
+        self.gossip: Optional[GossipManager] = None
+        self.gateway: Optional[Gateway] = None
+        self.feeder: Optional[RouteFeeder] = None
+        if fresh:
+            shutil.rmtree(workdir, ignore_errors=True)
+        os.makedirs(workdir, exist_ok=True)
+
+    # -- worker lifecycle -------------------------------------------------
+    def _spawn(self, idx: int) -> subprocess.Popen:
+        # the child resolves the package by PYTHONPATH, not the parent's
+        # cwd — drives launched from a scratch dir must still spawn
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.Popen(
+            [sys.executable, "-m", "dragonboat_tpu.scenario.procworker",
+             str(idx), str(self.n), self.workdir, str(self.base_port)],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+
+    def _wait_ready(self, idx: int, timeout: float = 90.0) -> dict:
+        path = f"{self.workdir}/ready-{idx}.json"
+        deadline = time.time() + timeout
+        while True:
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        info = json.load(f)
+                    if info.get("pid") == self.procs[idx].pid:
+                        return info
+                except (OSError, json.JSONDecodeError, KeyError):
+                    pass
+            if self.procs[idx].poll() is not None:
+                raise RuntimeError(f"worker {idx} died during startup")
+            if time.time() > deadline:
+                raise TimeoutError(f"worker {idx} never became ready")
+            time.sleep(0.1)
+
+    def start(self) -> None:
+        for idx in range(1, self.n + 1):
+            self.procs[idx] = self._spawn(idx)
+        for idx in range(1, self.n + 1):
+            self.ready[idx] = self._wait_ready(idx)
+        for idx in range(1, self.n + 1):
+            # keyed by the child's NodeHostID: with address_by_nodehost_id
+            # the membership addresses (and hence the collector's
+            # leader_host / the routing cache keys) ARE the nhids, and a
+            # restart over the same dirs keeps the id — so the handle
+            # registration survives kills
+            self.handles[self._key(idx)] = RemoteHostHandle(
+                self.ready[idx]["rpc"], rtt_millisecond=20
+            )
+        # observer membership in the children's gossip mesh: liveness
+        # for the RouteFeeder comes from DIRECT contact, exactly what a
+        # cross-process balance plane would consume
+        self.gossip = GossipManager(
+            nodehost_id=f"observer-{os.getpid()}",
+            raft_address="observer",
+            bind_address="127.0.0.1:0",
+            seeds=[self.ready[i]["gossip"] for i in range(1, self.n + 1)],
+            interval=0.1,
+        )
+        self.gossip.start()
+        self.gateway = Gateway(
+            dict(self.handles),
+            GatewayConfig(workers=2, default_timeout=5.0,
+                          cap_feedback=False),
+        )
+        self.feeder = RouteFeeder(self.gateway, self.gossip, interval=0.25)
+        self.feeder.start()
+
+    def _key(self, idx: int) -> str:
+        return self.ready[idx]["nhid"]
+
+    def raft_addr(self, idx: int) -> str:
+        return self.ready[idx]["raft"]
+
+    def handle(self, idx: int) -> RemoteHostHandle:
+        return self.handles[self._key(idx)]
+
+    def live_slots(self):
+        return [i for i in range(1, self.n + 1)
+                if self.procs[i].poll() is None]
+
+    # -- nemesis ----------------------------------------------------------
+    def kill(self, idx: int) -> None:
+        """A true crash: SIGKILL the worker's OS process.  The handle
+        stays registered — its breaker darkens it, and the fixed RPC
+        port lets it reconnect after restart()."""
+        p = self.procs[idx]
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+
+    def restart(self, idx: int) -> None:
+        """Respawn over the SAME dirs: WAL replay + gossip rejoin +
+        raft catch-up, observed purely over the wire."""
+        try:
+            os.remove(f"{self.workdir}/ready-{idx}.json")
+        except OSError:
+            pass
+        self.procs[idx] = self._spawn(idx)
+        self.ready[idx] = self._wait_ready(idx)
+
+    def leader_slot(self, timeout: float = 30.0) -> int:
+        """The slot whose replica currently leads SHARD, asked over the
+        wire (replica ids == slot numbers)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for idx in self.live_slots():
+                try:
+                    lid, ok = self.handle(idx).get_leader_id(SHARD)
+                except Exception:  # noqa: BLE001 — dark/restarting host
+                    continue
+                if ok and lid in self.procs:
+                    return lid
+            time.sleep(0.1)
+        raise TimeoutError("no leader observed over RPC")
+
+    def set_asym_drop(self, src: int, dst: int, p: float = 1.0) -> None:
+        """One-way partition: src's sends to dst drop, dst->src flows.
+        Installed on the SOURCE worker's FaultController (on_wire runs
+        sender-side), driven over the RPC fault op."""
+        self.handle(src).send_fault("activate", fault={
+            "kind": "asym_drop",
+            "targets": [asym_pair(self.raft_addr(src), self.raft_addr(dst))],
+            "p": p,
+        })
+
+    def set_asym_delay(self, src: int, dst: int, delay: float,
+                       p: float = 1.0) -> None:
+        self.handle(src).send_fault("activate", fault={
+            "kind": "asym_delay",
+            "targets": [asym_pair(self.raft_addr(src), self.raft_addr(dst))],
+            "p": p, "delay": delay,
+        })
+
+    def heal_wire(self, idx: int) -> None:
+        self.handle(idx).send_fault("heal_wire")
+
+    # -- teardown ---------------------------------------------------------
+    def close(self) -> None:
+        if self.feeder is not None:
+            self.feeder.close()
+        if self.gateway is not None:
+            try:
+                self.gateway.close()
+            except Exception:  # noqa: BLE001 — dark remotes mid-close
+                pass
+        for h in self.handles.values():
+            h.close()
+        if self.gossip is not None:
+            self.gossip.close()
+        for idx, p in self.procs.items():
+            if p.poll() is None:
+                with open(f"{self.workdir}/stop-{idx}", "w") as f:
+                    f.write("stop")
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _sla_hosts(fleet: ProcFleet) -> Dict[str, RemoteHostHandle]:
+    """SLA convergence is judged over LIVE workers only: a SIGKILLed
+    slot's handle raises on every probe and would read as 'never
+    converged' long after the survivors agree."""
+    return {fleet._key(i): fleet.handle(i) for i in fleet.live_slots()}
+
+
+# ---------------------------------------------------------------------------
+# the ~5s CI gate (scripts/rpc_smoke.sh)
+# ---------------------------------------------------------------------------
+def run_rpc_smoke(n: int = 2, *, workdir: str = "/tmp/rpc-smoke",
+                  base_port: int = 29750) -> dict:
+    fleet = ProcFleet(n, workdir=workdir, base_port=base_port)
+    out = {"committed": 0, "rerouted": False}
+    try:
+        fleet.start()
+        gw = fleet.gateway
+
+        # commits over the wire through the gateway (exactly-once)
+        h = gw.connect(SHARD, timeout=30.0)
+        for i in range(5):
+            h.sync_propose(audit_set_cmd(f"pre{i}", str(i)), timeout=10.0)
+            out["committed"] += 1
+        assert gw.read(SHARD, "pre0", timeout=10.0) == "0"
+
+        # SIGKILL the leader's PROCESS mid-service
+        victim = fleet.leader_slot()
+        fleet.kill(victim)
+
+        # with n=2 the shard has no quorum until the restart; bring the
+        # victim back over the same dirs and require recovery (WAL
+        # replay + gossip re-resolution + catch-up) inside the SLA
+        fleet.restart(victim)
+        assert_recovery_sla(
+            _sla_hosts(fleet), SHARD, sla_ticks=4000,
+            cmd=audit_set_cmd("sla", "probe"), rtt_ms=20,
+            per_try_timeout=1.0, fault_class="proc_kill9",
+        )
+
+        # routing reconverges off gossip+stats with zero shared memory
+        deadline = time.time() + 20
+        while gw.routes.lookup(SHARD) is None and time.time() < deadline:
+            time.sleep(0.1)
+        out["rerouted"] = gw.routes.lookup(SHARD) is not None
+
+        # post-recovery commits + read-your-write through the gateway
+        for i in range(3):
+            h.sync_propose(audit_set_cmd(f"post{i}", str(i)), timeout=10.0)
+            out["committed"] += 1
+        assert gw.read(SHARD, "post2", timeout=10.0) == "2"
+        gw.close_handle(h)
+        return out
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the mini multi-process production day (DRAGONBOAT_MULTIPROC=1 gear)
+# ---------------------------------------------------------------------------
+class _Traffic:
+    """Open-loop audited traffic over the gateway: exactly-once writers
+    plus a linearizable reader, every outcome recorded for the offline
+    Wing–Gong audit (the scenario runner's traffic idiom, client-side
+    only — no in-proc journal exists across process boundaries)."""
+
+    def __init__(self, gw: Gateway, rec: HistoryRecorder, writers: int = 2):
+        self._gw = gw
+        self.rec = rec
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._writer_main, args=(w,),
+                             daemon=True, name=f"mpday-writer-{w}")
+            for w in range(writers)
+        ] + [
+            threading.Thread(target=self._reader_main, daemon=True,
+                             name="mpday-reader")
+        ]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=20.0)
+
+    def _writer_main(self, w: int) -> None:
+        client = self.rec.new_client()
+        handle = None
+        seq = 0
+        while not self._stop.is_set():
+            if handle is None:
+                try:
+                    handle = self._gw.connect(SHARD, timeout=5.0)
+                except Exception:  # noqa: BLE001 — fleet mid-outage
+                    self._stop.wait(0.25)
+                    continue
+            key = f"w{w}-k{seq % 4}"
+            val = f"{w}:{seq}"
+            seq += 1
+            op = self.rec.invoke(client, "w", key, val)
+            try:
+                handle.sync_propose(audit_set_cmd(key, val), timeout=2.5)
+                self.rec.ok(op)
+            except GatewayBusy:
+                # shed at the door: definitely not in the history
+                self.rec.fail(op)
+            except Exception:  # noqa: BLE001 — maybe committed
+                self.rec.ambiguous(op)
+            self._stop.wait(0.02)
+
+    def _reader_main(self) -> None:
+        client = self.rec.new_client()
+        seq = 0
+        while not self._stop.is_set():
+            key = f"w{seq % 2}-k{seq % 4}"
+            seq += 1
+            op = self.rec.invoke(client, "r", key)
+            try:
+                val = self._gw.read(SHARD, key, timeout=2.0)
+                self.rec.ok(op, output=val)
+            except Exception:  # noqa: BLE001 — reads are idempotent
+                self.rec.fail(op)
+            self._stop.wait(0.03)
+
+
+def run_mini_multiproc_day(n: int = 3, *, workdir: str = "/tmp/mpday",
+                           base_port: int = 29650) -> dict:
+    """The acceptance scenario: a 3-process fleet serves open-loop
+    gateway traffic; the leader's process takes a real SIGKILL and the
+    fleet recovers inside the SLA; an asymmetric one-way drop is
+    injected and healed with routing reconverging; the full client
+    history passes the linearizability + stale-read audit."""
+    fleet = ProcFleet(n, workdir=workdir, base_port=base_port)
+    report = {"sla": {}, "ops": 0, "audit": "pending"}
+    try:
+        fleet.start()
+        gw = fleet.gateway
+        rec = HistoryRecorder()
+        traffic = _Traffic(gw, rec)
+        traffic.start()
+        time.sleep(2.0)  # steady-state traffic before the first fault
+
+        # -- disturbance 1: real whole-host kill (SIGKILL the leader) --
+        victim = fleet.leader_slot()
+        fleet.kill(victim)
+        t0 = time.monotonic()
+        assert_recovery_sla(
+            _sla_hosts(fleet), SHARD, sla_ticks=4000,
+            cmd=audit_set_cmd("sla-kill", "probe"), rtt_ms=20,
+            per_try_timeout=1.0, fault_class="proc_kill9",
+        )
+        report["sla"]["proc_kill9"] = round(time.monotonic() - t0, 3)
+
+        # restart the victim over the same dirs; wait until it answers
+        # stats over RPC again (catch-up observed from outside)
+        fleet.restart(victim)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if fleet.handle(victim).balance_shard_stats():
+                    break
+            except Exception:  # noqa: BLE001 — still replaying/joining
+                pass
+            time.sleep(0.2)
+
+        # -- disturbance 2: asymmetric one-way drop ---------------------
+        # the current leader's sends to one follower vanish while the
+        # reverse direction flows — the classic half-open link
+        leader = fleet.leader_slot()
+        follower = next(i for i in fleet.live_slots() if i != leader)
+        fleet.set_asym_drop(leader, follower, p=1.0)
+        time.sleep(1.5)  # let the one-way window bite under traffic
+        fleet.heal_wire(leader)
+        t0 = time.monotonic()
+        assert_recovery_sla(
+            _sla_hosts(fleet), SHARD, sla_ticks=4000,
+            cmd=audit_set_cmd("sla-asym", "probe"), rtt_ms=20,
+            per_try_timeout=1.0, fault_class="asym_drop",
+        )
+        report["sla"]["asym_drop"] = round(time.monotonic() - t0, 3)
+
+        # routing reconverges purely off gossip + stats
+        deadline = time.time() + 20
+        while gw.routes.lookup(SHARD) is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert gw.routes.lookup(SHARD) is not None, "route never reconverged"
+
+        time.sleep(1.0)  # post-heal traffic tail
+        traffic.stop()
+
+        # -- the audit: full client history, Wing–Gong ------------------
+        ops = rec.ops()
+        report["ops"] = len(ops)
+        lin = check_linearizable(ops)
+        assert lin.ok, lin.describe()
+        stale = check_stale_reads(ops)
+        assert not stale, "\n".join(v.describe() for v in stale)
+        report["audit"] = "ok"
+        report["counts"] = rec.counts()
+        return report
+    finally:
+        fleet.close()

@@ -189,8 +189,14 @@ class InMemLogDB(ILogDB):
         with self._lock:
             key = (shard_id, replica_id)
             if key not in self._nodes:
-                return None
-            ns = self._nodes[key]
+                # a replica saved ONLY through the columnar lane path
+                # has no node store yet — pending lane words are still
+                # durable state and must materialize through this
+                # reader, not read back as None
+                s = self._hs_slots.get(key)
+                if s is None or not self._hs_dirty[s]:
+                    return None
+            ns = self._get(shard_id, replica_id)
             if self._hs_slots:
                 self._hs_sync(key, ns)
             first = max(ns.min_index, ns.snapshot.index + 1)

@@ -51,6 +51,15 @@ KIND_CHUNK = 2
 # rolling upgrades degrade to restart-from-zero, never to corruption.
 KIND_RESUME_QUERY = 3
 KIND_RESUME_RESP = 4
+# gateway RPC ingress (gateway/rpc.py, docs/GATEWAY.md "Networked
+# ingress"): one request frame out, one response frame back, multiplexed
+# by request id over a long-lived client connection.  Same CRC framing
+# and the same versioned-payload discipline as KIND_BATCH (RPC_BIN_VER
+# below); unknown kinds still close the connection on OLD receivers, so
+# a client probing a pre-RPC node degrades to a torn connection its
+# breaker absorbs — never to misparsed frames.
+KIND_RPC_REQ = 5
+KIND_RPC_RESP = 6
 # frame-kind flag: payload is zlib-compressed (wire entry compression —
 # reference: EntryCompression on replicated batches [U]; ours is adaptive)
 KIND_COMPRESSED = 0x80
@@ -651,3 +660,274 @@ def decode_rsm_snapshot(data: bytes) -> dict:
         "sm_data": sm_data if has_sm_data else None,
         "on_disk": on_disk,
     }
+
+
+# ---------------------------------------------------------------------------
+# gateway RPC payloads (gateway/rpc.py)
+# ---------------------------------------------------------------------------
+# The networked NodeHost front door's request/response pair.  Both are
+# versioned like MessageBatch: the encoder always writes the CURRENT
+# layout, the decoder accepts known past versions and refuses FUTURE
+# ones (silently shifting every later field is the failure mode this
+# guards).  All fields positional binary — RPC input arrives from
+# untrusted client connections and must never execute code or allocate
+# unboundedly on decode.
+
+RPC_BIN_VER = 0
+
+# request ops
+RPC_OP_PROPOSE = 1
+RPC_OP_READ = 2
+RPC_OP_SESSION_OPEN = 3
+RPC_OP_SESSION_CLOSE = 4
+RPC_OP_STATS = 5
+RPC_OP_FAULT = 6
+
+# READ flags (RpcRequest.flags)
+RPC_READ_LEASE = 0   # lease fast path ONLY; ERR_NO_LEASE when not held
+RPC_READ_INDEX = 1   # full ReadIndex quorum read
+RPC_READ_STALE = 2   # local stale read (no linearizability)
+
+# response codes: 0..6 are RequestResultCode values verbatim; the 0x60
+# block is transport/ingress-level outcomes that have no node-side code
+RPC_ERR_BUSY = 0x60       # shed (server admission / node SystemBusy)
+RPC_ERR_NOT_FOUND = 0x61  # shard not on this host / host closed
+RPC_ERR_NO_LEASE = 0x62   # lease-only read: lease not held, fall back
+RPC_ERR = 0x63            # anything else (error string carries detail)
+RPC_ERR_DENIED = 0x64     # op not allowed (fault ops on a prod server)
+
+_RPC_MAX_CMD = 8 * 1024 * 1024  # per-request payload bound (ingress)
+
+
+class RpcRequest:
+    """One client request (see gateway/rpc.py for op semantics).
+
+    ``client_id``/``series_id``/``responded_to`` carry the exactly-once
+    session triple for PROPOSE/SESSION_CLOSE (the session STATE lives
+    client-side; the server reconstructs an ephemeral Session per
+    request).  ``timeout_ms`` is the per-request deadline the server
+    bounds its own wait by; ``arg`` is op-specific (lease margin ticks
+    for READ/LEASE)."""
+
+    __slots__ = ("req_id", "op", "flags", "shard_id", "client_id",
+                 "series_id", "responded_to", "timeout_ms", "arg",
+                 "payload")
+
+    def __init__(self, req_id=0, op=0, flags=0, shard_id=0, client_id=0,
+                 series_id=0, responded_to=0, timeout_ms=1000, arg=0,
+                 payload=b""):
+        self.req_id = req_id
+        self.op = op
+        self.flags = flags
+        self.shard_id = shard_id
+        self.client_id = client_id
+        self.series_id = series_id
+        self.responded_to = responded_to
+        self.timeout_ms = timeout_ms
+        self.arg = arg
+        self.payload = payload
+
+
+class RpcResponse:
+    """One server response.  ``code`` is a RequestResultCode value or an
+    RPC_ERR_* constant; ``value``/``data`` mirror statemachine.Result;
+    ``error`` is human-readable detail for the error block."""
+
+    __slots__ = ("req_id", "code", "value", "data", "error")
+
+    def __init__(self, req_id=0, code=0, value=0, data=b"", error=""):
+        self.req_id = req_id
+        self.code = code
+        self.value = value
+        self.data = data
+        self.error = error
+
+
+def encode_rpc_request(q: RpcRequest) -> bytes:
+    if len(q.payload) > _RPC_MAX_CMD:
+        raise WireError(f"rpc payload too large: {len(q.payload)}")
+    b = BytesIO()
+    _wu32(b, RPC_BIN_VER)
+    _wu64(b, q.req_id)
+    _wu8(b, q.op)
+    _wu8(b, q.flags)
+    _wu64(b, q.shard_id)
+    _wu64(b, q.client_id)
+    _wu64(b, q.series_id)
+    _wu64(b, q.responded_to)
+    _wu32(b, q.timeout_ms)
+    _wu32(b, q.arg)
+    _wb(b, q.payload)
+    return b.getvalue()
+
+
+def decode_rpc_request(data: bytes) -> RpcRequest:
+    r = _R(data)
+    bin_ver = r.u32()
+    if bin_ver > RPC_BIN_VER:
+        raise WireError(
+            f"rpc request bin_ver {bin_ver} is newer than supported "
+            f"{RPC_BIN_VER}"
+        )
+    q = RpcRequest(
+        req_id=r.u64(), op=r.u8(), flags=r.u8(), shard_id=r.u64(),
+        client_id=r.u64(), series_id=r.u64(), responded_to=r.u64(),
+        timeout_ms=r.u32(), arg=r.u32(), payload=r.blob(),
+    )
+    if len(q.payload) > _RPC_MAX_CMD:
+        raise WireError(f"rpc payload too large: {len(q.payload)}")
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return q
+
+
+def encode_rpc_response(p: RpcResponse) -> bytes:
+    b = BytesIO()
+    _wu32(b, RPC_BIN_VER)
+    _wu64(b, p.req_id)
+    _wu8(b, p.code)
+    _wu64(b, p.value)
+    _wb(b, p.data)
+    _ws(b, p.error)
+    return b.getvalue()
+
+
+def decode_rpc_response(data: bytes) -> RpcResponse:
+    r = _R(data)
+    bin_ver = r.u32()
+    if bin_ver > RPC_BIN_VER:
+        raise WireError(
+            f"rpc response bin_ver {bin_ver} is newer than supported "
+            f"{RPC_BIN_VER}"
+        )
+    p = RpcResponse(
+        req_id=r.u64(), code=r.u8(), value=r.u64(), data=r.blob(),
+        error=r.s(),
+    )
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return p
+
+
+# read queries and read results are small tagged values, not arbitrary
+# objects: the state machines' lookup() contracts in this repo take
+# str/bytes keys and return str/bytes/int/None (plus JSON-able
+# composites like AuditKV's ("get", k) tuples and list values).  A
+# tagged union keeps the wire pickle-free and the type round trip exact
+# (a bytes key must not come back str).
+RPC_VAL_NONE = 0
+RPC_VAL_BYTES = 1
+RPC_VAL_STR = 2
+RPC_VAL_INT = 3
+RPC_VAL_JSON = 4
+
+
+def encode_rpc_value(v) -> bytes:
+    import json as _json
+
+    b = BytesIO()
+    if v is None:
+        _wu8(b, RPC_VAL_NONE)
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        _wu8(b, RPC_VAL_BYTES)
+        _wb(b, bytes(v))
+    elif isinstance(v, str):
+        _wu8(b, RPC_VAL_STR)
+        _ws(b, v)
+    elif isinstance(v, bool):
+        # bool is an int subclass; JSON keeps the type distinct
+        _wu8(b, RPC_VAL_JSON)
+        _ws(b, _json.dumps(v))
+    elif isinstance(v, int) and 0 <= v <= 0xFFFFFFFFFFFFFFFF:
+        _wu8(b, RPC_VAL_INT)
+        _wu64(b, v)
+    elif isinstance(v, int):
+        # negative / oversized ints ride the JSON lane (u64 would wrap)
+        _wu8(b, RPC_VAL_JSON)
+        _ws(b, _json.dumps(v))
+    else:
+        try:
+            s = _json.dumps(v)
+        except (TypeError, ValueError) as e:
+            raise WireError(f"rpc value not encodable: {type(v).__name__}") from e
+        _wu8(b, RPC_VAL_JSON)
+        _ws(b, s)
+    return b.getvalue()
+
+
+def decode_rpc_value(data: bytes):
+    import json as _json
+
+    r = _R(data)
+    tag = r.u8()
+    if tag == RPC_VAL_NONE:
+        v = None
+    elif tag == RPC_VAL_BYTES:
+        v = r.blob()
+    elif tag == RPC_VAL_STR:
+        v = r.s()
+    elif tag == RPC_VAL_INT:
+        v = r.u64()
+    elif tag == RPC_VAL_JSON:
+        try:
+            v = _json.loads(r.s())
+        except ValueError as e:
+            raise WireError(f"bad rpc json value: {e}")
+        # JSON turns tuples into lists; lookup() contracts in this repo
+        # accept both, so no re-tupling is attempted here
+    else:
+        raise WireError(f"unknown rpc value tag {tag}")
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return v
+
+
+def encode_rpc_stats(nodehost_id: str, raft_address: str, rows) -> bytes:
+    """STATS response payload: the host identity plus its
+    ``balance_shard_stats()`` rows (membership included), so the
+    balance Collector — and through it the gossip-routed gateway's
+    RoutingCache — works over RemoteHostHandles with zero shared
+    memory."""
+    b = BytesIO()
+    _ws(b, nodehost_id)
+    _ws(b, raft_address)
+    rows = list(rows)
+    _wu32(b, len(rows))
+    for row in rows:
+        for k in ("shard_id", "replica_id", "leader_id", "term",
+                  "applied", "proposals"):
+            _wu64(b, row[k])
+        # device is -1 (host path / no mesh) or a chip ordinal; +1 keeps
+        # it in u64 without a sign convention on the wire
+        _wu64(b, int(row.get("device", -1)) + 1)
+        _w_membership(b, row["membership"])
+    return b.getvalue()
+
+
+def decode_rpc_stats(data: bytes):
+    r = _R(data)
+    nodehost_id = r.s()
+    raft_address = r.s()
+    rows = []
+    for _ in range(r.count()):
+        shard_id = r.u64()
+        replica_id = r.u64()
+        leader_id = r.u64()
+        term = r.u64()
+        applied = r.u64()
+        proposals = r.u64()
+        device = r.u64() - 1
+        membership = _r_membership(r)
+        rows.append({
+            "shard_id": shard_id,
+            "replica_id": replica_id,
+            "leader_id": leader_id,
+            "term": term,
+            "applied": applied,
+            "proposals": proposals,
+            "device": device,
+            "membership": membership,
+        })
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return nodehost_id, raft_address, rows

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Cross-process RPC smoke (docs/GATEWAY.md "Networked ingress" /
+# docs/SCENARIO.md multi-process gear): a REAL two-OS-process fleet —
+# each host its own `python -m dragonboat_tpu.scenario.procworker`
+# child with TCP raft transport, gossip liveness and an RpcServer
+# ingress; the parent drives it purely over RPC/TCP through a
+# gossip-routed Gateway (zero shared memory).  Asserts
+#   1. open-loop commits land through RemoteHostHandle sessions,
+#   2. a lease/ReadIndex read observes the last committed value,
+#   3. the LEADER process dies by real SIGKILL and, after restart,
+#      the fleet recovers under assert_recovery_sla (proc_kill9),
+#   4. the RouteFeeder re-learns the post-kill leader from the
+#      gossip-backed collector (rerouted=True) and post-kill commits
+#      land.
+# ~5-8s — wired into tier1.sh as a post-step.  The 3-process mini
+# production day (asym partitions, linearizability audit) is the
+# DRAGONBOAT_MULTIPROC=1 gear of tests/test_rpc.py, not run here.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import logging
+
+logging.basicConfig(level=logging.ERROR)
+
+from dragonboat_tpu.scenario import run_rpc_smoke
+
+out = run_rpc_smoke(n=2, workdir="/tmp/rpc-smoke-ci", base_port=29750)
+assert out["committed"] == 8, out
+assert out["rerouted"], out
+print(
+    "RPC_SMOKE_OK "
+    f"procs=2 committed={out['committed']} rerouted={out['rerouted']}"
+)
+EOF

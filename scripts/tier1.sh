@@ -20,7 +20,10 @@
 # devices + the transfer-free jaxcheck gate over the sharded entry
 # points), the production-day scenario smoke (scripts/scenario_smoke.sh,
 # ~10-15s: tiny seeded mini-day over the mixed on-disk/in-memory/witness
-# fleet — every disturbance class fired, audit green, zero SLA misses)
+# fleet — every disturbance class fired, audit green, zero SLA misses),
+# the cross-process RPC smoke (scripts/rpc_smoke.sh, ~5-8s: a real
+# two-OS-process fleet over RPC/TCP + gossip, leader SIGKILLed and
+# recovered under SLA, routing reconverged with zero shared memory)
 # and the static-analysis gates + analyzer
 # self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
 # Prints
@@ -45,5 +48,6 @@ timeout -k 10 120 bash scripts/fusedround_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/updatelanes_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 240 bash scripts/multichip_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/scenario_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/rpc_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

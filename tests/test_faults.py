@@ -671,3 +671,72 @@ def test_soak_randomized_fault_plan():
     finally:
         stop.set()
         cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# asymmetric (directional) wire faults
+# ---------------------------------------------------------------------------
+class TestAsymmetricWireFaults:
+    def test_asym_drop_is_directional(self):
+        from dragonboat_tpu.faults import asym_pair
+
+        ctl = FaultController(seed=1)
+        ctl.activate(Fault("asym_drop", targets=(asym_pair("a", "b"),),
+                           p=1.0))
+        # a sees b but b never hears a: ONLY the a->b direction drops
+        assert ctl.on_wire("a", "b", _batch()) == []
+        b = _batch()
+        assert ctl.on_wire("b", "a", b) == [b]
+        b2 = _batch()
+        assert ctl.on_wire("a", "c", b2) == [b2]
+        assert ctl.stats.get("wire_asym_dropped", 0) == 1
+        ctl.heal_wire()
+        b3 = _batch()
+        assert ctl.on_wire("a", "b", b3) == [b3]
+
+    def test_asym_delay_is_directional(self):
+        from dragonboat_tpu.faults import asym_pair
+
+        ctl = FaultController(seed=1)
+        ctl.activate(Fault("asym_delay", targets=(asym_pair("a", "b"),),
+                           p=1.0, delay=0.02))
+        t0 = time.monotonic()
+        b = _batch()
+        assert ctl.on_wire("a", "b", b) == [b]  # delayed, not dropped
+        assert time.monotonic() - t0 >= 0.02
+        b2 = _batch()
+        assert ctl.on_wire("b", "a", b2) == [b2]
+        assert ctl.stats.get("wire_asym_delayed", 0) == 1
+
+    def test_asym_kinds_validated_and_wire_healed(self):
+        from dragonboat_tpu.faults import ASYM_KINDS, WIRE_KINDS
+
+        for k in ASYM_KINDS:
+            assert k in WIRE_KINDS
+        with pytest.raises(ValueError):
+            Fault("asym_teleport")
+
+    def test_randomized_asym_pool_byte_compat(self):
+        from dragonboat_tpu.faults import ASYM_KINDS
+
+        # schedules without the new kwarg are byte-identical to the
+        # pre-asym pin (same RNG draw order)
+        a = FaultPlan.randomized(
+            42, addrs=["x", "y"], fs_keys=[1], rounds=12
+        ).describe()
+        b = FaultPlan.randomized(
+            42, addrs=["x", "y"], fs_keys=[1], asym_pairs=(), rounds=12
+        ).describe()
+        assert a == b
+        assert "asym" not in a
+        # a non-empty pair pool enters deterministically
+        c = FaultPlan.randomized(
+            42, addrs=["x", "y"], asym_pairs=["x->y", "y->x"], rounds=48
+        )
+        assert c.describe() == FaultPlan.randomized(
+            42, addrs=["x", "y"], asym_pairs=["x->y", "y->x"], rounds=48
+        ).describe()
+        asym = [f for f in c.faults if f.kind in ASYM_KINDS]
+        assert asym, "48 rounds drew no asym fault"
+        for f in asym:
+            assert f.targets and f.targets[0] in ("x->y", "y->x")

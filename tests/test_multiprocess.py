@@ -87,6 +87,10 @@ class _Cmd:
             return json.load(f)
 
 
+@pytest.mark.slow  # superseded in tier-1 by scripts/rpc_smoke.sh + the
+# gateway-over-RPC kill test (tests/test_rpc.py), which cover the same
+# SIGKILL-the-leader recovery over a REAL networked ingress; this
+# file-IPC variant stays as the slow-gear cross-check
 def test_multiprocess_kill9_leader_recovery():
     shutil.rmtree(WORKDIR, ignore_errors=True)
     os.makedirs(WORKDIR)

@@ -433,3 +433,56 @@ def test_sharded_mesh_lane_slices(n_dev):
                 nh.close()
             except Exception:  # noqa: BLE001
                 pass
+
+
+class TestLaneSlotPersistReadback:
+    """InMemLogDB columnar hard-state lanes: the persist half
+    (``save_state_slots``) and the reader half (``read_raft_state``
+    via ``_hs_sync``) must compose for replicas that have ONLY ever
+    saved through the lane path — such a replica has no classic node
+    store yet, and an early-return on that miss read its durable lane
+    words back as None (the PR-15 db-parity rot recorded in
+    docs/BENCH_NOTES_r10.md, fixed this PR)."""
+
+    def test_lane_only_replica_reads_back(self):
+        from dragonboat_tpu.storage.logdb import InMemLogDB
+
+        db = InMemLogDB()
+        s = db.state_lane_slot(7, 3)
+        db.save_state_slots(
+            np.array([s]), np.array([5]), np.array([2]),
+            np.array([11]), worker_id=0,
+        )
+        rs = db.read_raft_state(7, 3, 0)
+        assert rs is not None, "lane-only hard state must be readable"
+        st = rs.state
+        assert (st.term, st.vote, st.commit) == (5, 2, 11)
+        # the lazy materialize is exactly-once and stable: a second
+        # read (dirty bit now clear) returns the same words
+        st2 = db.read_raft_state(7, 3, 0).state
+        assert (st2.term, st2.vote, st2.commit) == (5, 2, 11)
+
+    def test_registered_but_never_saved_slot_reads_none(self):
+        from dragonboat_tpu.storage.logdb import InMemLogDB
+
+        db = InMemLogDB()
+        db.state_lane_slot(7, 4)  # registered, nothing persisted
+        assert db.read_raft_state(7, 4, 0) is None
+
+    def test_lane_words_win_over_stale_classic_state(self):
+        from dragonboat_tpu.pb import State, Update
+        from dragonboat_tpu.storage.logdb import InMemLogDB
+
+        db = InMemLogDB()
+        db.save_raft_state(
+            [Update(shard_id=7, replica_id=5,
+                    state=State(term=1, vote=1, commit=1))],
+            worker_id=0,
+        )
+        s = db.state_lane_slot(7, 5)
+        db.save_state_slots(
+            np.array([s]), np.array([9]), np.array([3]),
+            np.array([40]), worker_id=0,
+        )
+        st = db.read_raft_state(7, 5, 0).state
+        assert (st.term, st.vote, st.commit) == (9, 3, 40)
