@@ -18,8 +18,10 @@ Three pieces:
   the audited cluster runs, plus the pure replay model;
 * :mod:`.checker` — the offline checker: per-key Wing–Gong
   linearizability search with a bounded-search escape hatch and a
-  minimal failing-window report, a stale-read pass, and the
-  exactly-once session pass over replica apply journals.
+  minimal failing-window report, a stale-read pass, a bounded-read
+  containment pass (readplane: stamped staleness never exceeds the
+  bound, docs/READPLANE.md), and the exactly-once session pass over
+  replica apply journals.
 
 The churn nemesis itself (scheduled leader kills / transfers /
 membership churn / balancer moves) is the ``churn`` plane of
@@ -32,6 +34,7 @@ from .checker import (
     CheckResult,
     Violation,
     assert_audit_ok,
+    check_bounded_reads,
     check_linearizable,
     check_sessions,
     check_stale_reads,
@@ -51,6 +54,7 @@ __all__ = [
     "Op",
     "Violation",
     "audit_set_cmd",
+    "check_bounded_reads",
     "check_linearizable",
     "check_sessions",
     "check_stale_reads",

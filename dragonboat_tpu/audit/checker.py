@@ -231,6 +231,65 @@ def check_stale_reads(ops: Sequence[Op]) -> List[Violation]:
     return out
 
 
+def check_bounded_reads(ops: Sequence[Op]) -> List[Violation]:
+    """BOUNDED_STALENESS's two promises (docs/READPLANE.md): the
+    stamped staleness never exceeds the caller's bound (a read past
+    the bound must SHED, not serve), and the value obeys the same
+    containment stale reads owe — some possibly-committed write of
+    this key invoked before the read returned, never an aborted
+    proposal's value, never a value from the future.  The stamp rides
+    ``op.value`` as (applied_index, staleness_ticks, bound_ticks)."""
+    writes = {o.value: o for o in ops if o.kind == "w"}
+    out: List[Violation] = []
+    for o in ops:
+        if o.kind != "bounded" or o.status != "ok":
+            continue
+        stamp = o.value
+        if not isinstance(stamp, (tuple, list)) or len(stamp) != 3:
+            out.append(
+                Violation(o.key, "bounded read served without a stamp",
+                          _window([o]), [o])
+            )
+            continue
+        _applied, staleness, bound = stamp
+        if staleness > bound:
+            out.append(
+                Violation(
+                    o.key,
+                    f"bounded read served PAST its bound "
+                    f"(staleness {staleness} > bound {bound} ticks)",
+                    _window([o]), [o],
+                )
+            )
+        if o.output is None:
+            continue
+        w = writes.get(o.output)
+        if w is None:
+            out.append(
+                Violation(o.key,
+                          "bounded read observed a never-written value",
+                          _window([o]), [o])
+            )
+        elif w.key != o.key:
+            out.append(
+                Violation(o.key,
+                          "bounded read observed another key's value",
+                          _window([w, o]), [w, o])
+            )
+        elif w.status == "fail":
+            out.append(
+                Violation(o.key,
+                          "bounded read observed an aborted proposal's value",
+                          _window([w, o]), [w, o])
+            )
+        elif w.invoke > o.ret:
+            out.append(
+                Violation(o.key, "bounded read observed a future write",
+                          _window([w, o]), [w, o])
+            )
+    return out
+
+
 @dataclass
 class SessionReport:
     ok: bool
@@ -310,6 +369,7 @@ class AuditReport:
     linearizability: CheckResult
     stale: List[Violation]
     sessions: Optional[SessionReport]
+    bounded: List[Violation] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -323,6 +383,7 @@ class AuditReport:
             self.linearizability.ok
             and not self.linearizability.bounded
             and not self.stale
+            and not self.bounded
             and (self.sessions is None or self.sessions.ok)
         )
 
@@ -333,6 +394,11 @@ class AuditReport:
             parts += [v.describe() for v in self.stale]
         else:
             parts.append("stale reads: ok")
+        if self.bounded:
+            parts.append("bounded-read violations:")
+            parts += [v.describe() for v in self.bounded]
+        else:
+            parts.append("bounded reads: ok")
         if self.sessions is not None:
             parts.append(self.sessions.describe())
         return "\n".join(parts)
@@ -345,12 +411,15 @@ def run_audit(
     initial=None,
     bound: int = DEFAULT_BOUND,
 ) -> AuditReport:
-    """The full offline audit: linearizability + stale-read pass +
-    (when journals are given) the exactly-once session pass."""
+    """The full offline audit: linearizability (leader AND follower-
+    linearizable reads — both record kind "r") + stale-read pass +
+    bounded-read containment + (when journals are given) the
+    exactly-once session pass."""
     return AuditReport(
         linearizability=check_linearizable(ops, initial=initial, bound=bound),
         stale=check_stale_reads(ops),
         sessions=None if journals is None else check_sessions(ops, journals),
+        bounded=check_bounded_reads(ops),
     )
 
 

@@ -50,9 +50,15 @@ _MAYBE_COMMITTED_ERRORS = (TimeoutError_, RequestTerminated, NodeHostClosed)
 class Op:
     client: int
     index: int
-    kind: str  # "w" | "r" | "stale"
+    # "w" | "r" | "stale" | "bounded".  Follower-linearizable reads
+    # record as "r": they promise the SAME contract as a leader read,
+    # so they join the Wing–Gong pass unchanged — that IS the safety
+    # check (docs/READPLANE.md).  "bounded" reads are exempt from
+    # recency but carry their stamp in ``value`` as (applied_index,
+    # staleness_ticks, bound_ticks) for check_bounded_reads.
+    kind: str
     key: object
-    value: object = None  # written value (writes)
+    value: object = None  # written value (writes) / stamp (bounded reads)
     output: object = None  # observed value (reads) / apply index (writes)
     status: str = "pending"  # pending -> ok | fail | ambig
     invoke: float = 0.0
@@ -358,6 +364,54 @@ class AuditClient:
             self.recorder.fail(op)
             return None
 
+    def follower_read(self, key):
+        """Follower-linearizable read (docs/READPLANE.md): served from
+        any replica's local state machine after its ReadIndex round.
+        Recorded as kind "r" — it promises exactly the leader read's
+        contract, so the Wing–Gong pass judges it unchanged (that IS
+        the follower-read safety check)."""
+        op = self.recorder.invoke(self.client, "r", key)
+        deadline = self._deadline()
+        while time.monotonic() < deadline:
+            nh = self._host()
+            if nh is None:
+                time.sleep(0.05)
+                continue
+            try:
+                v, _applied = nh.follower_read(
+                    self.shard_id, ("get", key),
+                    timeout=self._per_try(deadline),
+                )
+                self.recorder.ok(op, v)
+                return v
+            except Exception as e:  # noqa: BLE001 — reads are idempotent
+                self._count(f"follower_{type(e).__name__}")
+                time.sleep(0.02)
+        self.recorder.fail(op)
+        return None
+
+    def bounded_read(self, key, bound_ticks: int = 50):
+        """Bounded-staleness read: one attempt against one live host
+        (like stale_read — retrying elsewhere is the GATEWAY's job; the
+        audit records what one replica answered).  The stamp rides
+        ``op.value`` as (applied_index, staleness_ticks, bound_ticks)
+        for check_bounded_reads; a shed records as fail (no effect)."""
+        op = self.recorder.invoke(self.client, "bounded", key)
+        nh = self._host()
+        if nh is None:
+            self.recorder.fail(op)
+            return None
+        try:
+            res = nh.bounded_read(self.shard_id, ("get", key),
+                                  bound_ticks=bound_ticks)
+            op.value = (res.applied_index, res.staleness_ticks, bound_ticks)
+            self.recorder.ok(op, res.value)
+            return res.value
+        except Exception as e:  # noqa: BLE001 — shed or host closing
+            self._count(f"bounded_{type(e).__name__}")
+            self.recorder.fail(op)
+            return None
+
     def close(self, timeout: float = 2.0) -> None:
         """Best-effort session unregister (the registry LRU also GCs)."""
         s, self.session = self.session, None
@@ -379,11 +433,16 @@ def run_workload(
     *,
     read_ratio: float = 0.35,
     stale_ratio: float = 0.1,
+    follower_ratio: float = 0.0,
+    bounded_ratio: float = 0.0,
+    bound_ticks: int = 50,
     pace: float = 0.002,
 ) -> List[threading.Thread]:
     """Spawn one daemon thread per client running a mixed write/read/
-    stale-read loop over ``keys`` until ``stop`` is set.  Returns the
-    (started) threads; join them after setting ``stop``."""
+    stale-read(/follower/bounded) loop over ``keys`` until ``stop`` is
+    set.  Returns the (started) threads; join them after setting
+    ``stop``.  The readplane ratios default to 0 so pre-readplane
+    workloads keep their exact op mix."""
 
     def loop(c: AuditClient):
         while not stop.is_set():
@@ -393,6 +452,11 @@ def run_workload(
                 c.read(key)
             elif roll < read_ratio + stale_ratio:
                 c.stale_read(key)
+            elif roll < read_ratio + stale_ratio + follower_ratio:
+                c.follower_read(key)
+            elif roll < (read_ratio + stale_ratio + follower_ratio
+                         + bounded_ratio):
+                c.bounded_read(key, bound_ticks=bound_ticks)
             else:
                 c.write(key)
             time.sleep(pace)

@@ -58,7 +58,13 @@ class AuditKV(IStateMachine):
         return Result(value=entry.index)
 
     def lookup(self, query):
-        if isinstance(query, tuple) and len(query) == 2 and query[0] == "get":
+        # tuple OR list: RPC queries ride the JSON value lane, which
+        # turns ("get", k) into ["get", k] (transport/wire.py contract)
+        if (
+            isinstance(query, (tuple, list))
+            and len(query) == 2
+            and query[0] == "get"
+        ):
             query = query[1]
         return self.data.get(query)
 

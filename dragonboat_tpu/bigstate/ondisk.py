@@ -297,9 +297,15 @@ class OnDiskKV(IOnDiskStateMachine):
         return entries
 
     def lookup(self, query):
-        if isinstance(query, tuple) and len(query) == 2 and query[0] == "get":
+        # tuple OR list: RPC queries ride the JSON value lane, which
+        # turns ("get", k) into ["get", k] (transport/wire.py contract)
+        if (
+            isinstance(query, (tuple, list))
+            and len(query) == 2
+            and query[0] == "get"
+        ):
             query = query[1]
-        if query == ("stats",):
+        if query == ("stats",) or query == ["stats"]:
             return {
                 "applied": self.applied,
                 "keys": len(self._data),

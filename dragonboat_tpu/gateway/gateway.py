@@ -41,6 +41,20 @@ from typing import Dict, Optional
 from ..client import LatencyBudget, Session
 from ..logger import get_logger
 from ..metrics import MetricsRegistry
+from ..readplane import (
+    BOUND_TICKS_DEFAULT,
+    Consistency,
+    PATH_BOUNDED,
+    PATH_FOLLOWER,
+    PATH_LEASE,
+    PATH_READ_INDEX,
+    READ_PATHS,
+    ReadResult,
+    ReadRouter,
+    ReadUnsupported,
+    STALENESS_TICK_BOUNDS,
+    StaleBoundExceeded,
+)
 from ..request import RequestResultCode, ShardNotFound, SystemBusy
 from .admission import AdmissionController
 from .routing import RoutingCache
@@ -237,6 +251,19 @@ class Gateway:
         self._lease_reads = self.metrics.counter("gateway_lease_read_total")
         self._fallback_reads = self.metrics.counter(
             "gateway_read_fallback_total"
+        )
+        # read-plane counters (docs/READPLANE.md): one per served path
+        # plus sheds; pre-resolved so the read path never takes the
+        # registry lock (counter() locks on lookup)
+        self._read_paths: Dict[str, int] = {p: 0 for p in READ_PATHS}
+        self._read_paths["bounded_shed"] = 0
+        self._read_counters = {
+            p: self.metrics.counter("gateway_read_total", {"path": p})
+            for p in self._read_paths
+        }
+        self.read_router = ReadRouter()
+        self._staleness = self.metrics.histogram(
+            "readplane_staleness_ticks", bounds=STALENESS_TICK_BOUNDS
         )
         self._latency = self.metrics.histogram("gateway_request_seconds")
         # per-shard submission lanes: shard -> deque of _GwReq released
@@ -734,14 +761,58 @@ class Gateway:
 
     # -- reads ---------------------------------------------------------------
     def read(self, shard_id: int, query, timeout: Optional[float] = None):
-        """Linearizable read.  Fast path: the routed leader host serves
-        it under its CheckQuorum lease, skipping the per-read ReadIndex
-        quorum round trip; fallback: plain ``sync_read`` (ReadIndex)
-        through any live host.  Safety: docs/GATEWAY.md."""
+        """Linearizable read (value only; the pre-readplane surface).
+        Fast path: the routed leader host serves it under its
+        CheckQuorum lease, skipping the per-read ReadIndex quorum round
+        trip; fallback: plain ``sync_read`` (ReadIndex) through any
+        live host.  Safety: docs/GATEWAY.md."""
+        return self.read_at(shard_id, query, timeout=timeout).value
+
+    def read_at(
+        self,
+        shard_id: int,
+        query,
+        *,
+        consistency: Consistency = Consistency.LINEARIZABLE,
+        timeout: Optional[float] = None,
+        bound_ticks: int = BOUND_TICKS_DEFAULT,
+    ) -> ReadResult:
+        """Consistency-routed read (docs/READPLANE.md).
+
+        LINEARIZABLE goes to the routed leader (lease fast path,
+        ReadIndex fallback); FOLLOWER_LINEARIZABLE and
+        BOUNDED_STALENESS fan out over the shard's replica set, the
+        serving replica picked by power-of-two-choices on observed
+        per-replica p99 (``read_router``).  Returns the value with its
+        provenance stamp; BOUNDED_STALENESS raises
+        :class:`StaleBoundExceeded` when no replica can serve within
+        ``bound_ticks``."""
         if self._closed:
             raise GatewayClosed("gateway closed")
         t = timeout if timeout is not None else self.config.default_timeout
         deadline = time.monotonic() + t
+        if consistency == Consistency.FOLLOWER_LINEARIZABLE:
+            return self._read_follower(shard_id, query, deadline)
+        if consistency == Consistency.BOUNDED_STALENESS:
+            return self._read_bounded(shard_id, query, deadline, bound_ticks)
+        return self._read_linearizable(shard_id, query, deadline)
+
+    def _count_read(self, path: str) -> None:
+        # GIL-racy like the other read-path counters (nothing depends
+        # on them exactly); the dict mirror feeds stats()/the ledger
+        self._read_paths[path] += 1
+        self._read_counters[path].add()
+
+    def _read_event(self, shard_id: int, detail: str) -> None:
+        """`read_path` flight-recorder lane: fallback transitions only
+        (lease->read_index, follower->leader, bounded sheds) — the
+        evidence trail for WHY a read took the path it took."""
+        rec = self._shed_recorder  # one attribute load on the hot path
+        if rec is not None:
+            rec.record(shard_id, "read_path", detail)
+
+    def _read_linearizable(self, shard_id: int, query,
+                           deadline: float) -> ReadResult:
         key = self.routes.resolve(shard_id)
         if key is not None:
             nh = self._live_hosts().get(key)
@@ -753,12 +824,14 @@ class Gateway:
                     )
                     if ok:
                         self._lease_reads.add()
-                        return val
+                        self._count_read(PATH_LEASE)
+                        return ReadResult(val, PATH_LEASE, host=key)
                 except Exception:  # noqa: BLE001 — host/shard stopping:
                     # fall through to the quorum path
                     self.routes.invalidate(shard_id)
         # ReadIndex fallback, retried across hosts until the deadline
         self._fallback_reads.add()
+        self._read_event(shard_id, "lease->read_index")
         last_exc: Optional[BaseException] = None
         while True:
             remaining = deadline - time.monotonic()
@@ -771,12 +844,129 @@ class Gateway:
                 time.sleep(0.02)
                 continue
             try:
-                return nh.sync_read(shard_id, query, timeout=remaining)
+                val = nh.sync_read(shard_id, query, timeout=remaining)
+                self._count_read(PATH_READ_INDEX)
+                return ReadResult(val, PATH_READ_INDEX)
             except Exception as e:  # noqa: BLE001 — reads are
                 # idempotent; retry through another route
                 last_exc = e
                 self.routes.invalidate(shard_id)
                 time.sleep(0.02)
+
+    def _pick_replica(self, shard_id: int, tried):
+        """One p2c selection over the live, untried replica set.
+        Returns (key, nh) or (None, None) when no candidate remains."""
+        hosts = self._live_hosts()
+        cands = [
+            k for k in self.routes.resolve_replicas(shard_id)
+            if k not in tried
+            and not getattr(hosts.get(k), "_closed", True)
+        ]
+        key = self.read_router.pick(cands)
+        if key is None:
+            return None, None
+        return key, hosts.get(key)
+
+    def _read_follower(self, shard_id: int, query,
+                       deadline: float) -> ReadResult:
+        """FOLLOWER_LINEARIZABLE: any replica confirms via a ReadIndex
+        round to the leader and serves from its local state machine.
+        Failed replicas are penalized and excluded; an old server
+        without the consistency byte degrades to a leader read."""
+        last_exc: Optional[BaseException] = None
+        tried: set = set()
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                from ..nodehost import TimeoutError_
+
+                raise last_exc or TimeoutError_("gateway read deadline")
+            key, nh = self._pick_replica(shard_id, tried)
+            if nh is None:
+                if not tried:
+                    # no replica set known at all yet: rediscover
+                    time.sleep(0.02)
+                    self.routes.invalidate_replicas(shard_id)
+                    continue
+                tried.clear()  # every replica failed once: fresh round
+                time.sleep(0.02)
+                continue
+            t0 = time.monotonic()
+            try:
+                val, applied = nh.follower_read(
+                    shard_id, query, timeout=remaining
+                )
+                self.read_router.observe(key, time.monotonic() - t0)
+                self._count_read(PATH_FOLLOWER)
+                return ReadResult(val, PATH_FOLLOWER,
+                                  applied_index=applied, host=key)
+            except ReadUnsupported:
+                # remote predates the consistency byte: leader read is
+                # the compatible contract-preserving fallback
+                self._read_event(shard_id,
+                                 f"follower->leader: {key} unsupported")
+                return self._read_linearizable(shard_id, query, deadline)
+            except Exception as e:  # noqa: BLE001 — replica dark/
+                # leaderless/mid-transfer: penalize and fan to the next
+                self.read_router.penalize(key)
+                tried.add(key)
+                last_exc = e
+
+    def _read_bounded(self, shard_id: int, query, deadline: float,
+                      bound_ticks: int) -> ReadResult:
+        """BOUNDED_STALENESS: a replica serves immediately from local
+        state, stamped; replicas past the bound shed and the next is
+        tried — when EVERY replica sheds, the caller gets
+        StaleBoundExceeded (escalate the level or retry later)."""
+        last_exc: Optional[BaseException] = None
+        shed_exc: Optional[StaleBoundExceeded] = None
+        tried: set = set()
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                from ..nodehost import TimeoutError_
+
+                raise shed_exc or last_exc or TimeoutError_(
+                    "gateway read deadline")
+            key, nh = self._pick_replica(shard_id, tried)
+            if nh is None:
+                if shed_exc is not None:
+                    # every live replica is past the bound: shed the
+                    # read rather than spin the deadline down
+                    raise shed_exc
+                if not tried:
+                    time.sleep(0.02)
+                    self.routes.invalidate_replicas(shard_id)
+                    continue
+                tried.clear()
+                time.sleep(0.02)
+                continue
+            t0 = time.monotonic()
+            try:
+                res = nh.bounded_read(shard_id, query,
+                                      bound_ticks=bound_ticks)
+                self.read_router.observe(key, time.monotonic() - t0)
+                self._count_read(PATH_BOUNDED)
+                self._staleness.observe(res.staleness_ticks)
+                res.host = key
+                return res
+            except ReadUnsupported:
+                self._read_event(shard_id,
+                                 f"bounded->leader: {key} unsupported")
+                return self._read_linearizable(shard_id, query, deadline)
+            except StaleBoundExceeded as e:
+                # not a latency fault — the replica is out of leader
+                # contact; bias away AND record the shed evidence
+                self._count_read("bounded_shed")
+                self._read_event(
+                    shard_id, f"bounded shed: {key}: {e}")
+                self.read_router.penalize(key)
+                tried.add(key)
+                shed_exc = e
+            except Exception as e:  # noqa: BLE001 — replica dark
+                self.read_router.penalize(key)
+                tried.add(key)
+                last_exc = e
 
     # -- overload evidence -----------------------------------------------------
     def _record_shed(self, shard_id: int, reason: str) -> None:
@@ -818,7 +1008,12 @@ class Gateway:
             "shed_dumps": self.admission.dumps,
             "lease_reads": self._lease_reads.value,
             "read_fallbacks": self._fallback_reads.value,
+            # per-consistency-path serve counts + the router's observed
+            # per-replica p99 (the read plane's ledger row inputs)
+            "read_paths": dict(self._read_paths),
+            "read_p99_by_host": self.read_router.snapshot(),
             "route_table": self.routes.table(),
+            "replica_table": self.routes.replica_table(),
             # the commit path's live latency picture, as the scenario
             # ledger samples it per phase (docs/SCENARIO.md): p99 is the
             # budget's sliding-window estimate (bootstrap until any

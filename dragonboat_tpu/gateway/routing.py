@@ -46,6 +46,14 @@ class RoutingCache:
         # fresh dict under _lock and swap the reference; readers load
         # self._table once and use it lock-free
         self._table: Dict[int, str] = {}
+        # shard_id -> tuple of host keys carrying a replica (the read
+        # plane's fan-out set, docs/READPLANE.md).  Same copy-on-write
+        # discipline as _table.  Staleness is safe the same way the
+        # leader table's is: a host that no longer carries the shard
+        # fails the read (ShardNotFound), the router penalizes it and
+        # the next refresh drops it — never a wrong VALUE, only a
+        # wasted attempt.
+        self._replicas: Dict[int, tuple] = {}
         nop = _Nop()
         self.hits = metrics.counter("gateway_route_hits_total") if metrics else nop
         self.misses = metrics.counter("gateway_route_misses_total") if metrics else nop
@@ -61,6 +69,10 @@ class RoutingCache:
             self.hits.add()
         return host
 
+    def replicas(self, shard_id: int) -> tuple:  # gateway-hot
+        """Known replica-host set, or ().  NO locking (see lookup)."""
+        return self._replicas.get(shard_id, ())
+
     # -- write paths (cold: event-driven, not per-request) ---------------
     def learn(self, shard_id: int, host: str) -> None:
         with self._lock:
@@ -68,7 +80,15 @@ class RoutingCache:
             t[shard_id] = host
             self._table = t
 
+    def learn_replicas(self, shard_id: int, hosts) -> None:
+        with self._lock:
+            r = dict(self._replicas)
+            r[shard_id] = tuple(hosts)
+            self._replicas = r
+
     def invalidate(self, shard_id: int) -> None:
+        # leader route only: the replica set stays — followers still
+        # serve reads through a leadership change (that's the point)
         with self._lock:
             if shard_id not in self._table:
                 return
@@ -77,22 +97,46 @@ class RoutingCache:
             self._table = t
         self.invalidations.add()
 
+    def invalidate_replicas(self, shard_id: int) -> None:
+        with self._lock:
+            if shard_id not in self._replicas:
+                return
+            r = dict(self._replicas)
+            del r[shard_id]
+            self._replicas = r
+
     def invalidate_all(self) -> None:
         with self._lock:
             n = len(self._table)
             self._table = {}
+            self._replicas = {}
         if n:
             self.invalidations.add(n)
 
     def refresh_from_view(self, view) -> None:
-        """Bulk refresh from a balance ``ClusterView`` (leader_map).
+        """Bulk refresh from a balance ``ClusterView``: leader_map for
+        the proposal route, per-shard member hosts (intersected with
+        the view's ALIVE hosts) for the read plane's replica sets.
         View entries WIN over cached ones — the collector's snapshot is
-        newer than any event we might have missed."""
+        newer than any event we might have missed; a shard's replica
+        set is REPLACED wholesale so removed members drop out."""
         lm = view.leader_map()
+        live = set(view.hosts)
+        reps = {
+            s.shard_id: tuple(h for h in s.member_hosts() if h in live)
+            for s in view.shards
+        }
         with self._lock:
             t = dict(self._table)
             t.update(lm)
             self._table = t
+            r = dict(self._replicas)
+            for sid, hs in reps.items():
+                if hs:
+                    r[sid] = hs
+                else:
+                    r.pop(sid, None)
+            self._replicas = r
 
     # -- event tap (one closure per registered host) ----------------------
     def host_tap(self, host_key: str) -> Callable:
@@ -119,6 +163,9 @@ class RoutingCache:
                 sid = getattr(info, "shard_id", None)
                 if sid is not None:
                     self.invalidate(sid)
+                    # membership is about to change: rediscover the
+                    # replica set rather than read from a leaver
+                    self.invalidate_replicas(sid)
 
         return tap
 
@@ -144,9 +191,36 @@ class RoutingCache:
                 continue
         return None
 
+    def resolve_replicas(self, shard_id: int) -> tuple:
+        """Replica-host set with one discovery sweep on miss: every
+        live host that carries the shard (``_get_node`` answers) is a
+        serving replica.  Works for in-proc hosts and remote handles
+        alike (the remote probes its cached STATS rows).  Learned sets
+        stick until a balance move or a view refresh replaces them."""
+        reps = self.replicas(shard_id)
+        if reps:
+            return reps
+        self.misses.add()
+        found = []
+        for key, nh in sorted(self._hosts().items()):
+            if getattr(nh, "_closed", False):
+                continue
+            try:
+                nh._get_node(shard_id)
+                found.append(key)
+            except Exception:  # noqa: BLE001 — shard not on this host
+                continue
+        if found:
+            self.learn_replicas(shard_id, found)
+        return tuple(found)
+
     def table(self) -> Dict[int, str]:
         """Snapshot for observability/tests."""
         return dict(self._table)
+
+    def replica_table(self) -> Dict[int, tuple]:
+        """Snapshot for observability/tests."""
+        return dict(self._replicas)
 
 
 class _Nop:

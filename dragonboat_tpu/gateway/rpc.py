@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import socket
+import struct
 import threading
 import time
 from typing import Dict, Optional
@@ -49,6 +50,13 @@ from ..nodehost import (
     RequestTerminated,
     TimeoutError_,
     _CODE_ERRORS,
+)
+from ..readplane import (
+    BOUND_TICKS_DEFAULT,
+    PATH_BOUNDED,
+    ReadResult,
+    ReadUnsupported,
+    StaleBoundExceeded,
 )
 from ..request import (
     RequestError,
@@ -67,15 +75,19 @@ from ..transport.wire import (
     RPC_ERR_DENIED,
     RPC_ERR_NO_LEASE,
     RPC_ERR_NOT_FOUND,
+    RPC_ERR_STALE_BOUND,
     RPC_OP_FAULT,
     RPC_OP_PROPOSE,
     RPC_OP_READ,
     RPC_OP_SESSION_CLOSE,
     RPC_OP_SESSION_OPEN,
     RPC_OP_STATS,
+    RPC_READ_BOUNDED,
+    RPC_READ_FOLLOWER,
     RPC_READ_INDEX,
     RPC_READ_LEASE,
     RPC_READ_STALE,
+    RPC_STATS_READ_PATHS,
     RpcRequest,
     RpcResponse,
     WireError,
@@ -309,9 +321,14 @@ class RpcServer:
                 nh.sync_close_session(s, timeout=timeout)
                 return RpcResponse(req_id=q.req_id, code=_COMPLETED)
             if q.op == RPC_OP_STATS:
+                rp = None
+                if q.flags & RPC_STATS_READ_PATHS:
+                    fn = getattr(nh, "read_path_counts", None)
+                    rp = fn() if callable(fn) else {}
                 data = encode_rpc_stats(
                     getattr(nh, "nodehost_id", "") or "",
                     nh.raft_address(), nh.balance_shard_stats(),
+                    read_paths=rp,
                 )
                 return RpcResponse(req_id=q.req_id, code=_COMPLETED,
                                    data=data)
@@ -355,6 +372,29 @@ class RpcServer:
             val = nh.sync_read(q.shard_id, query, timeout=timeout)
         elif q.flags == RPC_READ_STALE:
             val = nh.stale_read(q.shard_id, query)
+        elif q.flags == RPC_READ_FOLLOWER:
+            # ReadIndex round via the leader, served from THIS host's
+            # state machine; value = applied index (the stamp)
+            val, applied = nh.follower_read(q.shard_id, query,
+                                            timeout=timeout)
+            return RpcResponse(req_id=q.req_id, code=_COMPLETED,
+                               value=applied, data=encode_rpc_value(val))
+        elif q.flags == RPC_READ_BOUNDED:
+            try:
+                res = nh.bounded_read(
+                    q.shard_id, query,
+                    bound_ticks=q.arg or BOUND_TICKS_DEFAULT,
+                )
+            except StaleBoundExceeded as e:
+                return RpcResponse(req_id=q.req_id,
+                                   code=RPC_ERR_STALE_BOUND,
+                                   error=str(e) or "stale bound exceeded")
+            # stamp rides value (applied) + a u32 staleness prefix on
+            # data — binary, so bytes-typed SM values survive intact
+            data = struct.pack("<I", res.staleness_ticks)
+            data += encode_rpc_value(res.value)
+            return RpcResponse(req_id=q.req_id, code=_COMPLETED,
+                               value=res.applied_index, data=data)
         else:
             return RpcResponse(req_id=q.req_id, code=RPC_ERR,
                                error=f"unknown read mode {q.flags}")
@@ -500,10 +540,11 @@ class RemoteHostHandle:
         self._pending: Dict[int, _RemoteCall] = {}
         self._req_seq = 0
         self._closed_flag = False
-        # stats snapshot (balance rows + remote identity)
+        # stats snapshot (balance rows + remote identity + read paths)
         self._stats_rows = None
         self._stats_nhid = ""
         self._stats_raft = ""
+        self._stats_read_paths: Dict[str, int] = {}
         self._stats_t = 0.0
 
     # -- liveness ---------------------------------------------------------
@@ -730,6 +771,12 @@ class RemoteHostHandle:
                 raise RpcLeaseNotHeld(p.error or "lease not held")
             if p.code == RPC_ERR_DENIED:
                 raise RpcDenied(p.error or "denied")
+            if p.code == RPC_ERR_STALE_BOUND:
+                raise StaleBoundExceeded(p.error or "stale bound exceeded")
+            if p.code == RPC_ERR and "unknown read mode" in p.error:
+                # pre-readplane server: the caller degrades to a
+                # leader read (docs/READPLANE.md "Version skew")
+                raise ReadUnsupported(p.error)
             raise RequestError(p.error or _err_name(p.code))
         if code == RequestResultCode.COMPLETED:
             return rc.result
@@ -801,6 +848,38 @@ class RemoteHostHandle:
         result = self._finish(rc, self._stats_timeout + 0.5)
         return decode_rpc_value(result.data)
 
+    def follower_read(self, shard_id: int, query, timeout: float = 5.0):
+        """(value, applied_index) served from the REMOTE host's state
+        machine after its ReadIndex round — the NodeHost.follower_read
+        surface over the wire.  Raises ReadUnsupported against a
+        pre-readplane server (caller degrades to a leader read)."""
+        rc = self._submit(
+            RPC_OP_READ, flags=RPC_READ_FOLLOWER, shard_id=shard_id,
+            timeout=timeout, payload=encode_rpc_value(query),
+        )
+        result = self._finish(rc, timeout + 0.5)
+        return decode_rpc_value(result.data), result.value
+
+    def bounded_read(self, shard_id: int, query,
+                     bound_ticks: int = BOUND_TICKS_DEFAULT) -> ReadResult:
+        """Bounded-staleness read off the remote's local state; the
+        stamp rides value (applied) + a u32 staleness prefix on data.
+        Raises StaleBoundExceeded on a shed, ReadUnsupported against a
+        pre-readplane server."""
+        rc = self._submit(
+            RPC_OP_READ, flags=RPC_READ_BOUNDED, shard_id=shard_id,
+            timeout=self._stats_timeout, arg=bound_ticks,
+            payload=encode_rpc_value(query),
+        )
+        result = self._finish(rc, self._stats_timeout + 0.5)
+        if len(result.data) < 4:
+            raise RequestError("bounded read: short stamp")
+        (staleness,) = struct.unpack_from("<I", result.data, 0)
+        return ReadResult(
+            decode_rpc_value(result.data[4:]), PATH_BOUNDED,
+            applied_index=result.value, staleness_ticks=staleness,
+        )
+
     def get_noop_session(self, shard_id: int) -> Session:
         return Session.noop(shard_id)
 
@@ -828,15 +907,26 @@ class RemoteHostHandle:
         rows = self._stats_rows
         if rows is not None and time.monotonic() - self._stats_t < age:
             return rows
-        rc = self._submit(RPC_OP_STATS, timeout=self._stats_timeout)
+        rc = self._submit(RPC_OP_STATS, flags=RPC_STATS_READ_PATHS,
+                          timeout=self._stats_timeout)
         result = self._finish(rc, self._stats_timeout + 0.5)
-        nhid, raft, rows = decode_rpc_stats(result.data)
+        nhid, raft, rows, read_paths = decode_rpc_stats(result.data)
         with self._lock:
             self._stats_nhid = nhid
             self._stats_raft = raft
             self._stats_rows = rows
+            self._stats_read_paths = read_paths
             self._stats_t = time.monotonic()
         return rows
+
+    def read_path_counts(self) -> Dict[str, int]:
+        """The remote's per-path read serve counts (empty against a
+        pre-readplane server — the section is flag-gated)."""
+        try:
+            self._stats()
+        except (RequestError, OSError):
+            pass
+        return dict(self._stats_read_paths)
 
     def balance_shard_stats(self) -> list:
         # the Collector's feed: always a fresh snapshot (its own cadence

@@ -1400,6 +1400,46 @@ class Node:
         left — the gateway's fast-read gate."""
         return self.lease_remaining_ticks() > margin_ticks
 
+    def bounded_read_probe(self, bound_ticks: int) -> tuple:
+        """BOUNDED_STALENESS serving gate (readplane/,
+        docs/READPLANE.md): returns ``(ok, applied_index,
+        staleness_ticks)``.  ``ok`` means this replica may serve a
+        local read stamped ``staleness_ticks`` stale without exceeding
+        ``bound_ticks``:
+
+        * a leader serves at staleness 0 (its state is current);
+        * a follower serves iff it has a leader, heard from it within
+          ``bound_ticks`` (``election_tick`` resets on leader traffic),
+          AND has applied everything up to the leader's last-known
+          UNCAPPED commit (``Raft.leader_commit_hint``) — fresh
+          heartbeats alone must not let a still-recovering replica
+          serve arbitrarily old state as "bounded".
+
+        Lock-free probe off producer threads, same contract as
+        ``lease_remaining_ticks``: every read is one GIL-atomic load
+        and a state change right after a True answer is absorbed by the
+        bound itself (the stamp is conservative — staleness can only
+        have been SMALLER when the fields were loaded)."""
+        if self.stopped or self.stopping:
+            return False, 0, 0
+        r = self.peer.raft
+        applied = self.sm.last_applied
+        try:
+            if self.peer.is_leader():
+                return True, applied, 0
+            if r.leader_id == 0:
+                return False, applied, bound_ticks + 1
+            staleness = r.election_tick
+            if staleness > bound_ticks:
+                return False, applied, staleness
+            if applied < r.leader_commit_hint:
+                return False, applied, staleness
+            return True, applied, staleness
+        except Exception:  # noqa: BLE001 — racing a concurrent step's
+            # mutation (same guard as lease_remaining_ticks): shed this
+            # probe rather than serve on torn state
+            return False, applied, bound_ticks + 1
+
     # ------------------------------------------------------------------
     def get_membership(self) -> Membership:
         return self.sm.get_membership()

@@ -30,6 +30,12 @@ from .pb import (
 )
 from .pb import Message
 from .raftio import LeaderInfo, NodeInfoEvent
+from .readplane import (
+    BOUND_TICKS_DEFAULT,
+    Consistency,
+    ReadResult,
+    StaleBoundExceeded,
+)
 from .request import (
     RequestError,
     RequestResultCode,
@@ -145,6 +151,21 @@ class NodeHost:
             # metrics exist before everything that registers series
             # (event fanout, per-target breakers, the engine)
             self.metrics = MetricsRegistry(enabled=config.enable_metrics)
+            # readplane per-path read counters (docs/READPLANE.md).
+            # Plain dict bumps: observability only, and a GIL-preempted
+            # lost increment is the same benign race every other scrape
+            # surface here accepts — no lock on the read hot paths.
+            self._read_paths: Dict[str, int] = {
+                "lease": 0, "read_index": 0, "follower": 0,
+                "bounded": 0, "bounded_shed": 0,
+            }
+            # pre-resolved labeled counters: counter() takes the
+            # registry lock; resolving once keeps the per-read cost at
+            # one dict load + one GIL-atomic add
+            self._read_counters = {
+                p: self.metrics.counter("nodehost_read_total", {"path": p})
+                for p in self._read_paths
+            }
             # observability (obs/, docs/OBSERVABILITY.md): both gates
             # default off and leave the attribute None — every hot-path
             # check is one attribute load
@@ -726,10 +747,89 @@ class NodeHost:
     def sync_read(self, shard_id: int, query, timeout: float = 5.0):
         rs = self.read_index(shard_id, timeout)
         _check(rs.wait(timeout), rs)
+        self._count_read("read_index")
         return self._get_node(shard_id).lookup(query)
 
     def stale_read(self, shard_id: int, query):
         return self._get_node(shard_id).stale_read(query)
+
+    def _count_read(self, path: str) -> None:
+        self._read_paths[path] = self._read_paths.get(path, 0) + 1
+        c = self._read_counters.get(path)
+        if c is not None:
+            c.add()
+
+    def read_path_counts(self) -> Dict[str, int]:
+        """Cumulative reads served per readplane path on this host
+        (lease / read_index / follower / bounded / bounded_shed) —
+        surfaced through RPC STATS and the readplane smoke."""
+        return dict(self._read_paths)
+
+    def follower_read(self, shard_id: int, query, timeout: float = 5.0):
+        """FOLLOWER_LINEARIZABLE: run the ReadIndex confirmation round
+        through the leader (the raft layer forwards when this replica
+        is a follower), wait until the local RSM has applied past the
+        confirmed index, then serve from the LOCAL state machine.
+        Returns ``(value, applied_index)``.  Linearizable — safety
+        argument in docs/READPLANE.md; a leadership change mid-round
+        fails the future fast (Raft.drop_pending_read_indexes) so the
+        caller re-confirms instead of trusting a deposed leader."""
+        rs = self.read_index(shard_id, timeout)
+        _check(rs.wait(timeout), rs)
+        node = self._get_node(shard_id)
+        value = node.lookup(query)
+        self._count_read("follower")
+        return value, node.sm.last_applied
+
+    def bounded_read(
+        self, shard_id: int, query, bound_ticks: int = BOUND_TICKS_DEFAULT
+    ) -> ReadResult:
+        """BOUNDED_STALENESS: serve immediately from the local state
+        machine, stamped with the applied index and staleness in ticks;
+        raise :class:`StaleBoundExceeded` when the replica cannot prove
+        the stamp stays within ``bound_ticks`` (Node.bounded_read_probe
+        has the gate)."""
+        node = self._get_node(shard_id)
+        ok, applied, staleness = node.bounded_read_probe(bound_ticks)
+        if not ok:
+            self._count_read("bounded_shed")
+            raise StaleBoundExceeded(
+                f"shard {shard_id}: staleness {staleness} ticks exceeds "
+                f"bound {bound_ticks}"
+            )
+        value = node.lookup(query)
+        self._count_read("bounded")
+        return ReadResult(
+            value=value, path="bounded",
+            applied_index=applied, staleness_ticks=staleness,
+        )
+
+    def read_at_replica(
+        self,
+        shard_id: int,
+        query,
+        consistency: Consistency = Consistency.LINEARIZABLE,
+        timeout: float = 5.0,
+        bound_ticks: int = BOUND_TICKS_DEFAULT,
+        lease_margin_ticks: int = 2,
+    ) -> ReadResult:
+        """One explicit-consistency read against THIS host's replica
+        (docs/READPLANE.md; the cross-replica routing lives in the
+        gateway).  LINEARIZABLE tries the lease fast path and falls
+        back to the ReadIndex quorum round; the other levels map to
+        :meth:`follower_read` / :meth:`bounded_read`."""
+        if consistency == Consistency.FOLLOWER_LINEARIZABLE:
+            value, applied = self.follower_read(shard_id, query, timeout)
+            return ReadResult(
+                value=value, path="follower", applied_index=applied
+            )
+        if consistency == Consistency.BOUNDED_STALENESS:
+            return self.bounded_read(shard_id, query, bound_ticks)
+        ok, value = self.try_lease_read(shard_id, query, lease_margin_ticks)
+        if ok:
+            return ReadResult(value=value, path="lease")
+        value = self.sync_read(shard_id, query, timeout)
+        return ReadResult(value=value, path="read_index")
 
     def try_lease_read(
         self, shard_id: int, query, margin_ticks: int = 2
@@ -747,6 +847,7 @@ class NodeHost:
         node = self._get_node(shard_id)
         if not node.lease_held(margin_ticks):
             return False, None
+        self._count_read("lease")
         return True, node.lookup(query)
 
     def lease_status(self, shard_id: int) -> dict:

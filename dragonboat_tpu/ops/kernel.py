@@ -632,6 +632,10 @@ def _broadcast_heartbeat(st, out, mask, hint=0, hint_high=0) -> DeviceOut:
             to=st.peer_id[p],
             term=st.term,
             commit=jnp.minimum(st.match[p], st.committed),
+            # uncapped commit advisory for the follower's
+            # leader_commit_hint (oracle: broadcast_heartbeat's
+            # log_index; unused by HEARTBEAT handling proper)
+            log_index=st.committed,
             hint=hint,
             hint_high=hint_high,
         )

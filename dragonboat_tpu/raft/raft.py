@@ -12,7 +12,7 @@ trace replayed against the device kernel produces bit-identical behavior
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import settings
 from ..logger import get_logger
@@ -85,6 +85,7 @@ class Raft:
         "leader_id", "log", "remotes", "non_votings", "witnesses",
         "addresses", "role", "votes", "msgs", "ready_to_reads",
         "dropped_entries", "dropped_read_indexes", "read_index",
+        "forwarded_reads", "leader_commit_hint",
         "election_tick", "heartbeat_tick", "randomized_election_timeout",
         "_timeout_seq", "leader_transfer_target", "pending_config_change",
         "is_leader_transfer_target", "snapshotting", "tick_count",
@@ -144,6 +145,25 @@ class Raft:
         self.dropped_entries: List[Entry] = []
         self.dropped_read_indexes: List[SystemCtx] = []
         self.read_index = ReadIndex()
+        # follower-side ReadIndex forwarding ledger: ctx key -> the
+        # leader the confirmation round was sent to.  The readplane's
+        # follower-linearizable path depends on a LEADERSHIP-CHANGE
+        # ABORT: a confirmation obtained from a deposed leader must
+        # never serve a read after a new leader may have committed past
+        # it, so any leader change (new leader observed, leaderless
+        # window, own candidacy) fails these ctxs fast via
+        # dropped_read_indexes instead of leaving them to deadline GC
+        # (docs/READPLANE.md "Follower-read safety").
+        self.forwarded_reads: Dict[Tuple[int, int], int] = {}
+        # the leader's commit index as LAST HEARD, uncapped — the
+        # follower's own log.committed is min'd with its last index, so
+        # a catching-up replica's local commit understates how far
+        # behind its state is.  BOUNDED_STALENESS serving requires
+        # applied >= this hint: fresh heartbeats alone must not let a
+        # recovering follower serve months-old state as "bounded"
+        # (docs/READPLANE.md).  Monotone per leadership; _reset floors
+        # it back to the local commit.
+        self.leader_commit_hint = 0
 
         self.election_tick = 0
         self.heartbeat_tick = 0
@@ -353,6 +373,7 @@ class Raft:
         self.pending_config_change = False
         self.read_index.clear()
         self.drop_pending_read_indexes()
+        self.leader_commit_hint = self.log.committed
         last = self.log.last_index()
         for pid, rm in self.all_remotes().items():
             rm.reset(last + 1)
@@ -389,6 +410,11 @@ class Raft:
         self.role = RaftRole.PRE_CANDIDATE
         self.votes = {}
         self.leader_id = NO_LEADER
+        # prevote skips _reset, so the forwarded-read abort must fire
+        # here: the election timeout that made us a pre-candidate is
+        # exactly the "leader may be gone" signal the readplane's
+        # follower-linearizable path must not read through
+        self.drop_pending_read_indexes()
         self.election_tick = 0
         self._reset_randomized_timeout()
         assert self.term == role_term
@@ -548,6 +574,13 @@ class Raft:
                     type=MessageType.HEARTBEAT,
                     to=pid,
                     commit=min(rm.match, self.log.committed),
+                    # log_index is unused by HEARTBEAT handling proper:
+                    # it carries the UNCAPPED commit as an advisory for
+                    # the follower's leader_commit_hint (the capped
+                    # commit above understates for a behind follower,
+                    # which would let its bounded reads serve stale
+                    # state as fresh).  Never fed to commit_to.
+                    log_index=self.log.committed,
                     hint=ctx.low if ctx else 0,
                     hint_high=ctx.high if ctx else 0,
                 )
@@ -1143,6 +1176,18 @@ class Raft:
             _log.debug("candidate dropping %s", t.name)
 
     # -- follower ---------------------------------------------------------
+    def _observe_leader(self, lid: int) -> None:
+        """Follower saw leader traffic from ``lid``.  A SWITCH from a
+        different known leader (possible without a local term bump when
+        this replica missed the election entirely) aborts every
+        confirmation round forwarded to the old leader — its answer may
+        predate the new leader's commits (readplane leadership-change
+        abort; the term-bump path is covered by _reset)."""
+        if self.leader_id != lid and self.leader_id != NO_LEADER:
+            self.drop_pending_read_indexes()
+            self.leader_commit_hint = self.log.committed
+        self.leader_id = lid
+
     def _step_follower(self, m: Message) -> None:
         t = m.type
         if t == MessageType.PROPOSE:
@@ -1155,15 +1200,22 @@ class Raft:
             )
         elif t == MessageType.REPLICATE:
             self.election_tick = 0
-            self.leader_id = m.from_
+            self._observe_leader(m.from_)
+            if m.commit > self.leader_commit_hint:
+                self.leader_commit_hint = m.commit
             self._handle_replicate(m)
         elif t == MessageType.HEARTBEAT:
             self.election_tick = 0
-            self.leader_id = m.from_
+            self._observe_leader(m.from_)
+            # m.log_index = the leader's uncapped commit advisory (see
+            # broadcast_heartbeat); m.commit is capped at our match
+            hint = m.log_index if m.log_index > m.commit else m.commit
+            if hint > self.leader_commit_hint:
+                self.leader_commit_hint = hint
             self._handle_heartbeat(m)
         elif t == MessageType.INSTALL_SNAPSHOT:
             self.election_tick = 0
-            self.leader_id = m.from_
+            self._observe_leader(m.from_)
             self._handle_install_snapshot(m)
         elif t == MessageType.READ_INDEX:
             if self.role in (RaftRole.NON_VOTING,):
@@ -1185,7 +1237,22 @@ class Raft:
                     hint_high=m.hint_high,
                 )
             )
+            # ledger the in-flight confirmation round so a leadership
+            # change aborts it (drop_pending_read_indexes).  Bounded: a
+            # lost READ_INDEX_RESP leaves an entry behind until the
+            # next leader change, so shed the oldest past a soft cap —
+            # dropping early is safe (the future fails fast, client
+            # retries) while a silent leak is not.
+            fr = self.forwarded_reads
+            fr[(m.hint, m.hint_high)] = self.leader_id
+            if len(fr) > 4096:
+                for key in list(fr)[:1024]:
+                    del fr[key]
+                    self.dropped_read_indexes.append(
+                        SystemCtx(low=key[0], high=key[1])
+                    )
         elif t == MessageType.READ_INDEX_RESP:
+            self.forwarded_reads.pop((m.hint, m.hint_high), None)
             self.ready_to_reads.append(
                 ReadyToRead(
                     index=m.log_index,
@@ -1379,7 +1446,16 @@ class Raft:
     # output draining (used by Peer.get_update)
     # ------------------------------------------------------------------
     def drop_pending_read_indexes(self) -> None:
-        pass
+        """Fail every ReadIndex confirmation round this replica has
+        forwarded to a leader (follower side of the readplane's
+        leadership-change abort; the leader side's own pending table is
+        ``read_index.clear()``).  Dropping is always safe — the caller's
+        future fails fast and the client re-confirms against the current
+        leader instead of trusting a deposed one's answer."""
+        if self.forwarded_reads:
+            for low, high in self.forwarded_reads:
+                self.dropped_read_indexes.append(SystemCtx(low=low, high=high))
+            self.forwarded_reads.clear()
 
     def drain_messages(self) -> List[Message]:
         out = self.msgs

@@ -52,10 +52,13 @@ class ProcFleet:
     """N procworker children + the client-side planes over them."""
 
     def __init__(self, n: int = 3, *, workdir: str = "/tmp/mpday",
-                 base_port: int = 29650, fresh: bool = True):
+                 base_port: int = 29650, fresh: bool = True,
+                 shards: int = 1, rpc_inflight: int = 64):
         self.n = n
         self.workdir = workdir
         self.base_port = base_port
+        self.shards = shards
+        self.rpc_inflight = rpc_inflight
         self.procs: Dict[int, subprocess.Popen] = {}
         self.handles: Dict[str, RemoteHostHandle] = {}
         self.ready: Dict[int, dict] = {}
@@ -74,6 +77,8 @@ class ProcFleet:
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["DRAGONBOAT_PROC_SHARDS"] = str(self.shards)
+        env["DRAGONBOAT_PROC_RPC_INFLIGHT"] = str(self.rpc_inflight)
         return subprocess.Popen(
             [sys.executable, "-m", "dragonboat_tpu.scenario.procworker",
              str(idx), str(self.n), self.workdir, str(self.base_port)],

@@ -25,7 +25,11 @@ Two gears:
 The five disturbance classes (every gear fires each at least once):
 ``rolling_restart``, ``leader_churn``, ``stream_chaos``, ``drain``,
 ``dr_cycle`` — see docs/SCENARIO.md for the class catalog and the
-ledger each phase emits.
+ledger each phase emits.  ``read_hot`` is a TRAFFIC-SHAPE phase, not a
+disturbance class (ROADMAP 5c): a zipfian hot-key read storm against
+the audited shard, split across the read plane's consistency levels
+(docs/READPLANE.md) — its ledger row carries the observed read-path
+split.
 """
 from __future__ import annotations
 
@@ -55,7 +59,7 @@ class Phase:
 
     ``action`` names an orchestrator maneuver the runner executes
     (``rolling_restart`` / ``catchup_chaos`` / ``drain`` / ``dr_cycle``
-    or empty for traffic-only phases); ``faults`` is a nemesis
+    / ``read_hot`` or empty for traffic-only phases); ``faults`` is a nemesis
     sub-plan executed via :meth:`FaultController.run_phase` before the
     action; ``duration`` is the minimum wall time of the phase (traffic
     keeps flowing until it elapses, so even a fast action yields a
@@ -171,6 +175,21 @@ class DayPlan:
                 action="dr_cycle",
                 params=_p(shard=SH_MEM),
             ),
+            # traffic shape, not a disturbance: the zipfian read storm
+            # lands AFTER the DR cycle so follower/bounded reads are
+            # served by the re-imported membership (the hard case)
+            Phase(
+                "read_hot",
+                duration=round(1.5 * sc, 3),
+                action="read_hot",
+                params=_p(
+                    keys=24,
+                    skew=j(1.1, 1.5),
+                    readers=3,
+                    bound_ticks=100,
+                    shard=SH_MEM,
+                ),
+            ),
             Phase("cooldown", duration=round(2.0 * sc, 3)),
         ]
         return DayPlan(seed=seed, gear="mini", phases=phases)
@@ -274,5 +293,20 @@ class DayPlan:
                     params=_p(shard=SH_MEM),
                 )
             )
+        # one zipfian read storm per day (traffic shape, no fault class)
+        phases.append(
+            Phase(
+                "read_hot",
+                duration=30.0,
+                action="read_hot",
+                params=_p(
+                    keys=24,
+                    skew=j(1.1, 1.5),
+                    readers=4,
+                    bound_ticks=100,
+                    shard=SH_MEM,
+                ),
+            )
+        )
         phases.append(Phase("cooldown", duration=15.0))
         return DayPlan(seed=seed, gear="full", phases=phases)

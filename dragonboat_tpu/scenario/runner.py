@@ -33,6 +33,7 @@ from .. import settings
 from ..audit import (
     AuditReport,
     audit_set_cmd,
+    check_bounded_reads,
     check_linearizable,
     check_sessions,
     check_stale_reads,
@@ -50,6 +51,7 @@ from ..faults import (
 from ..gateway import GatewayBusy
 from ..logger import get_logger
 from ..obs import record_all
+from ..readplane import Consistency
 from .fleet import CORE, LAGGARD, SPARE, WITNESS, DayFleet
 from .plan import SH_DISK, SH_MEM, DayPlan, Phase
 from .report import DayReport
@@ -418,6 +420,8 @@ class ScenarioRunner:
             return self._drain(phase)
         if a == "dr_cycle":
             return self._dr_cycle(phase)
+        if a == "read_hot":
+            return self._read_hot(phase)
         raise ValueError(f"unknown phase action {a!r}")
 
     def _sla(self, shard: int, fault_class: str) -> None:
@@ -636,6 +640,114 @@ class ScenarioRunner:
                 self.rec.fail(op)
         return {"events": 1, "dr_index": manifest.index}
 
+    def _read_hot(self, phase: Phase) -> Dict[str, object]:
+        """The zipfian read storm (ROADMAP 5c, traffic shape): hot-key
+        skewed readers hammer one shard through the gateway's read
+        plane, split across FOLLOWER_LINEARIZABLE / BOUNDED_STALENESS /
+        LINEARIZABLE (docs/READPLANE.md).  Follower reads join the
+        Wing-Gong history as plain "r" ops — the offline audit, not
+        this method, is the safety argument; bounded reads carry their
+        stamp in ``op.value`` for check_bounded_reads.  The ledger row
+        carries the observed read-path split; a storm that never
+        reached a replica-served path is a failed phase, not a quiet
+        row."""
+        import bisect
+
+        fleet = self.fleet
+        gw = fleet.gateway
+        shard = int(phase.param("shard", SH_MEM))
+        n_keys = int(phase.param("keys", 24))
+        skew = float(phase.param("skew", 1.2))
+        readers = int(phase.param("readers", 3))
+        bound = int(phase.param("bound_ticks", 100))
+        burst = max(0.8, float(phase.duration))
+        fleet.wait_for_leader(shard)
+        # zipf CDF over key ranks: m:k0 is the hot key (same key space
+        # the writers churn, so the storm joins a contended history)
+        w = [1.0 / (r ** skew) for r in range(1, n_keys + 1)]
+        tot = sum(w)
+        cdf: List[float] = []
+        acc = 0.0
+        for x in w:
+            acc += x / tot
+            cdf.append(acc)
+        rp0 = dict(gw.stats()["read_paths"])
+        stop_at = time.monotonic() + burst
+        hot_hits = [0] * readers
+
+        def storm(idx: int) -> None:
+            rng = Random(12_000 + idx)
+            cid = self.rec.new_client()
+            while time.monotonic() < stop_at:
+                key = f"m:k{bisect.bisect_left(cdf, rng.random())}"
+                if key == "m:k0":
+                    hot_hits[idx] += 1
+                roll = rng.random()
+                if roll < 0.3:
+                    op = self.rec.invoke(cid, "bounded", key)
+                    try:
+                        res = gw.read_at(
+                            shard, key,
+                            consistency=Consistency.BOUNDED_STALENESS,
+                            timeout=2.0, bound_ticks=bound,
+                        )
+                        op.value = (
+                            res.applied_index, res.staleness_ticks, bound
+                        )
+                        v = res.value
+                        if isinstance(v, bytes):
+                            v = v.decode()
+                        self.rec.ok(op, output=v)
+                    except Exception:  # noqa: BLE001 — shed/outage
+                        self.rec.fail(op)
+                    continue
+                level = (
+                    Consistency.FOLLOWER_LINEARIZABLE
+                    if roll < 0.8 else Consistency.LINEARIZABLE
+                )
+                op = self.rec.invoke(cid, "r", key)
+                try:
+                    res = gw.read_at(
+                        shard, key, consistency=level, timeout=2.0
+                    )
+                    v = res.value
+                    if isinstance(v, bytes):
+                        v = v.decode()
+                    self.rec.ok(op, output=v)
+                except Exception:  # noqa: BLE001 — reads fail clean
+                    self.rec.fail(op)
+
+        threads = [
+            threading.Thread(
+                target=storm, args=(i,), daemon=True,
+                name=f"tpu-day-readhot-{i}",
+            )
+            for i in range(readers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=burst + 30.0)
+        rp1 = gw.stats()["read_paths"]
+        split = {
+            k: max(0, rp1.get(k, 0) - rp0.get(k, 0)) for k in rp1
+        }
+        served = sum(
+            split.get(p, 0)
+            for p in ("lease", "read_index", "follower", "bounded")
+        )
+        if not (split.get("follower") and split.get("bounded")):
+            raise RecoverySLAViolation(
+                "read-hot storm never reached the replica read paths: "
+                f"split={split}"
+            )
+        return {
+            "events": 1,
+            "reads": served,
+            "read_paths": split,
+            "hot_key_reads": sum(hot_hits),
+        }
+
     # ------------------------------------------------------------------
     # verdicts
     # ------------------------------------------------------------------
@@ -656,6 +768,7 @@ class ScenarioRunner:
             linearizability=check_linearizable(ops),
             stale=check_stale_reads(ops),
             sessions=sessions,
+            bounded=check_bounded_reads(ops),
         )
         counts = self.rec.counts()
         self.report.audit = {

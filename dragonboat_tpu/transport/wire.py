@@ -687,6 +687,19 @@ RPC_OP_FAULT = 6
 RPC_READ_LEASE = 0   # lease fast path ONLY; ERR_NO_LEASE when not held
 RPC_READ_INDEX = 1   # full ReadIndex quorum read
 RPC_READ_STALE = 2   # local stale read (no linearizability)
+# readplane consistency byte (docs/READPLANE.md).  Old servers answer
+# unknown flags with code=RPC_ERR "unknown read mode N" — the client's
+# readplane router treats that as ReadUnsupported and degrades to a
+# leader read, so mixed-version fleets stay correct.
+RPC_READ_FOLLOWER = 3  # follower-linearizable: ReadIndex round via the
+                       # leader, served from the LOCAL state machine
+RPC_READ_BOUNDED = 4   # bounded staleness: local read stamped with the
+                       # applied index; arg = bound in ticks, shed past it
+
+# STATS request flag: append the read-path serve counts as a trailing
+# payload section.  Flag-gated because OLD decoders reject trailing
+# bytes — a new server must never send the section unsolicited.
+RPC_STATS_READ_PATHS = 1
 
 # response codes: 0..6 are RequestResultCode values verbatim; the 0x60
 # block is transport/ingress-level outcomes that have no node-side code
@@ -695,6 +708,7 @@ RPC_ERR_NOT_FOUND = 0x61  # shard not on this host / host closed
 RPC_ERR_NO_LEASE = 0x62   # lease-only read: lease not held, fall back
 RPC_ERR = 0x63            # anything else (error string carries detail)
 RPC_ERR_DENIED = 0x64     # op not allowed (fault ops on a prod server)
+RPC_ERR_STALE_BOUND = 0x65  # BOUNDED read shed: staleness past the bound
 
 _RPC_MAX_CMD = 8 * 1024 * 1024  # per-request payload bound (ingress)
 
@@ -882,12 +896,19 @@ def decode_rpc_value(data: bytes):
     return v
 
 
-def encode_rpc_stats(nodehost_id: str, raft_address: str, rows) -> bytes:
+def encode_rpc_stats(nodehost_id: str, raft_address: str, rows,
+                     read_paths=None) -> bytes:
     """STATS response payload: the host identity plus its
     ``balance_shard_stats()`` rows (membership included), so the
     balance Collector — and through it the gossip-routed gateway's
     RoutingCache — works over RemoteHostHandles with zero shared
-    memory."""
+    memory.
+
+    ``read_paths`` (path label -> serve count, NodeHost.
+    read_path_counts) is a TRAILING section appended only when the
+    CLIENT asked for it (RPC_STATS_READ_PATHS in the request flags):
+    old decoders reject trailing bytes, so the server must never send
+    it unsolicited — flag-gating keeps both skew directions green."""
     b = BytesIO()
     _ws(b, nodehost_id)
     _ws(b, raft_address)
@@ -901,6 +922,11 @@ def encode_rpc_stats(nodehost_id: str, raft_address: str, rows) -> bytes:
         # it in u64 without a sign convention on the wire
         _wu64(b, int(row.get("device", -1)) + 1)
         _w_membership(b, row["membership"])
+    if read_paths is not None:
+        _wu32(b, len(read_paths))
+        for k in sorted(read_paths):
+            _ws(b, k)
+            _wu64(b, read_paths[k])
     return b.getvalue()
 
 
@@ -928,6 +954,14 @@ def decode_rpc_stats(data: bytes):
             "device": device,
             "membership": membership,
         })
+    # optional read-path section (present iff the request asked for it
+    # AND the server knows how to send it — an old server just ends
+    # here and the caller sees empty counts)
+    read_paths = {}
+    if r.pos != len(data):
+        for _ in range(r.count()):
+            k = r.s()
+            read_paths[k] = r.u64()
     if r.pos != len(data):
         raise WireError(f"trailing bytes: {len(data) - r.pos}")
-    return nodehost_id, raft_address, rows
+    return nodehost_id, raft_address, rows, read_paths

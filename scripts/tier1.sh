@@ -24,6 +24,10 @@
 # the cross-process RPC smoke (scripts/rpc_smoke.sh, ~5-8s: a real
 # two-OS-process fleet over RPC/TCP + gossip, leader SIGKILLed and
 # recovered under SLA, routing reconverged with zero shared memory)
+# the read-plane smoke (scripts/readplane_smoke.sh, ~3s: 3-replica
+# shard behind the gateway, one read per consistency level with the
+# follower path actually taken, full audit incl. the bounded-read
+# containment pass green)
 # and the static-analysis gates + analyzer
 # self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
 # Prints
@@ -49,5 +53,6 @@ timeout -k 10 120 bash scripts/updatelanes_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 240 bash scripts/multichip_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/scenario_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/rpc_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/readplane_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

@@ -110,6 +110,11 @@ class TestThreadLeaks:
 
 
 class TestProfiling:
+    # tier-1 budget repair (PR 17): ~30s of pure profiler start/stop for
+    # a feature smoke (an xplane file appears) that gates no correctness
+    # path — the annotate/trace wrappers themselves are trivial.  Runs
+    # in the slow tier.
+    @pytest.mark.slow
     def test_trace_produces_xplane(self, tmp_path):
         """SURVEY §5.1: the kernel is traceable via the JAX profiler."""
         import glob

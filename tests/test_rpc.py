@@ -130,7 +130,7 @@ class TestRpcCodecs:
             "membership": Membership(config_change_id=4,
                                      addresses={1: "a", 2: "b"}),
         }]
-        nhid, raft, drows = decode_rpc_stats(
+        nhid, raft, drows, rp = decode_rpc_stats(
             encode_rpc_stats("nhid-x", "127.0.0.1:1", rows))
         assert (nhid, raft) == ("nhid-x", "127.0.0.1:1")
         r = drows[0]
@@ -138,6 +138,14 @@ class TestRpcCodecs:
                   "applied", "proposals", "device"):
             assert r[k] == rows[0][k], k
         assert r["membership"].addresses == {1: "a", 2: "b"}
+        # legacy payload (no trailing section) decodes to empty counts
+        assert rp == {}
+        # flag-gated read-path section roundtrips
+        counts = {"lease": 3, "follower": 9, "bounded": 1}
+        _, _, _, rp2 = decode_rpc_stats(
+            encode_rpc_stats("nhid-x", "127.0.0.1:1", rows,
+                             read_paths=counts))
+        assert rp2 == counts
 
 
 # ---------------------------------------------------------------------------

@@ -460,6 +460,14 @@ class TestMiniDay:
         sc = next(p for p in r.phases if p["name"] == "stream_chaos")
         if sc["stream_kills"]:
             assert sc["stream_resumes"] >= 1, sc
+        # the zipfian read-hot storm (traffic shape, docs/READPLANE.md)
+        # served real replica reads AFTER the DR cycle, and its ledger
+        # row carries the read-path split the runner asserted on
+        rh = next(p for p in r.phases if p["name"] == "read_hot")
+        assert rh["read_paths"]["follower"] >= 1, rh
+        assert rh["read_paths"]["bounded"] >= 1, rh
+        assert rh["reads"] >= rh["read_paths"]["follower"]
+        assert rh["hot_key_reads"] >= 1, rh
         # the JSON emit round-trips
         import json
 
