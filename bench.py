@@ -1069,6 +1069,18 @@ def phase_day(seed: int = 7, scale: float = 0.6) -> dict:
 
     plan = DayPlan.mini(seed, scale=scale)
     r = ScenarioRunner(plan, tag=f"bench-day-{seed}").run()
+    # the elastic loop's own ledger surface: load-driven moves fired,
+    # the hot shard's p99 at the storm peak vs after the move, shed
+    # delta over the storm window (ISSUE 18 acceptance numbers)
+    el = next((p for p in r.phases if p.get("name") == "elastic"), {})
+    elastic = {
+        "moves": el.get("events", 0),
+        "quiet_moves": el.get("quiet_moves", 0),
+        "p99_storm_ms": round(el.get("p99_storm_s", 0.0) * 1000, 1),
+        "p99_after_ms": round(el.get("p99_after_s", 0.0) * 1000, 1),
+        "shed_delta": el.get("shed_delta", 0),
+        "colocated_leaders": bool(el.get("colocated_leaders", False)),
+    }
     return {
         "ok": r.ok,
         "seed": seed,
@@ -1078,6 +1090,7 @@ def phase_day(seed: int = 7, scale: float = 0.6) -> dict:
         "fault_dips": {k: round(v, 3) for k, v in r.fault_dips.items()},
         "recovery": r.recovery,
         "disturbances_fired": r.disturbances_fired,
+        "elastic": elastic,
         "audit_ok": bool(r.audit.get("ok", False)),
         "ops_ok": r.audit.get("ops", {}).get("ok", 0),
         "aborted": r.aborted,

@@ -9,14 +9,16 @@ and a :class:`MoveExecutor` realizing each move as the safe
 add -> catchup -> transfer -> remove sequence with rollback.
 :class:`Balancer` is the public handle.  See docs/BALANCE.md.
 """
-from .balancer import Balancer, DrainTimeout
+from .balancer import Balancer, DrainTimeout, HotTracker, LoadPolicy
 from .executor import BalanceAborted, MoveExecutor, MoveFailed
 from .planner import Move, MovePlan, Planner
-from .view import ClusterView, Collector, ReplicaView, ShardView
+from .view import ClusterView, Collector, ReplicaView, ShardLoad, ShardView
 
 __all__ = [
     "Balancer",
     "DrainTimeout",
+    "HotTracker",
+    "LoadPolicy",
     "BalanceAborted",
     "MoveExecutor",
     "MoveFailed",
@@ -26,5 +28,6 @@ __all__ = [
     "ClusterView",
     "Collector",
     "ReplicaView",
+    "ShardLoad",
     "ShardView",
 ]

@@ -22,19 +22,95 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
 from ..events import EventFanout
 from ..logger import get_logger
 from .executor import MoveExecutor, MoveFailed
 from .planner import MovePlan, Planner
-from .view import ClusterView, Collector
+from .view import ClusterView, Collector, ShardLoad
 
 _log = get_logger("balance")
 
 
 class DrainTimeout(Exception):
     """drain() did not converge within its deadline."""
+
+
+@dataclass(frozen=True)
+class LoadPolicy:
+    """Thresholds + thrash guards for the load-feedback mode
+    (docs/BALANCE.md "Load-reactive rebalancing").
+
+    A shard is HOT in one window when its observed commit p99 crosses
+    ``hot_p99_s`` (with at least ``min_samples`` samples backing the
+    estimate — a two-sample p99 is noise) OR the gateway shed at least
+    ``hot_shed`` requests for it this window.  ``hot_submit`` adds an
+    absolute submit-delta trigger, disabled by default (0).  A hot
+    reading only FIRES a move after ``hysteresis`` consecutive hot
+    windows, and a fired shard then cools for ``cooldown`` windows —
+    counted in PASSES, not wall time, per the determinism rule (the
+    planner and faults planes ban wall clocks; the Balancer's pass
+    cadence is the one legitimate clock here).  ``max_moves`` clamps
+    each firing pass."""
+
+    hot_p99_s: float = 0.25
+    hot_shed: int = 8
+    hot_submit: int = 0
+    min_samples: int = 12
+    hysteresis: int = 3
+    cooldown: int = 6
+    max_moves: int = 1
+
+    def is_hot(self, row: ShardLoad) -> bool:
+        if row.samples >= self.min_samples and (
+                row.p99_ms >= int(self.hot_p99_s * 1000)):
+            return True
+        if self.hot_shed and row.shed >= self.hot_shed:
+            return True
+        if self.hot_submit and row.submitted >= self.hot_submit:
+            return True
+        return False
+
+
+class HotTracker:
+    """Pure hysteresis/cooldown state machine over per-pass hot sets
+    (unit-tested in isolation — tests/test_balance.py).  ``observe``
+    takes the shards hot THIS pass and returns the sorted subset whose
+    hot streak just reached the hysteresis bar and that are not
+    cooling; ``fired`` starts their cooldown."""
+
+    def __init__(self, hysteresis: int = 3, cooldown: int = 6):
+        self.hysteresis = max(1, hysteresis)
+        self.cooldown = max(0, cooldown)
+        self._streak: Dict[int, int] = {}
+        self._cooling: Dict[int, int] = {}
+
+    def observe(self, hot_now) -> list:
+        hot_now = set(hot_now)
+        for sid in list(self._streak):
+            if sid not in hot_now:
+                del self._streak[sid]
+        fire = []
+        for sid in sorted(hot_now):
+            self._streak[sid] = self._streak.get(sid, 0) + 1
+            if sid in self._cooling:
+                continue
+            if self._streak[sid] >= self.hysteresis:
+                fire.append(sid)
+        # cooldown ages at the END of the pass so cooldown=N suppresses
+        # exactly N subsequent passes
+        for sid in list(self._cooling):
+            self._cooling[sid] -= 1
+            if self._cooling[sid] <= 0:
+                del self._cooling[sid]
+        return fire
+
+    def fired(self, shard_ids) -> None:
+        for sid in shard_ids:
+            self._cooling[sid] = self.cooldown
+            self._streak.pop(sid, None)
 
 
 class Balancer:
@@ -53,6 +129,7 @@ class Balancer:
         catchup_timeout: float = 30.0,
         catchup_gap: int = 0,
         alive: Optional[Callable] = None,
+        load_policy: Optional[LoadPolicy] = None,
     ):
         self.hosts: Dict[str, object] = dict(hosts or {})
         self.seed = seed
@@ -78,6 +155,15 @@ class Balancer:
         )
         # nemesis plug point (FaultController.install_balancer)
         self.fault_injector = None
+        # load-feedback mode (docs/BALANCE.md "Load-reactive
+        # rebalancing"): hysteresis state + the most recent pass report
+        self.load_policy = load_policy or LoadPolicy()
+        self._hot = HotTracker(
+            hysteresis=self.load_policy.hysteresis,
+            cooldown=self.load_policy.cooldown,
+        )
+        self._load_moves = self.metrics.counter("balance_load_moves_total")
+        self.last_load_report: dict = {}
         # the most recent pass's final collect (see _rebalance_locked)
         self._last_view: Optional[ClusterView] = None
         # shard -> consecutive passes its membership showed an all-live
@@ -204,6 +290,66 @@ class Balancer:
         self._last_view = view
         return {"planned": len(plan), "executed": executed, "failed": failed}
 
+    # -- load-feedback mode ---------------------------------------------
+    def set_load_policy(self, policy: LoadPolicy) -> None:
+        """Swap the load policy AND reset the hysteresis tracker (a
+        policy change mid-streak would make stale streaks fire under
+        thresholds they never saw)."""
+        self.load_policy = policy
+        self._hot = HotTracker(
+            hysteresis=policy.hysteresis, cooldown=policy.cooldown
+        )
+
+    def attach_load_source(self, fn: Callable[[], Dict[int, dict]]) -> None:
+        """Wire the serving plane's evidence (``Gateway.shard_load``)
+        into the collector; subsequent views carry per-shard load rows
+        and ``load_rebalance_once`` can react to them."""
+        self.collector.load_source = fn
+
+    def load_rebalance_once(self) -> dict:
+        """One load-feedback pass: collect (with load rows), classify
+        hot shards against the policy, advance the hysteresis tracker,
+        and — only for shards whose hot streak reached the bar — plan a
+        seeded ``spread_hot`` pass and execute it with the normal move
+        discipline (one move at a time, fresh view after each,
+        rollback in the executor).  Fired shards start their cooldown
+        whether their move succeeded or not: hammering a shard whose
+        move just failed is exactly the thrash the guard exists for."""
+        with self._pass_lock:
+            return self._load_rebalance_locked()
+
+    def _load_rebalance_locked(self) -> dict:
+        pol = self.load_policy
+        view = self.view()
+        hot_now = [l.shard_id for l in view.load if pol.is_hot(l)]
+        fire = self._hot.observe(hot_now)
+        report = {
+            "hot": sorted(hot_now), "fired": list(fire),
+            "planned": 0, "executed": 0, "failed": 0, "moves": [],
+        }
+        if fire:
+            plan = self.planner.plan_spread_hot(
+                view, fire, max_moves=pol.max_moves
+            )
+            report["planned"] = len(plan)
+            self.executor.fault_injector = self.fault_injector
+            for move in plan:
+                if self._stop.is_set():
+                    break
+                try:
+                    self.executor.execute(move, view)
+                    report["executed"] += 1
+                    self._load_moves.add()
+                    report["moves"].append(move.describe())
+                except MoveFailed as e:
+                    report["failed"] += 1
+                    _log.warning("load move failed: %s", e)
+                view = self.view()
+            self._last_view = view
+            self._hot.fired([m.shard_id for m in plan])
+        self.last_load_report = report
+        return report
+
     def drain(self, key: str, *, timeout: float = 120.0,
               settle_passes: int = 1) -> dict:
         """Drain a host: mark it, then rebalance until it holds zero
@@ -251,22 +397,28 @@ class Balancer:
         last["passes"] = passes
         return last
 
-    def run(self, interval: float = 0.5) -> None:
-        """Start the continuous rebalancing loop on a daemon thread."""
+    def run(self, interval: float = 0.5, *,
+            load_feedback: bool = False) -> None:
+        """Start the continuous rebalancing loop on a daemon thread.
+        With ``load_feedback=True`` each pass also runs the
+        load-reactive pass (requires an attached load source; a pass
+        without load rows is a no-op)."""
         with self._lock:
             if self._run_thread is not None:
                 raise RuntimeError("balancer already running")
             self._stop.clear()
             self._run_thread = threading.Thread(
-                target=self._run_main, args=(interval,), daemon=True,
-                name="tpu-raft-balancer",
+                target=self._run_main, args=(interval, load_feedback),
+                daemon=True, name="tpu-raft-balancer",
             )
             self._run_thread.start()
 
-    def _run_main(self, interval: float) -> None:
+    def _run_main(self, interval: float, load_feedback: bool = False) -> None:
         while not self._stop.wait(interval):
             try:
                 self.rebalance_once()
+                if load_feedback:
+                    self.load_rebalance_once()
             except Exception:  # noqa: BLE001 — the loop must survive a bad pass
                 _log.exception("rebalance pass raised")
 

@@ -344,3 +344,85 @@ class Planner:
                 if not moved:
                     break
         return MovePlan(moves)
+
+    # -- load-reactive pass (docs/BALANCE.md "Load-reactive rebalancing")
+    def plan_spread_hot(
+        self, view: ClusterView, hot_shards, *, max_moves: int = 1
+    ) -> MovePlan:
+        """Seeded, pure spread-hot pass: for each HOT shard (the
+        Balancer's hysteresis-vetted set), move its leader off the
+        current leader host onto the coldest target host — by pure
+        ``transfer`` when the shard already has a member there (the
+        cheap move), else by ``replace`` of the leader replica (the
+        executor's replace realizes the leadership handoff).  Ranking
+        is combined leader+member pressure (leaders weigh 1000x: the
+        serving plane's commit path runs through leaders), ties break
+        through ``_pick_least_loaded``'s seeded shuffle, and projected
+        counts advance per move so multiple hot shards cannot dogpile
+        one cold host.  ``max_moves`` clamps the whole pass — the
+        thrash guard's last line."""
+        rng = Random(self.seed)
+        targets = view.target_hosts()
+        moves: List[Move] = []
+        if not targets or max_moves < 1:
+            return MovePlan(moves)
+        chips = {h: view.chips_of(h) for h in targets}
+        if all(n <= 1 for n in chips.values()):
+            chips = None
+        counts = {h: 0 for h in targets}
+        leaders = {h: 0 for h in targets}
+        next_id: Dict[int, int] = {}
+        placement: Dict[int, Dict[str, int]] = {}
+        for s in view.shards:
+            placement[s.shard_id] = {h: rid for rid, h in s.members}
+            next_id[s.shard_id] = s.next_replica_id
+            for _, h in s.members:
+                if h in counts:
+                    counts[h] += 1
+            if s.leader_host in leaders:
+                leaders[s.leader_host] += 1
+
+        def pressure():
+            return {h: leaders[h] * 1_000 + counts[h] for h in targets}
+
+        for shard_id in sorted(set(hot_shards)):
+            if len(moves) >= max_moves:
+                break
+            s = view.shard(shard_id)
+            if s is None or not s.leader_host or s.leader_host not in counts:
+                continue
+            pl = placement[shard_id]
+            cold = self._pick_least_loaded(
+                pressure(), {s.leader_host}, rng, chips
+            )
+            if cold is None:
+                continue
+            # already the coldest placement: moving gains nothing (and
+            # a transfer to an equally-hot host would just thrash)
+            if (leaders[cold] * 1_000 + counts[cold]
+                    >= leaders[s.leader_host] * 1_000 + counts[s.leader_host]):
+                continue
+            if cold in pl:
+                moves.append(Move(
+                    kind="transfer", shard_id=shard_id,
+                    src_host=s.leader_host,
+                    src_replica_id=pl.get(s.leader_host, 0),
+                    dst_host=cold, new_replica_id=pl[cold],
+                ))
+            else:
+                new_rid = next_id[shard_id]
+                next_id[shard_id] = new_rid + 1
+                moves.append(Move(
+                    kind="replace", shard_id=shard_id,
+                    src_host=s.leader_host,
+                    src_replica_id=pl.get(s.leader_host, 0),
+                    dst_host=cold, new_replica_id=new_rid,
+                ))
+                pl.pop(s.leader_host, None)
+                pl[cold] = new_rid
+                if s.leader_host in counts:
+                    counts[s.leader_host] -= 1
+                counts[cold] += 1
+            leaders[s.leader_host] -= 1
+            leaders[cold] += 1
+        return MovePlan(moves)

@@ -88,6 +88,29 @@ class ShardView:
 
 
 @dataclass(frozen=True)
+class ShardLoad:
+    """One shard's serving-plane load evidence over the last collect
+    window (docs/BALANCE.md "Load-reactive rebalancing").  ``p99_ms``
+    is the gateway's observed commit p99 rounded to whole milliseconds
+    (integers keep describe() byte-stable); ``submitted``/``shed`` are
+    WINDOW DELTAS — the Collector differences the gateway's cumulative
+    counters with the same first-sight baseline it uses for
+    proposal_rate."""
+
+    shard_id: int
+    p99_ms: int = 0
+    samples: int = 0
+    submitted: int = 0
+    shed: int = 0
+
+    def describe(self) -> str:
+        return (
+            f"load({self.shard_id},p99={self.p99_ms}ms,"
+            f"n={self.samples},sub={self.submitted},shed={self.shed})"
+        )
+
+
+@dataclass(frozen=True)
 class ClusterView:
     """One collector pass over the whole cluster."""
 
@@ -99,6 +122,16 @@ class ClusterView:
     # multi-chip placement dimension (docs/MULTICHIP.md "Placement").
     # Default empty keeps single-chip fleets byte-identical.
     chips: Tuple[Tuple[str, int], ...] = ()
+    # per-shard serving-plane load evidence (sorted by shard_id; empty
+    # when no load source is attached — the default keeps existing
+    # describe() baselines byte-identical, same opt-in as chips)
+    load: Tuple[ShardLoad, ...] = ()
+
+    def load_of(self, shard_id: int) -> Optional[ShardLoad]:
+        for l in self.load:
+            if l.shard_id == shard_id:
+                return l
+        return None
 
     def chips_of(self, host: str) -> int:
         for h, n in self.chips:
@@ -153,11 +186,16 @@ class ClusterView:
         chips = ""
         if any(n > 1 for _, n in self.chips):
             chips = f" chips={sorted(self.chips)!r}"
-        return (
+        body = (
             f"hosts={list(self.hosts)!r} draining={list(self.draining)!r}"
             f"{chips}\n"
             + "\n".join(s.describe() for s in self.shards)
         )
+        # load rows follow the chips opt-in: only emitted when a load
+        # source is attached, so pre-elastic baselines stay byte-exact
+        if self.load:
+            body += "\n" + ",".join(l.describe() for l in self.load)
+        return body
 
 
 class Collector:
@@ -171,8 +209,18 @@ class Collector:
     (``lambda key: nhid(key) in gm.alive_peers()``).
     """
 
-    def __init__(self, alive: Optional[Callable[[str, object], bool]] = None):
+    def __init__(
+        self,
+        alive: Optional[Callable[[str, object], bool]] = None,
+        load_source: Optional[Callable[[], Dict[int, dict]]] = None,
+    ):
         self._alive = alive
+        # serving-plane evidence hook (``Gateway.shard_load``): absent
+        # by default so membership-only deployments build byte-identical
+        # views; failures degrade to "no load rows" (placement must
+        # never depend on the gateway being up)
+        self.load_source = load_source
+        self._prev_load: Dict[int, Tuple[int, int]] = {}
         self._prev_proposals: Dict[int, int] = {}
         # hosts that reported last round: a host dropping out (liveness
         # flap, mid-collect failure) makes the round incomplete for the
@@ -300,9 +348,32 @@ class Collector:
                 n = 1
             if n > 1:
                 chips.append((key, n))
+        load_rows = []
+        if self.load_source is not None:
+            try:
+                raw = self.load_source() or {}
+            except Exception:  # noqa: BLE001 — gateway closing mid-collect
+                raw = {}
+            for sid in sorted(raw):
+                row = raw[sid]
+                sub = int(row.get("submitted", 0))
+                shed = int(row.get("shed", 0))
+                # first-sight baseline = current totals (delta 0), the
+                # proposal_rate idiom; gateway counters are cumulative
+                # and monotonic so the baseline always advances
+                psub, pshed = self._prev_load.get(sid, (sub, shed))
+                self._prev_load[sid] = (sub, shed)
+                load_rows.append(ShardLoad(
+                    shard_id=sid,
+                    p99_ms=int(round(float(row.get("p99_s", 0.0)) * 1000)),
+                    samples=int(row.get("samples", 0)),
+                    submitted=max(0, sub - psub),
+                    shed=max(0, shed - pshed),
+                ))
         return ClusterView(
             hosts=tuple(alive),
             draining=tuple(sorted(set(draining))),
             shards=tuple(shard_views),
             chips=tuple(sorted(chips)),
+            load=tuple(load_rows),
         )

@@ -210,6 +210,7 @@ class FaultPlan:
         stream_addrs: Sequence[str] = (),
         stream_recv_addrs: Sequence[str] = (),
         asym_pairs: Sequence[str] = (),
+        balance_shards: Sequence[int] = (),
         rounds: int = 8,
         mean_gap: float = 0.8,
         mean_duration: float = 0.8,
@@ -231,7 +232,10 @@ class FaultPlan:
         ``asym_pairs`` (``asym_pair(src, dst)`` strings) adds the
         directional wire kinds to the pool — same opt-in discipline:
         omitting it keeps every pre-existing seeded schedule
-        byte-identical."""
+        byte-identical.  ``balance_shards`` adds ``balance_move``
+        (race ONE planner move against the schedule; the consumer must
+        have called ``install_balancer``) with its own shard target
+        pool — opt-in like every knob before it."""
         rng = Random(seed)
         addrs = list(addrs)
         stream_pool = list(stream_addrs) + [
@@ -250,6 +254,8 @@ class FaultPlan:
             kinds += ["snapshot_stream_kill", "snapshot_stream_stall"]
         if asym_pairs:
             kinds += ["asym_drop", "asym_delay"]
+        if balance_shards:
+            kinds.append("balance_move")
         t = 0.0
         faults: List[Fault] = []
         for _ in range(rounds):
@@ -293,12 +299,13 @@ class FaultPlan:
                     )
                 )
             elif kind in CHURN_KINDS:
+                pool = balance_shards if kind == "balance_move" else churn_shards
                 faults.append(
                     Fault(
                         kind,
                         at=t,
                         duration=max(0.4, dur) if kind != "leader_transfer" else 0.0,
-                        targets=(rng.choice(list(churn_shards)),),
+                        targets=(rng.choice(list(pool)),),
                     )
                 )
             elif kind in STREAM_KINDS:

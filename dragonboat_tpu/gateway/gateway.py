@@ -117,6 +117,23 @@ class GatewayConfig:
         self.cap_feedback_interval = cap_feedback_interval
 
 
+class _ShardLoadState:
+    """Per-shard overload evidence (docs/BALANCE.md "Load-reactive
+    rebalancing"): an observed-latency budget plus cumulative
+    submit/shed counters, read by ``Gateway.shard_load`` and consumed
+    by the balance Collector as window deltas.  The counters follow the
+    read-path convention — lock-free-ish increments, nothing depends on
+    them exactly — and the budget window is deliberately small (128)
+    so a post-move latency picture flushes the storm's tail quickly."""
+
+    __slots__ = ("budget", "submitted", "shed")
+
+    def __init__(self):
+        self.budget = LatencyBudget(bootstrap=0.25, floor=0.05, window=128)
+        self.submitted = 0
+        self.shed = 0
+
+
 class GatewayFuture:
     """Completion future for one gateway proposal."""
 
@@ -262,6 +279,11 @@ class Gateway:
             for p in self._read_paths
         }
         self.read_router = ReadRouter()
+        # per-shard overload evidence for the elastic balance loop
+        # (created lazily on first touch; the lock guards only dict
+        # insertion — counter bumps are lock-free-ish by convention)
+        self._shard_load: Dict[int, _ShardLoadState] = {}
+        self._shard_load_lock = threading.Lock()
         self._staleness = self.metrics.histogram(
             "readplane_staleness_ticks", bounds=STALENESS_TICK_BOUNDS
         )
@@ -532,6 +554,7 @@ class Gateway:
         if reason is not None:
             self._record_shed(handle.shard_id, reason)
             raise GatewayBusy(f"shed: {reason} (shard {handle.shard_id})")
+        self._shard_load_state(handle.shard_id).submitted += 1
         req = _GwReq(handle, cmd, deadline)
         with handle._lock:
             if handle._inflight:
@@ -701,6 +724,7 @@ class Gateway:
             if req.handle.is_exactly_once():
                 req.handle.session.proposal_completed()
             self.budget.observe(lat)
+            self._shard_load_state(req.handle.shard_id).budget.observe(lat)
             with self._done_lock:
                 self._latency.observe(lat)
                 self._committed.add()
@@ -969,7 +993,32 @@ class Gateway:
                 last_exc = e
 
     # -- overload evidence -----------------------------------------------------
+    def _shard_load_state(self, shard_id: int) -> _ShardLoadState:
+        st = self._shard_load.get(shard_id)
+        if st is None:
+            with self._shard_load_lock:
+                st = self._shard_load.setdefault(shard_id, _ShardLoadState())
+        return st
+
+    def shard_load(self) -> Dict[int, dict]:
+        """Per-shard overload evidence for the elastic balance loop:
+        observed commit p99 (seconds, this gateway's view), sample
+        count, and CUMULATIVE submitted/shed counts — the Collector
+        turns the cumulative counters into per-window deltas with the
+        same first-sight baseline it uses for proposal rates."""
+        out = {}
+        for sid in sorted(self._shard_load):
+            st = self._shard_load[sid]
+            out[sid] = {
+                "p99_s": st.budget.p99(),
+                "samples": st.budget.samples(),
+                "submitted": st.submitted,
+                "shed": st.shed,
+            }
+        return out
+
     def _record_shed(self, shard_id: int, reason: str) -> None:
+        self._shard_load_state(shard_id).shed += 1
         rec = self._shed_recorder  # one attribute load on the hot path
         if rec is not None:
             rec.record(shard_id, "gateway_shed", reason)

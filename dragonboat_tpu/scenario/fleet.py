@@ -47,16 +47,29 @@ SLOTS = CORE + (WITNESS, LAGGARD, SPARE)
 WITNESS_RID = 4
 LAGGARD_RID = 5
 
+# the colocated fleet member: one CORE host whose replicas (both
+# shards) step through a shared ColocatedEngineGroup — the product
+# device path riding the SAME scheduled churn as everyone else
+COLO_SLOT = "h2"
+# small ring window (entry-cache depth 256/shard) — the geometry the
+# colocated chaos suite pins, reused here so the jit cache is warm
+COLO_GEOM = dict(capacity=16, P=5, W=8, M=8, E=4, O=32, budget=4)
+
 
 class DayFleet:
     """See module docstring.  ``tag`` namespaces transport addresses
     and on-disk dirs so concurrent fleets (tests) never collide."""
 
     def __init__(self, seed: int = 0, *, tag: str = "day",
-                 workdir: str = "/tmp"):
+                 workdir: str = "/tmp", colocated: bool = False):
         self.seed = seed
         self.tag = tag
         self.workdir = workdir
+        self.colocated = colocated
+        # slot -> ColocatedEngineGroup, REUSED across that slot's
+        # restarts (the chaos-tested path: state generations + WAL
+        # replay re-attach the restarted replicas to the live group)
+        self._colo_groups: Dict[str, object] = {}
         self.addrs: Dict[str, str] = {s: f"{tag}-{s}" for s in SLOTS}
         self.slots: Dict[str, str] = {a: s for s, a in self.addrs.items()}
         self.hosts: Dict[str, NodeHost] = {}
@@ -107,22 +120,52 @@ class DayFleet:
             election_rtt=20, heartbeat_rtt=2, check_quorum=True,
         )
 
+    def _colo_group(self, slot: str):
+        group = self._colo_groups.get(slot)
+        if group is None:
+            from ..ops.colocated import ColocatedEngineGroup
+
+            group = ColocatedEngineGroup(**COLO_GEOM)
+            self._colo_groups[slot] = group
+        return group
+
     def _make_host(self, slot: str) -> NodeHost:
         # single-shard engine pools: six hosts run on one box, and the
         # day's realism comes from plane interleaving, not from intra-
         # host engine parallelism — fewer threads keep tick cadence
         # honest under the GIL
+        expert = ExpertConfig(
+            engine=EngineConfig(exec_shards=1, apply_shards=1)
+        )
+        if self.colocated and slot == COLO_SLOT:
+            # the factory is NODEHOST-level: every replica this host
+            # runs (both shards) steps on the shared device group
+            expert = ExpertConfig(
+                engine=EngineConfig(exec_shards=1, apply_shards=1),
+                step_engine_factory=self._colo_group(slot).factory,
+            )
         return NodeHost(
             NodeHostConfig(
                 nodehost_dir=self._dir(slot),
                 rtt_millisecond=5,
                 raft_address=self.addrs[slot],
                 enable_flight_recorder=True,
-                expert=ExpertConfig(
-                    engine=EngineConfig(exec_shards=1, apply_shards=1)
-                ),
+                expert=expert,
             )
         )
+
+    def colo_stats(self) -> Dict[str, int]:
+        """Device-path counters from the colocated member's group —
+        device_rows_stepped proves the launch pipeline actually rode
+        the device, divergence_halts must stay zero through churn."""
+        out: Dict[str, int] = {}
+        for group in self._colo_groups.values():
+            core = group.core
+            if core is None:
+                continue
+            for k, v in dict(core.stats).items():
+                out[k] = out.get(k, 0) + int(v)
+        return out
 
     def build(self) -> None:
         from ..transport.inproc import reset_inproc_network
@@ -176,6 +219,9 @@ class DayFleet:
         self.gateway = Gateway(
             dict(self.hosts), GatewayConfig(workers=2, default_timeout=4.0)
         )
+        # close the loop: the balancer's collector reads the gateway's
+        # per-shard latency/shed evidence (ClusterView.load rows)
+        self.balancer.attach_load_source(self.gateway.shard_load)
 
     def _add_member(self, shard: int, rid: int, slot: str, kind: str) -> None:
         from ..client import call_with_retry

@@ -357,69 +357,102 @@ class _Traffic:
             self._stop.wait(0.03)
 
 
+def _mp_proc_kill(fleet: ProcFleet, phase, report: dict) -> None:
+    """Real whole-host kill: SIGKILL the leader's process, require
+    recovery inside the SLA, restart over the same dirs and wait until
+    the victim answers stats over RPC again (catch-up observed from
+    the outside)."""
+    sla_ticks = int(phase.param("sla_ticks", 4000))
+    victim = fleet.leader_slot()
+    fleet.kill(victim)
+    t0 = time.monotonic()
+    assert_recovery_sla(
+        _sla_hosts(fleet), SHARD, sla_ticks=sla_ticks,
+        cmd=audit_set_cmd("sla-kill", "probe"), rtt_ms=20,
+        per_try_timeout=1.0, fault_class=phase.fault_class,
+    )
+    report["sla"][phase.fault_class] = round(time.monotonic() - t0, 3)
+    fleet.restart(victim)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            if fleet.handle(victim).balance_shard_stats():
+                break
+        except Exception:  # noqa: BLE001 — still replaying/joining
+            pass
+        time.sleep(0.2)
+
+
+def _mp_asym_partition(fleet: ProcFleet, phase, report: dict) -> None:
+    """Directional wire fault between real processes: the leader's
+    sends toward one follower vanish (or crawl) while the reverse
+    direction flows — the half-open link, held for the plan's window,
+    then healed with the recovery SLA asserted after the heal.  The
+    victims (who leads, which follower is struck) are runtime-sampled;
+    the plan pins only kind/p/window."""
+    kind = str(phase.param("kind", "asym_drop"))
+    p = float(phase.param("p", 1.0))
+    window = float(phase.param("window", 1.5))
+    sla_ticks = int(phase.param("sla_ticks", 4000))
+    leader = fleet.leader_slot()
+    follower = next(i for i in fleet.live_slots() if i != leader)
+    if kind == "asym_delay":
+        fleet.set_asym_delay(
+            leader, follower, float(phase.param("delay", 0.2)), p=p
+        )
+    else:
+        fleet.set_asym_drop(leader, follower, p=p)
+    time.sleep(window)  # let the one-way window bite under traffic
+    fleet.heal_wire(leader)
+    t0 = time.monotonic()
+    assert_recovery_sla(
+        _sla_hosts(fleet), SHARD, sla_ticks=sla_ticks,
+        cmd=audit_set_cmd("sla-asym", "probe"), rtt_ms=20,
+        per_try_timeout=1.0, fault_class=kind,
+    )
+    report["sla"][kind] = round(time.monotonic() - t0, 3)
+    # routing reconverges purely off gossip + stats
+    gw = fleet.gateway
+    deadline = time.time() + 20
+    while gw.routes.lookup(SHARD) is None and time.time() < deadline:
+        time.sleep(0.1)
+    assert gw.routes.lookup(SHARD) is not None, "route never reconverged"
+
+
 def run_mini_multiproc_day(n: int = 3, *, workdir: str = "/tmp/mpday",
-                           base_port: int = 29650) -> dict:
-    """The acceptance scenario: a 3-process fleet serves open-loop
-    gateway traffic; the leader's process takes a real SIGKILL and the
-    fleet recovers inside the SLA; an asymmetric one-way drop is
-    injected and healed with routing reconverging; the full client
-    history passes the linearizability + stale-read audit."""
+                           base_port: int = 29650, seed: int = 11) -> dict:
+    """The acceptance scenario, SCHEDULE-DRIVEN: execute the seeded
+    :meth:`DayPlan.multiproc` phases over a real 3-process fleet under
+    open-loop gateway traffic — a real leader SIGKILL, then an
+    asymmetric one-way partition injected over the RPC fault op and
+    healed, each recovery under ``assert_recovery_sla``, and the full
+    client history through the Wing–Gong audit.  The plan is byte-
+    stable per seed (``report["plan"]``); victims stay runtime-sampled
+    exactly like the in-proc gears."""
+    from .plan import DayPlan
+
+    plan = DayPlan.multiproc(seed)
     fleet = ProcFleet(n, workdir=workdir, base_port=base_port)
-    report = {"sla": {}, "ops": 0, "audit": "pending"}
+    report = {
+        "sla": {}, "ops": 0, "audit": "pending",
+        "seed": seed, "plan": plan.describe(), "phases": [],
+    }
     try:
         fleet.start()
         gw = fleet.gateway
         rec = HistoryRecorder()
         traffic = _Traffic(gw, rec)
         traffic.start()
-        time.sleep(2.0)  # steady-state traffic before the first fault
-
-        # -- disturbance 1: real whole-host kill (SIGKILL the leader) --
-        victim = fleet.leader_slot()
-        fleet.kill(victim)
-        t0 = time.monotonic()
-        assert_recovery_sla(
-            _sla_hosts(fleet), SHARD, sla_ticks=4000,
-            cmd=audit_set_cmd("sla-kill", "probe"), rtt_ms=20,
-            per_try_timeout=1.0, fault_class="proc_kill9",
-        )
-        report["sla"]["proc_kill9"] = round(time.monotonic() - t0, 3)
-
-        # restart the victim over the same dirs; wait until it answers
-        # stats over RPC again (catch-up observed from outside)
-        fleet.restart(victim)
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            try:
-                if fleet.handle(victim).balance_shard_stats():
-                    break
-            except Exception:  # noqa: BLE001 — still replaying/joining
-                pass
-            time.sleep(0.2)
-
-        # -- disturbance 2: asymmetric one-way drop ---------------------
-        # the current leader's sends to one follower vanish while the
-        # reverse direction flows — the classic half-open link
-        leader = fleet.leader_slot()
-        follower = next(i for i in fleet.live_slots() if i != leader)
-        fleet.set_asym_drop(leader, follower, p=1.0)
-        time.sleep(1.5)  # let the one-way window bite under traffic
-        fleet.heal_wire(leader)
-        t0 = time.monotonic()
-        assert_recovery_sla(
-            _sla_hosts(fleet), SHARD, sla_ticks=4000,
-            cmd=audit_set_cmd("sla-asym", "probe"), rtt_ms=20,
-            per_try_timeout=1.0, fault_class="asym_drop",
-        )
-        report["sla"]["asym_drop"] = round(time.monotonic() - t0, 3)
-
-        # routing reconverges purely off gossip + stats
-        deadline = time.time() + 20
-        while gw.routes.lookup(SHARD) is None and time.time() < deadline:
-            time.sleep(0.1)
-        assert gw.routes.lookup(SHARD) is not None, "route never reconverged"
-
-        time.sleep(1.0)  # post-heal traffic tail
+        for phase in plan.phases:
+            if phase.action == "proc_kill":
+                _mp_proc_kill(fleet, phase, report)
+            elif phase.action == "asym_partition":
+                _mp_asym_partition(fleet, phase, report)
+            else:
+                # warmup/cooldown: steady-state traffic windows around
+                # the disturbances (the cooldown is the post-heal tail)
+                time.sleep(max(0.5, phase.duration))
+            report["phases"].append(phase.name)
         traffic.stop()
 
         # -- the audit: full client history, Wing–Gong ------------------

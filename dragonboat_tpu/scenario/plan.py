@@ -22,14 +22,22 @@ Two gears:
   raised to GB scale when ``DRAGONBOAT_BIGSTATE_GB=1``
   (:func:`dragonboat_tpu.bigstate.gb_tier`).
 
-The five disturbance classes (every gear fires each at least once):
+The six disturbance classes (every gear fires each at least once):
 ``rolling_restart``, ``leader_churn``, ``stream_chaos``, ``drain``,
-``dr_cycle`` — see docs/SCENARIO.md for the class catalog and the
-ledger each phase emits.  ``read_hot`` is a TRAFFIC-SHAPE phase, not a
-disturbance class (ROADMAP 5c): a zipfian hot-key read storm against
-the audited shard, split across the read plane's consistency levels
-(docs/READPLANE.md) — its ledger row carries the observed read-path
-split.
+``dr_cycle``, ``elastic`` — see docs/SCENARIO.md for the class catalog
+and the ledger each phase emits.  ``read_hot``, ``write_hot`` and
+``diurnal`` are TRAFFIC-SHAPE phases, not disturbance classes
+(ROADMAP 5c): the zipfian hot-key read/write storms against the
+audited shard and the sinusoidal offered-load swing — their ledger
+rows carry the observed split/swing.  ``elastic`` IS a class: it
+drives a zipfian write storm and REQUIRES the balancer's
+load-feedback loop to fire ≥1 move that sheds the hot shard's p99
+(docs/BALANCE.md "Load-reactive rebalancing").
+
+:meth:`DayPlan.multiproc` is the third gear (``DRAGONBOAT_MULTIPROC``):
+a short schedule over the cross-process ProcFleet, the only gear whose
+wire can express DIRECTIONAL faults — its ``asym_partition`` phase
+fires the PR 16 ``asym_drop`` kinds.
 """
 from __future__ import annotations
 
@@ -43,13 +51,14 @@ from ..faults import Fault
 SH_MEM = 1   # in-memory AuditKV: audited gateway session traffic + DR
 SH_DISK = 2  # on-disk OnDiskKV: big-state plane, witness + non-voting
 
-#: the five disturbance classes a production day must fire
+#: the six disturbance classes a production day must fire
 DISTURBANCE_CLASSES = (
     "rolling_restart",
     "leader_churn",
     "stream_chaos",
     "drain",
     "dr_cycle",
+    "elastic",
 )
 
 
@@ -190,6 +199,58 @@ class DayPlan:
                     shard=SH_MEM,
                 ),
             ),
+            # write-side zipfian skew (the read_hot mirror, ROADMAP 5c:
+            # the write half): a hot-key write storm against the
+            # audited shard — traffic shape, no fault class
+            Phase(
+                "write_hot",
+                duration=round(1.2 * sc, 3),
+                action="write_hot",
+                params=_p(
+                    keys=24,
+                    skew=j(1.1, 1.5),
+                    writers=3,
+                    shard=SH_MEM,
+                ),
+            ),
+            # sinusoidal offered-load swing (diurnal in miniature):
+            # writers modulate their pacing over `period`; the ledger
+            # row records the observed peak/trough committed rates
+            Phase(
+                "diurnal",
+                duration=round(1.6 * sc, 3),
+                action="diurnal",
+                params=_p(
+                    writers=3,
+                    period=j(0.7, 1.1),
+                    amp=j(0.5, 0.8),
+                    shard=SH_MEM,
+                ),
+            ),
+            # the elastic class: a zipfian write storm heats one shard
+            # while the balancer's load-feedback loop watches the
+            # gateway's per-shard evidence; the phase REQUIRES >=1
+            # load-driven move and a post-move p99 drop (and that a
+            # preceding quiet window fired ZERO moves)
+            Phase(
+                "elastic",
+                fault_class="elastic",
+                duration=round(2.0 * sc, 3),
+                action="elastic",
+                params=_p(
+                    keys=24,
+                    skew=j(1.2, 1.6),
+                    writers=4,
+                    shard=SH_MEM,
+                    hot_p99_ms=60,
+                    hot_submit=20,
+                    min_samples=12,
+                    hysteresis=2,
+                    cooldown=8,
+                    quiet_passes=4,
+                    storm_s=round(2.5 * sc, 3),
+                ),
+            ),
             Phase("cooldown", duration=round(2.0 * sc, 3)),
         ]
         return DayPlan(seed=seed, gear="mini", phases=phases)
@@ -308,5 +369,93 @@ class DayPlan:
                 ),
             )
         )
+        # the adversarial-traffic tail (ISSUE 18): write-side skew,
+        # a diurnal swing, then the elastic class — full-gear sized
+        phases += [
+            Phase(
+                "write_hot",
+                duration=30.0,
+                action="write_hot",
+                params=_p(
+                    keys=24,
+                    skew=j(1.1, 1.5),
+                    writers=4,
+                    shard=SH_MEM,
+                ),
+            ),
+            Phase(
+                "diurnal",
+                duration=45.0,
+                action="diurnal",
+                params=_p(
+                    writers=4,
+                    period=j(8.0, 12.0),
+                    amp=j(0.5, 0.8),
+                    shard=SH_MEM,
+                ),
+            ),
+            Phase(
+                "elastic",
+                fault_class="elastic",
+                duration=30.0,
+                action="elastic",
+                params=_p(
+                    keys=24,
+                    skew=j(1.2, 1.6),
+                    writers=5,
+                    shard=SH_MEM,
+                    hot_p99_ms=60,
+                    hot_submit=20,
+                    min_samples=12,
+                    hysteresis=2,
+                    cooldown=8,
+                    quiet_passes=4,
+                    storm_s=8.0,
+                ),
+            ),
+        ]
         phases.append(Phase("cooldown", duration=15.0))
         return DayPlan(seed=seed, gear="full", phases=phases)
+
+    @staticmethod
+    def multiproc(seed: int) -> "DayPlan":
+        """The cross-process gear (``DRAGONBOAT_MULTIPROC=1``,
+        docs/SCENARIO.md "The multi-process gear"): a short schedule
+        the ProcFleet dispatcher executes over real OS processes —
+        whole-host SIGKILL, then an ASYMMETRIC partition (the PR 16
+        directional wire kinds the in-proc transport can't express):
+        a one-way ``asym_drop`` from the leader's process toward one
+        follower, healed after ``window`` seconds, with the recovery
+        SLA asserted after the heal and the Wing–Gong audit across the
+        whole day.  Victims (which process leads, which follower is
+        struck) are runtime-sampled and stay out of describe() by
+        construction."""
+        rng = Random(seed)
+
+        def j(lo: float, hi: float) -> float:
+            return round(rng.uniform(lo, hi), 3)
+
+        phases = [
+            Phase("warmup", duration=j(1.5, 2.5)),
+            Phase(
+                "proc_kill",
+                fault_class="proc_kill9",
+                duration=j(1.0, 2.0),
+                action="proc_kill",
+                params=_p(sla_ticks=4000),
+            ),
+            Phase(
+                "asym_partition",
+                fault_class="asym_partition",
+                duration=j(1.0, 2.0),
+                action="asym_partition",
+                params=_p(
+                    kind="asym_drop",
+                    p=1.0,
+                    window=j(1.2, 1.8),
+                    sla_ticks=4000,
+                ),
+            ),
+            Phase("cooldown", duration=j(0.8, 1.2)),
+        ]
+        return DayPlan(seed=seed, gear="multiproc", phases=phases)

@@ -40,6 +40,9 @@ class DayReport:
     violations: List[str] = field(default_factory=list)
     aborted: str = ""
     timeline: str = ""
+    #: device-path counters from the colocated fleet member's engine
+    #: group (empty when the day ran host-only)
+    colocated: Dict[str, int] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -76,6 +79,7 @@ class DayReport:
             "violations": self.violations,
             "aborted": self.aborted,
             "plan": self.plan,
+            "colocated": dict(self.colocated),
         }
 
     def to_json(self, path: str = "") -> str:

@@ -227,9 +227,12 @@ class ScenarioRunner:
         workdir: str = "/tmp",
         sla_ticks: int = 15_000,
         traffic_pace: float = 0.012,
+        colocated: bool = False,
     ):
         self.plan = plan
-        self.fleet = DayFleet(plan.seed, tag=tag, workdir=workdir)
+        self.fleet = DayFleet(
+            plan.seed, tag=tag, workdir=workdir, colocated=colocated
+        )
         self.sla_ticks = sla_ticks
         self.traffic_pace = traffic_pace
         self.rec = HistoryRecorder()
@@ -296,6 +299,26 @@ class ScenarioRunner:
             nemesis = self.fleet.nemesis
             if nemesis is not None:
                 self.report.violations.extend(nemesis.churn_violations)
+            self.report.colocated = self.fleet.colo_stats()
+            if self.report.colocated:
+                # the colocated member's own invariants, phrased as day
+                # verdicts: the launch pipeline must actually STEP on
+                # the device path (a day that silently fell back to the
+                # host engine proves nothing), and scheduled churn must
+                # never trip a divergence fail-stop (I5).  With one
+                # colocated slot its two replicas are the only group
+                # members, so rows-stepped — not intra-group routing —
+                # is the device-path evidence.
+                if not self.report.colocated.get("device_rows_stepped", 0):
+                    self.report.violations.append(
+                        "colocated member never stepped on the device "
+                        f"path: {self.report.colocated}"
+                    )
+                if self.report.colocated.get("divergence_halts", 0):
+                    self.report.violations.append(
+                        "colocated member divergence fail-stop under "
+                        f"scheduled churn: {self.report.colocated}"
+                    )
             if not self.report.aborted:
                 self._final_audit()
         finally:
@@ -422,6 +445,12 @@ class ScenarioRunner:
             return self._dr_cycle(phase)
         if a == "read_hot":
             return self._read_hot(phase)
+        if a == "write_hot":
+            return self._write_hot(phase)
+        if a == "diurnal":
+            return self._diurnal(phase)
+        if a == "elastic":
+            return self._elastic(phase)
         raise ValueError(f"unknown phase action {a!r}")
 
     def _sla(self, shard: int, fault_class: str) -> None:
@@ -746,6 +775,389 @@ class ScenarioRunner:
             "reads": served,
             "read_paths": split,
             "hot_key_reads": sum(hot_hits),
+        }
+
+    @staticmethod
+    def _zipf_cdf(n_keys: int, skew: float) -> List[float]:
+        w = [1.0 / (r ** skew) for r in range(1, n_keys + 1)]
+        tot = sum(w)
+        cdf: List[float] = []
+        acc = 0.0
+        for x in w:
+            acc += x / tot
+            cdf.append(acc)
+        return cdf
+
+    def _storm_writers(self, shard: int, n: int, cdf: List[float],
+                       stop_at, *, seed_base: int = 13_000,
+                       pace_fn=None) -> Dict[str, int]:
+        """Run ``n`` zipfian writer threads against the audited shard
+        until ``stop_at`` (a float deadline or a callable returning
+        True to stop).  Each writer owns an exactly-once gateway handle
+        and records every op in the Wing–Gong history (ok / shed-fail /
+        ambiguous, the _Traffic discipline).  ``pace_fn(t)`` returns
+        the inter-write sleep at elapsed day-phase time ``t`` (None:
+        unpaced — the storm shape)."""
+        import bisect
+
+        gw = self.fleet.gateway
+        done = (stop_at if callable(stop_at)
+                else (lambda: time.monotonic() >= stop_at))
+        hot_hits = [0] * n
+        wrote = [0] * n
+        shed = [0] * n
+        t0 = time.monotonic()
+
+        def storm(idx: int) -> None:
+            rng = Random(13_000 + idx if seed_base == 13_000
+                         else seed_base + idx)
+            cid = self.rec.new_client()
+            try:
+                h = gw.connect(shard, timeout=10.0)
+            except Exception:  # noqa: BLE001 — storm starts mid-outage
+                return
+            seq = 0
+            try:
+                while not done():
+                    r = bisect.bisect_left(cdf, rng.random())
+                    key = f"m:k{r}"
+                    if r == 0:
+                        hot_hits[idx] += 1
+                    seq += 1
+                    val = f"{cid}:{seq}"
+                    op = self.rec.invoke(cid, "w", key, val)
+                    try:
+                        h.sync_propose(audit_set_cmd(key, val), timeout=2.5)
+                        self.rec.ok(op)
+                        wrote[idx] += 1
+                    except GatewayBusy:
+                        self.rec.fail(op)  # shed at the door: not in
+                        shed[idx] += 1
+                    except Exception:  # noqa: BLE001 — maybe committed
+                        self.rec.ambiguous(op)
+                    if pace_fn is not None:
+                        time.sleep(pace_fn(time.monotonic() - t0))
+            finally:
+                try:
+                    h.close(timeout=1.0)
+                except Exception:  # noqa: BLE001 — gateway closing
+                    pass
+
+        threads = [
+            threading.Thread(
+                target=storm, args=(i,), daemon=True,
+                name=f"tpu-day-writehot-{i}",
+            )
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        return {
+            "writes": sum(wrote),
+            "hot_key_writes": sum(hot_hits),
+            "write_shed": sum(shed),
+        }
+
+    def _write_hot(self, phase: Phase) -> Dict[str, object]:
+        """The write half of the zipfian storm (ROADMAP 5c, traffic
+        shape): hot-key skewed writers hammer the audited shard's
+        exactly-once path.  Every write joins the Wing–Gong history —
+        the skew is adversarial precisely because dedupe + per-session
+        ordering must hold while one key's apply order is contended."""
+        fleet = self.fleet
+        shard = int(phase.param("shard", SH_MEM))
+        n_keys = int(phase.param("keys", 24))
+        skew = float(phase.param("skew", 1.2))
+        writers = int(phase.param("writers", 3))
+        burst = max(0.8, float(phase.duration))
+        fleet.wait_for_leader(shard)
+        cdf = self._zipf_cdf(n_keys, skew)
+        out = self._storm_writers(
+            shard, writers, cdf, time.monotonic() + burst
+        )
+        if not out["writes"]:
+            raise RecoverySLAViolation(
+                f"write-hot storm landed zero commits: {out}"
+            )
+        return {"events": 1, **out}
+
+    def _diurnal(self, phase: Phase) -> Dict[str, object]:
+        """Sinusoidal offered-load swing (diurnal in miniature): writer
+        pacing modulates by ``1 + amp*sin(2*pi*t/period)`` and the
+        ledger row records the observed peak/trough committed rates —
+        the serving plane must ride the swing without shedding at the
+        trough's budget (no hard swing assert: the 1-core container
+        flattens small swings; the row is the evidence)."""
+        import math
+
+        fleet = self.fleet
+        gw = fleet.gateway
+        shard = int(phase.param("shard", SH_MEM))
+        writers = int(phase.param("writers", 3))
+        period = max(0.2, float(phase.param("period", 1.0)))
+        amp = min(0.95, max(0.0, float(phase.param("amp", 0.6))))
+        burst = max(0.8, float(phase.duration))
+        fleet.wait_for_leader(shard)
+        base = 2 * self.traffic_pace
+
+        def pace(t: float) -> float:
+            # offered load ~ (1 + amp*sin): the gap is its reciprocal
+            return base / max(0.05, 1.0 + amp * math.sin(
+                2.0 * math.pi * t / period))
+
+        cdf = self._zipf_cdf(int(phase.param("keys", 24)), 0.0)
+        stop_at = time.monotonic() + burst
+        rates: List[float] = []
+
+        def sampler() -> None:
+            dt = max(0.05, period / 8.0)
+            prev = gw.stats()["committed"]
+            while time.monotonic() < stop_at:
+                time.sleep(dt)
+                cur = gw.stats()["committed"]
+                rates.append(max(0, cur - prev) / dt)
+                prev = cur
+
+        st = threading.Thread(target=sampler, daemon=True,
+                              name="tpu-day-diurnal-sampler")
+        st.start()
+        out = self._storm_writers(
+            shard, writers, cdf, stop_at, seed_base=14_000, pace_fn=pace
+        )
+        st.join(timeout=30.0)
+        if not out["writes"]:
+            raise RecoverySLAViolation(
+                f"diurnal swing landed zero commits: {out}"
+            )
+        peak = round(max(rates), 2) if rates else 0.0
+        trough = round(min(rates), 2) if rates else 0.0
+        return {
+            "events": 1,
+            "writes": out["writes"],
+            "peak_committed_per_s": peak,
+            "trough_committed_per_s": trough,
+            "swing": round(peak / trough, 2) if trough > 0 else 0.0,
+        }
+
+    def _elastic(self, phase: Phase) -> Dict[str, object]:
+        """The elastic disturbance class (docs/BALANCE.md "Load-reactive
+        rebalancing"): close the measurement->placement loop under a
+        hostile write storm and PROVE the move shed the heat.
+
+        Sequence: (1) quiet pre-check — with the phase's policy armed,
+        run ``quiet_passes`` feedback passes under baseline traffic and
+        require ZERO load-driven moves (the hysteresis guarantee, in
+        the ledger as ``quiet_moves``); (2) manufacture genuine heat —
+        transfer the big-state shard's leadership onto the audited
+        shard's leader host, so both commit paths contend for that
+        host's single engine worker; (3) zipfian write storm against
+        the audited shard while the main loop samples the gateway's
+        per-shard p99 and runs ``load_rebalance_once`` — the balancer
+        must fire >=1 move; (4) keep the storm up through a tail so
+        the post-move latency picture is measured UNDER the same
+        offered load, and require the hot shard's p99 to drop below
+        the storm peak; (5) recovery SLA around the whole maneuver,
+        same as every other class."""
+        from ..balance import LoadPolicy
+
+        fleet = self.fleet
+        gw = fleet.gateway
+        bal = fleet.balancer
+        shard = int(phase.param("shard", SH_MEM))
+        n_keys = int(phase.param("keys", 24))
+        skew = float(phase.param("skew", 1.4))
+        writers = int(phase.param("writers", 4))
+        hot_p99_ms = int(phase.param("hot_p99_ms", 60))
+        hot_submit_floor = int(phase.param("hot_submit", 20))
+        min_samples = int(phase.param("min_samples", 12))
+        hysteresis = int(phase.param("hysteresis", 2))
+        cooldown = int(phase.param("cooldown", 8))
+        quiet_passes = int(phase.param("quiet_passes", 4))
+        storm_s = max(1.0, float(phase.param("storm_s", 2.5)))
+        pass_sleep = 0.12  # one cadence for quiet AND storm passes:
+        # the submit trigger is a per-pass delta, so comparable windows
+        # are what make the quiet/storm separation meaningful
+        fleet.wait_for_leader(shard)
+        fleet.wait_for_leader(SH_DISK)
+        # thresholds are runtime-adaptive (like victim sampling, OUT of
+        # describe()): the plan pins only the floors.  Submit-rate is
+        # the PRIMARY trigger — offered load is what "load-reactive"
+        # reacts to, and it separates storm from quiet far more
+        # sharply than the absolute tail on a loaded 1-core box;
+        # p99 stays as the secondary trigger with a 3x-baseline guard.
+        base_row = gw.shard_load().get(shard) or {}
+        base_p99 = float(base_row.get("p99_s", 0.0) or 0.0)
+        sub0 = int(base_row.get("submitted", 0))
+        time.sleep(0.6)
+        sub1 = int((gw.shard_load().get(shard) or {}).get("submitted", 0))
+        quiet_rate = max(0.0, (sub1 - sub0) / 0.6)
+        hot_p99_s = max(hot_p99_ms / 1000.0, 3.0 * base_p99)
+        hot_submit = max(
+            hot_submit_floor, int(3.0 * quiet_rate * pass_sleep) + 1
+        )
+        bal.set_load_policy(LoadPolicy(
+            hot_p99_s=hot_p99_s,
+            hot_shed=8,
+            hot_submit=hot_submit,
+            min_samples=min_samples,
+            hysteresis=hysteresis,
+            cooldown=cooldown,
+            max_moves=1,
+        ))
+        # (1) quiet pre-check: baseline traffic must fire ZERO moves
+        quiet_moves = 0
+        for _ in range(max(hysteresis + 1, quiet_passes)):
+            rep = bal.load_rebalance_once()
+            quiet_moves += rep["executed"] + rep["failed"]
+            time.sleep(pass_sleep)
+        if quiet_moves:
+            raise RecoverySLAViolation(
+                "elastic: quiet window fired load-driven moves "
+                f"(hysteresis broken): {bal.last_load_report}"
+            )
+        # (2) colocate the two leaders: find the audited shard's leader
+        # host and transfer the big-state shard's leadership onto it
+        leader_nh = fleet.wait_for_leader(shard)
+        hot_host = next(
+            (a for a, h in fleet.hosts.items() if h is leader_nh), ""
+        )
+        colocated = False
+        if hot_host:
+            disk_ent = fleet._assign.get(hot_host, {}).get(SH_DISK)
+            disk_rid = disk_ent[0] if disk_ent else None
+            if disk_rid:
+                disk_leader = fleet.wait_for_leader(SH_DISK)
+                try:
+                    disk_leader.request_leader_transfer(SH_DISK, disk_rid)
+                    end = time.monotonic() + 5.0
+                    while time.monotonic() < end:
+                        if fleet.wait_for_leader(SH_DISK) is fleet.hosts[
+                                hot_host]:
+                            colocated = True
+                            break
+                        time.sleep(0.05)
+                except Exception:  # noqa: BLE001 — the storm still
+                    # heats the shard without the colocation boost
+                    pass
+        # (3) the storm + the feedback loop.  Alongside the zipfian
+        # mem-shard storm, two disk-shard writers hammer the COLOCATED
+        # big-state leader — the cross-shard engine contention is what
+        # the move must escape.  Disk ops join the recorded history
+        # (same d:k* key space as the baseline disk writer).
+        from ..bigstate.ondisk import put_cmd
+
+        state = {"stop": False}
+        out_box: Dict[str, Dict[str, int]] = {}
+
+        def run_storm() -> None:
+            out_box["w"] = self._storm_writers(
+                shard, writers, self._zipf_cdf(n_keys, skew),
+                lambda: state["stop"], seed_base=15_000,
+            )
+
+        def disk_heat(idx: int) -> None:
+            rng = Random(16_000 + idx)
+            cid = self.rec.new_client()
+            try:
+                h = gw.connect(SH_DISK, timeout=10.0)
+            except Exception:  # noqa: BLE001 — storm mid-outage
+                return
+            seq = 0
+            try:
+                while not state["stop"]:
+                    key = f"d:k{rng.randrange(8)}"
+                    seq += 1
+                    val = f"{cid}:{seq}"
+                    op = self.rec.invoke(cid, "w", key, val)
+                    try:
+                        h.sync_propose(
+                            put_cmd(key.encode(), val.encode()),
+                            timeout=2.5,
+                        )
+                        self.rec.ok(op)
+                    except GatewayBusy:
+                        self.rec.fail(op)
+                    except Exception:  # noqa: BLE001 — maybe committed
+                        self.rec.ambiguous(op)
+            finally:
+                try:
+                    h.close(timeout=1.0)
+                except Exception:  # noqa: BLE001 — gateway closing
+                    pass
+
+        storm_t = threading.Thread(target=run_storm, daemon=True,
+                                   name="tpu-day-elastic-storm")
+        heat_ts = [
+            threading.Thread(target=disk_heat, args=(i,), daemon=True,
+                             name=f"tpu-day-elastic-heat-{i}")
+            for i in range(2)
+        ]
+        shed0 = int((gw.shard_load().get(shard) or {}).get("shed", 0))
+        storm_t.start()
+        for t in heat_ts:
+            t.start()
+        p99_peak = 0.0
+        p99_after = 0.0
+        executed = failed = 0
+        moves: List[str] = []
+        hard_cap = time.monotonic() + storm_s + 8.0
+        try:
+            # pre-move: sample heat + run the loop until a move fires
+            while time.monotonic() < hard_cap:
+                row = gw.shard_load().get(shard) or {}
+                p99_peak = max(p99_peak, float(row.get("p99_s", 0.0)))
+                rep = bal.load_rebalance_once()
+                executed += rep["executed"]
+                failed += rep["failed"]
+                moves.extend(rep["moves"])
+                if executed:
+                    break
+                time.sleep(pass_sleep)
+            if not executed:
+                raise RecoverySLAViolation(
+                    "elastic: storm fired no load-driven move "
+                    f"(p99_peak={p99_peak:.4f}s p99_thr={hot_p99_s:.4f}s "
+                    f"submit_thr={hot_submit}/pass "
+                    f"last={bal.last_load_report})"
+                )
+            # (4) post-move tail: same storm, fresh window — wait for
+            # the per-shard budget to flush into the post-move picture
+            tail_end = time.monotonic() + max(1.2, 0.6 * storm_s)
+            tail_cap = time.monotonic() + max(4.0, storm_s)
+            while time.monotonic() < tail_end:
+                time.sleep(0.1)
+            row = gw.shard_load().get(shard) or {}
+            p99_after = float(row.get("p99_s", 0.0))
+            while p99_after >= p99_peak and time.monotonic() < tail_cap:
+                time.sleep(0.15)
+                row = gw.shard_load().get(shard) or {}
+                p99_after = float(row.get("p99_s", 0.0))
+        finally:
+            state["stop"] = True
+            storm_t.join(timeout=60.0)
+            for t in heat_ts:
+                t.join(timeout=30.0)
+        shed1 = int((gw.shard_load().get(shard) or {}).get("shed", 0))
+        # (5) the same recovery gate every class gets
+        self._sla(shard, "elastic")
+        if p99_after >= p99_peak:
+            raise RecoverySLAViolation(
+                "elastic: move did not shed the hot shard's p99 "
+                f"(storm peak {p99_peak:.4f}s -> after {p99_after:.4f}s, "
+                f"moves={moves})"
+            )
+        return {
+            "events": executed,
+            "moves": moves,
+            "moves_failed": failed,
+            "colocated_leaders": colocated,
+            "quiet_moves": quiet_moves,
+            "p99_storm_s": round(p99_peak, 4),
+            "p99_after_s": round(p99_after, 4),
+            "shed_delta": max(0, shed1 - shed0),
+            "writes": out_box.get("w", {}).get("writes", 0),
         }
 
     # ------------------------------------------------------------------

@@ -116,6 +116,11 @@ def _decode_table(data: bytes) -> Optional[Dict[str, Tuple[str, int]]]:
         return None
 
 
+# consecutive direct packets a suspect peer must deliver before it
+# counts alive again (see GossipManager._suspect)
+SUSPECT_CLEAR_PACKETS = 3
+
+
 class GossipManager:
     """The UDP push-gossip epidemic itself."""
 
@@ -145,6 +150,16 @@ class GossipManager:
         # (liveness for the balance control plane; relayed rows don't
         # count — see _encode_packets)
         self._last_heard: Dict[str, float] = {}
+        # suspect hysteresis (docs/BALANCE.md, one-way partitions): a
+        # peer that ever misses its liveness window is SUSPECT and must
+        # deliver SUSPECT_CLEAR_PACKETS consecutive direct packets
+        # before it reads alive again.  Under an intermittent
+        # asym_drop toward us (p < 1) the occasional lucky packet
+        # refreshes _last_heard sporadically — without the counter the
+        # peer's liveness would oscillate at the window boundary and
+        # the balance repair invariant would churn its replicas.
+        # nodehost_id -> direct packets heard since marked suspect
+        self._suspect: Dict[str, int] = {}
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -223,7 +238,18 @@ class GossipManager:
             window = max(2.0, self.interval * 5.0 * n / max(self.fanout, 1))
         cutoff = _time.monotonic() - window
         with self._lock:
-            alive = {k for k, t in self._last_heard.items() if t >= cutoff}
+            alive = set()
+            for k, t in self._last_heard.items():
+                if t < cutoff:
+                    # missed the window: suspect from here on — reset
+                    # the recovery counter even if already suspect
+                    self._suspect[k] = 0
+                    continue
+                if k in self._suspect:
+                    # fresh but still suspect: one lucky packet through
+                    # an intermittent one-way drop is not recovery
+                    continue
+                alive.add(k)
         alive.add(self.nodehost_id)
         return alive
 
@@ -235,6 +261,10 @@ class GossipManager:
         with self._lock:
             if sender_id:
                 self._last_heard[sender_id] = _time.monotonic()
+                if sender_id in self._suspect:
+                    self._suspect[sender_id] += 1
+                    if self._suspect[sender_id] >= SUSPECT_CLEAR_PACKETS:
+                        del self._suspect[sender_id]
             for nhid, (addr, ver) in table.items():
                 if nhid == self.nodehost_id:
                     # never accept a peer's view of OUR address: after a

@@ -4,7 +4,7 @@
 # under live gateway traffic.  Asserts
 #   1. every disturbance class fired at least once (rolling restart,
 #      leader churn, snapshot-stream kill/stall, region drain, DR
-#      export->import),
+#      export->import, elastic load-feedback),
 #   2. zero Wing-Gong audit violations across the DR boundary,
 #   3. zero recovery-SLA misses (every recovery ran under
 #      assert_recovery_sla with its fault class),
@@ -30,10 +30,19 @@ assert all(n >= 1 for n in r.disturbances_fired.values()), (
 assert r.audit["ok"] and not r.violations
 assert all(c["violations"] == 0 for c in r.recovery.values()), r.recovery
 assert set(r.fault_dips) == set(DISTURBANCE_CLASSES), r.fault_dips
+# the elastic loop's ledger: >=1 load-driven move fired under the storm,
+# ZERO fired in the quiet pre-check, and the move shed the hot shard's
+# p99 below the storm peak (ISSUE 18 acceptance)
+el = next(p for p in r.phases if p["name"] == "elastic")
+assert el["events"] >= 1 and el["quiet_moves"] == 0, el
+assert el["p99_after_s"] < el["p99_storm_s"], el
 print(
     "SCENARIO_SMOKE_OK "
     f"wall={r.wall_s:.1f}s baseline={r.baseline_committed_per_s:.0f}/s "
     f"classes={len(r.disturbances_fired)} "
+    f"elastic_moves={el['events']} "
+    f"p99_storm={el['p99_storm_s']*1000:.0f}ms "
+    f"p99_after={el['p99_after_s']*1000:.0f}ms "
     f"ops_ok={r.audit['ops'].get('ok', 0)} audit=green sla_misses=0"
 )
 EOF

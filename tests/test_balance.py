@@ -38,10 +38,13 @@ from dragonboat_tpu.balance import (
     Balancer,
     ClusterView,
     Collector,
+    HotTracker,
+    LoadPolicy,
     Move,
     MoveExecutor,
     MoveFailed,
     Planner,
+    ShardLoad,
     ShardView,
 )
 from dragonboat_tpu.transport.inproc import reset_inproc_network
@@ -642,6 +645,37 @@ class TestGossipLiveness:
         finally:
             a.close()
 
+    def test_one_way_drop_reads_suspect_not_flapping(self):
+        # ISSUE 18 satellite: an intermittent asym_drop toward us lets
+        # the occasional lucky packet through — that must not oscillate
+        # the peer's direct-contact liveness at the window boundary.
+        # Drives _merge directly (no sockets, no start()).
+        from dragonboat_tpu.transport.gossip import (
+            SUSPECT_CLEAR_PACKETS,
+            GossipManager,
+        )
+
+        g = GossipManager("nhid-aaaa", "ra-1", "127.0.0.1:0", [])
+        g._merge({}, None, "nhid-bbbb")
+        assert "nhid-bbbb" in g.alive_peers(window=5.0)
+        # peer misses the window: suspect from here on
+        with g._lock:
+            g._last_heard["nhid-bbbb"] -= 10.0
+        assert "nhid-bbbb" not in g.alive_peers(window=5.0)
+        # one lucky packet through the drop must NOT flip it back
+        g._merge({}, None, "nhid-bbbb")
+        assert "nhid-bbbb" not in g.alive_peers(window=5.0)
+        # sustained direct contact clears the suspicion
+        for _ in range(SUSPECT_CLEAR_PACKETS - 1):
+            g._merge({}, None, "nhid-bbbb")
+        assert "nhid-bbbb" in g.alive_peers(window=5.0)
+        # a relapse re-arms the counter from zero
+        with g._lock:
+            g._last_heard["nhid-bbbb"] -= 10.0
+        assert "nhid-bbbb" not in g.alive_peers(window=5.0)
+        g._merge({}, None, "nhid-bbbb")
+        assert "nhid-bbbb" not in g.alive_peers(window=5.0)
+
 
 # ---------------------------------------------------------------------------
 # real clusters
@@ -906,3 +940,182 @@ class TestBalanceChaos:
             b.stop()
             for nh in nhs.values():
                 nh.close()
+
+
+# ---------------------------------------------------------------------------
+# load-reactive rebalancing: the elastic loop's pure parts in isolation
+# ---------------------------------------------------------------------------
+class TestSpreadHotPlanner:
+    def hot_view(self):
+        # h1 carries both leaders AND the most members; h4 is empty
+        return mk_view(
+            ["h1", "h2", "h3", "h4"],
+            [
+                mk_shard(1, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=1),
+                mk_shard(2, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=1),
+            ],
+        )
+
+    def test_same_seed_same_view_same_plan(self):
+        a = Planner(seed=SEED).plan_spread_hot(self.hot_view(), [1])
+        b = Planner(seed=SEED).plan_spread_hot(self.hot_view(), [1])
+        assert a.describe() == b.describe()
+        assert len(a) == 1
+
+    def test_prefers_transfer_when_cold_host_is_a_member(self):
+        # every target host already holds a member, so the cheap move
+        # (pure leadership transfer) must win over replace
+        v = mk_view(
+            ["h1", "h2", "h3"],
+            [
+                mk_shard(1, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=1),
+                mk_shard(2, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=1),
+            ],
+        )
+        (m,) = Planner(seed=SEED).plan_spread_hot(v, [1])
+        assert m.kind == "transfer"
+        assert m.shard_id == 1
+        assert m.src_host == "h1"
+        assert m.dst_host in ("h2", "h3")
+
+    def test_replace_when_coldest_host_holds_no_member(self):
+        # pile members on h2/h3 so empty h4 is strictly coldest
+        v = mk_view(
+            ["h1", "h2", "h3", "h4"],
+            [
+                mk_shard(1, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=1),
+                mk_shard(2, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=2),
+                mk_shard(3, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=3),
+            ],
+        )
+        (m,) = Planner(seed=SEED).plan_spread_hot(v, [1])
+        assert m.kind == "replace"
+        assert m.dst_host == "h4"
+        assert m.new_replica_id == 4  # fresh id above every member
+
+    def test_no_gain_guard_skips_balanced_leaders(self):
+        # one leader per host: the coldest target is exactly as hot as
+        # the source, a move would only thrash
+        v = mk_view(
+            ["h1", "h2", "h3"],
+            [
+                mk_shard(1, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=1),
+                mk_shard(2, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=2),
+                mk_shard(3, [(1, "h1"), (2, "h2"), (3, "h3")], leader_rid=3),
+            ],
+        )
+        assert len(Planner(seed=SEED).plan_spread_hot(v, [1])) == 0
+
+    def test_max_moves_clamps_the_pass(self):
+        plan = Planner(seed=SEED).plan_spread_hot(
+            self.hot_view(), [1, 2], max_moves=1
+        )
+        assert len(plan) == 1
+
+    def test_projection_spreads_two_hot_shards_apart(self):
+        plan = Planner(seed=SEED).plan_spread_hot(
+            self.hot_view(), [1, 2], max_moves=2
+        )
+        assert len(plan) == 2
+        # projected pressure advances per move: the second hot shard
+        # must not dogpile the first one's destination
+        assert plan.moves[0].dst_host != plan.moves[1].dst_host
+
+    def test_unknown_or_leaderless_shard_is_skipped(self):
+        v = mk_view(
+            ["h1", "h2"],
+            [mk_shard(1, [(1, "h1"), (2, "h2")], leader_rid=0)],
+        )
+        assert len(Planner(seed=SEED).plan_spread_hot(v, [1, 9])) == 0
+
+
+class TestHotTracker:
+    def test_fires_only_after_consecutive_hot_passes(self):
+        t = HotTracker(hysteresis=3, cooldown=2)
+        assert t.observe([1]) == []
+        assert t.observe([1]) == []
+        assert t.observe([1]) == [1]
+
+    def test_broken_streak_resets(self):
+        t = HotTracker(hysteresis=2, cooldown=2)
+        assert t.observe([1]) == []
+        assert t.observe([]) == []
+        assert t.observe([1]) == []
+        assert t.observe([1]) == [1]
+
+    def test_cooldown_suppresses_exactly_n_passes(self):
+        t = HotTracker(hysteresis=1, cooldown=2)
+        assert t.observe([1]) == [1]
+        t.fired([1])
+        # cooldown=2: exactly two subsequent hot passes are suppressed
+        assert t.observe([1]) == []
+        assert t.observe([1]) == []
+        assert t.observe([1]) == [1]
+
+    def test_fired_without_refire_until_hysteresis_rebuilt(self):
+        t = HotTracker(hysteresis=2, cooldown=0)
+        t.observe([1])
+        assert t.observe([1]) == [1]
+        t.fired([1])
+        # firing popped the streak: the bar must be re-earned
+        assert t.observe([1]) == []
+        assert t.observe([1]) == [1]
+
+
+class TestLoadPolicy:
+    def test_p99_trigger_needs_min_samples(self):
+        pol = LoadPolicy(hot_p99_s=0.1, min_samples=12)
+        assert not pol.is_hot(ShardLoad(1, p99_ms=500, samples=3))
+        assert pol.is_hot(ShardLoad(1, p99_ms=500, samples=12))
+        assert not pol.is_hot(ShardLoad(1, p99_ms=50, samples=128))
+
+    def test_shed_and_submit_triggers(self):
+        pol = LoadPolicy(hot_p99_s=9.0, hot_shed=8, hot_submit=40)
+        assert pol.is_hot(ShardLoad(1, shed=8))
+        assert not pol.is_hot(ShardLoad(1, shed=7))
+        assert pol.is_hot(ShardLoad(1, submitted=40))
+        assert not pol.is_hot(ShardLoad(1, submitted=39))
+
+    def test_disabled_triggers_stay_dark(self):
+        pol = LoadPolicy(hot_p99_s=9.0, hot_shed=0, hot_submit=0)
+        assert not pol.is_hot(
+            ShardLoad(1, shed=10_000, submitted=10_000, samples=128)
+        )
+
+
+class TestCollectorLoadRows:
+    def test_load_rows_are_window_deltas(self):
+        raw = {
+            1: {"p99_s": 0.0421, "samples": 64, "submitted": 100, "shed": 2},
+        }
+        c = Collector(load_source=lambda: raw)
+        v1 = c.collect({})
+        # first sight: baseline = current totals, delta 0 (the
+        # proposal_rate idiom — no fabricated spike on pass one)
+        row = v1.load_of(1)
+        assert row == ShardLoad(1, p99_ms=42, samples=64,
+                                submitted=0, shed=0)
+        raw[1] = {"p99_s": 0.05, "samples": 128, "submitted": 160, "shed": 5}
+        row = c.collect({}).load_of(1)
+        assert row.submitted == 60
+        assert row.shed == 3
+        assert row.p99_ms == 50
+
+    def test_no_source_and_failing_source_mean_no_rows(self):
+        assert Collector().collect({}).load == ()
+
+        def boom():
+            raise RuntimeError("gateway closing")
+
+        assert Collector(load_source=boom).collect({}).load == ()
+
+    def test_describe_emits_load_only_when_present(self):
+        base = Collector().collect({}).describe()
+        assert "load(" not in base
+        c = Collector(load_source=lambda: {
+            2: {"p99_s": 0.001, "samples": 16, "submitted": 7, "shed": 0},
+        })
+        c.collect({})  # baseline pass
+        d = c.collect({}).describe()
+        assert d.startswith(base)
+        assert d.endswith("load(2,p99=1ms,n=16,sub=0,shed=0)")

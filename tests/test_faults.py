@@ -740,3 +740,32 @@ class TestAsymmetricWireFaults:
         assert asym, "48 rounds drew no asym fault"
         for f in asym:
             assert f.targets and f.targets[0] in ("x->y", "y->x")
+
+    def test_randomized_balance_pool_byte_compat(self):
+        # ISSUE 18 satellite: the balance_shards knob follows the same
+        # opt-in discipline as asym_pairs/stream_addrs — absent (or
+        # empty), every pre-existing seeded schedule is byte-identical
+        a = FaultPlan.randomized(
+            42, addrs=["x", "y"], fs_keys=[1], churn_shards=[1, 2],
+            asym_pairs=["x->y"], rounds=24,
+        ).describe()
+        b = FaultPlan.randomized(
+            42, addrs=["x", "y"], fs_keys=[1], churn_shards=[1, 2],
+            asym_pairs=["x->y"], balance_shards=(), rounds=24,
+        ).describe()
+        assert a == b
+        assert "balance_move" not in a
+        # a non-empty pool enters deterministically, targets drawn from
+        # balance_shards (not churn_shards)
+        c = FaultPlan.randomized(
+            42, addrs=["x", "y"], churn_shards=[1, 2],
+            balance_shards=[7, 8], rounds=64,
+        )
+        assert c.describe() == FaultPlan.randomized(
+            42, addrs=["x", "y"], churn_shards=[1, 2],
+            balance_shards=[7, 8], rounds=64,
+        ).describe()
+        bal = [f for f in c.faults if f.kind == "balance_move"]
+        assert bal, "64 rounds drew no balance_move"
+        for f in bal:
+            assert f.targets and f.targets[0] in (7, 8)

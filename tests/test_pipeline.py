@@ -518,6 +518,9 @@ class TestFusedShardedRounds:
     not after the wave (a lane deferred to the wave end would diverge
     the serial legs on the first cross-device ack)."""
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 18): 27s; the sharded
+    # round's cross-device parity stays covered every run by
+    # test_multichip's round/step parity variants
     def test_fused_sharded_parity_cross_device(self):
         import functools
 

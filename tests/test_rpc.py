@@ -568,3 +568,9 @@ def test_mini_multiproc_day():
     assert rep["audit"] == "ok"
     assert rep["ops"] > 100
     assert set(rep["sla"]) == {"proc_kill9", "asym_drop"}
+    # schedule-driven: the byte-stable multiproc plan ran end to end
+    assert rep["phases"] == ["warmup", "proc_kill", "asym_partition",
+                             "cooldown"]
+    from dragonboat_tpu.scenario import DayPlan
+
+    assert rep["plan"] == DayPlan.multiproc(rep["seed"]).describe()

@@ -505,6 +505,9 @@ def test_scale_small_always_on():
     assert report["engine_stats"]["device_rows_stepped"] > 0, report
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): 38s, and the cold-kill
+# re-election signal is redundantly covered by test_chaos, test_route
+# drop-liveness and the mini production day's leader_churn phase
 def test_scale_churn_small():
     """The default-suite churn variant (VERDICT item 3 / BASELINE
     config 4's leader-election churn): 64 shards x 5 replicas on the

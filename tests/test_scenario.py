@@ -82,6 +82,31 @@ class TestPlanDeterminism:
                 plan.gear, plan.classes_planned()
             )
 
+    def test_multiproc_plan_byte_identical_and_shaped(self):
+        a = DayPlan.multiproc(7)
+        assert a.describe() == DayPlan.multiproc(7).describe()
+        assert a.describe() != DayPlan.multiproc(8).describe()
+        assert a.gear == "multiproc"
+        assert [p.name for p in a.phases] == [
+            "warmup", "proc_kill", "asym_partition", "cooldown",
+        ]
+        asym = a.phases[2]
+        assert asym.fault_class == "asym_partition"
+        assert asym.param("kind") == "asym_drop"
+        assert asym.param("p") == 1.0
+        # victims are runtime-sampled: the schedule pins no host names
+        assert "h1" not in a.describe() and "@" not in a.describe()
+
+    def test_elastic_phase_pins_policy_knobs_in_describe(self):
+        # the elastic trigger floors live in the plan bytes (runtime-
+        # adaptive thresholds stay OUT — same rule as victim sampling)
+        d = DayPlan.mini(3).describe()
+        for knob in ("hot_p99_ms", "hot_submit", "hysteresis",
+                     "cooldown", "quiet_passes", "storm_s"):
+            assert knob in d, knob
+        full = DayPlan.full(3, hours=0.1, gb=False).describe()
+        assert "hot_submit" in full
+
     def test_gb_tier_changes_only_the_payload(self):
         gb = DayPlan.full(5, hours=0.5, gb=True)
         mb = DayPlan.full(5, hours=0.5, gb=False)
@@ -428,15 +453,16 @@ class TestPhaseAbort:
 class TestMiniDay:
     @pytest.mark.flaky_isolated
     def test_mini_day_all_classes_audit_green(self):
-        """The ISSUE 14 acceptance gate: a seeded mini-day over the
-        mixed on-disk/in-memory/witness fleet under live gateway
-        traffic fires all five disturbance classes, every recovery
+        """The ISSUE 14 acceptance gate (grown by ISSUE 18): a seeded
+        mini-day over the mixed on-disk/in-memory/witness fleet under
+        live gateway traffic fires all six disturbance classes
+        (including the elastic load-feedback loop), every recovery
         holds its SLA, the Wing-Gong audit is green across the DR
         boundary, and the DayReport carries a throughput-dip entry per
         fault class."""
         r = ScenarioRunner(DayPlan.mini(11), tag="mday").run()
         assert r.ok, (r.aborted, r.violations, r.audit)
-        # all five disturbance classes fired at least once
+        # all six disturbance classes fired at least once
         assert set(r.disturbances_fired) == set(DISTURBANCE_CLASSES)
         assert all(n >= 1 for n in r.disturbances_fired.values())
         # audit green over a real history spanning the DR boundary
@@ -468,11 +494,78 @@ class TestMiniDay:
         assert rh["read_paths"]["bounded"] >= 1, rh
         assert rh["reads"] >= rh["read_paths"]["follower"]
         assert rh["hot_key_reads"] >= 1, rh
+        # the write half of the storm landed skewed commits through the
+        # exactly-once path
+        wh = next(p for p in r.phases if p["name"] == "write_hot")
+        assert wh["writes"] >= 1 and wh["hot_key_writes"] >= 1, wh
+        # the diurnal swing recorded its peak/trough committed rates
+        di = next(p for p in r.phases if p["name"] == "diurnal")
+        assert di["writes"] >= 1, di
+        assert di["peak_committed_per_s"] >= di["trough_committed_per_s"]
+        # the elastic loop (ISSUE 18 acceptance): the storm fired >= 1
+        # LOAD-DRIVEN move, the quiet pre-check fired ZERO, and the
+        # post-move hot-shard p99 landed below the storm peak — with
+        # the big-state leader genuinely colocated for the contention
+        el = next(p for p in r.phases if p["name"] == "elastic")
+        assert el["events"] >= 1 and el["moves"], el
+        assert el["quiet_moves"] == 0, el
+        assert el["p99_after_s"] < el["p99_storm_s"], el
+        assert el["writes"] >= 1, el
         # the JSON emit round-trips
         import json
 
         assert json.loads(r.to_json())["ok"] is True
         assert "comm/s" in r.format_table()
+
+
+# ---------------------------------------------------------------------------
+# the colocated fleet member (ISSUE 18 tentpole part 3)
+# ---------------------------------------------------------------------------
+class TestColocatedFleetMember:
+    @pytest.mark.flaky_isolated
+    def test_colocated_member_rides_whole_host_churn(self):
+        """One DayFleet slot steps both shards through a shared
+        ColocatedEngineGroup (the product device path).  Kill/restart
+        that exact host — the same whole-host churn the scheduled day
+        applies — and require: commits keep flowing, recovery holds the
+        SLA, the restarted member re-attaches to the LIVE group (the
+        chaos-tested restart path), the launch pipeline genuinely
+        stepped on the device path (device_rows_stepped > 0 — with one
+        colocated slot its replicas are the only group members, so
+        intra-group routing is structurally zero) and churn never
+        tripped a divergence fail-stop."""
+        from dragonboat_tpu.audit import audit_set_cmd
+        from dragonboat_tpu.scenario.fleet import COLO_SLOT, DayFleet
+        from dragonboat_tpu.scenario.plan import SH_MEM
+
+        fleet = DayFleet(seed=5, tag="coloday", colocated=True)
+        try:
+            fleet.build()
+            gw = fleet.gateway
+            h = gw.connect(SH_MEM, timeout=20.0)
+            for i in range(10):
+                h.sync_propose(audit_set_cmd(f"c{i}", str(i)), timeout=5.0)
+            addr = fleet.addrs[COLO_SLOT]
+            fleet.kill(addr)
+            assert_recovery_sla(
+                fleet.hosts_holding(SH_MEM), SH_MEM, sla_ticks=15_000,
+                cmd=fleet.sla_cmd(), fault_class="colo_kill",
+            )
+            fleet.restart(addr)
+            # the restarted host must rejoin the shard AND the group
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                if fleet.hosts[addr]._nodes.get(SH_MEM) is not None:
+                    break
+                time.sleep(0.2)
+            for i in range(10, 20):
+                h.sync_propose(audit_set_cmd(f"c{i}", str(i)), timeout=5.0)
+            assert gw.read(SH_MEM, "c19", timeout=5.0) == "19"
+            st = fleet.colo_stats()
+            assert st.get("device_rows_stepped", 0) > 0, st  # device path
+            assert st.get("divergence_halts", 0) == 0, st    # I5
+        finally:
+            fleet.close()
 
 
 # ---------------------------------------------------------------------------

@@ -330,7 +330,12 @@ class TestLiveClusterParity:
             cluster.close()
 
 
-@pytest.mark.parametrize("n_dev", [2, 8])
+@pytest.mark.parametrize(
+    "n_dev",
+    # the 8-device variant is slow-tier only (tier-1 budget, ISSUE 18:
+    # 24s); the 2-device run keeps the sliced-lane signal every run
+    [2, pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_sharded_mesh_lane_slices(n_dev):
     """ColocatedEngineGroup(mesh=...) at forced host devices: live
     traffic runs with parity armed, and the lane block composes as
