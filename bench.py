@@ -1528,6 +1528,127 @@ def phase_readplane() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def phase_fleetobs() -> dict:
+    """Fleet-scope telemetry tax (dragonboat_tpu/obs/fleetscope.py,
+    docs/OBSERVABILITY.md "Fleet scope"): what does polling the whole
+    fleet's obs plane over RPC_OP_OBS cost the commit path?
+
+    A real 3-process fleet (scenario/multiproc.ProcFleet — separate OS
+    processes, TCP + gossip + RPC only) takes closed-loop traced
+    gateway proposals through two equal windows: A with the parent's
+    FleetScope poller OFF, B with it ON at BENCH_FLEETOBS_POLL_S.  The
+    record carries committed/s for both, the overhead percentage, poll
+    counts and reply bytes per poll (the bounded-ring payload the
+    obs-bound lint rule caps), plus the cross-process stitch count and
+    the SLO burn-rate ledger verdict — so the tax is judged against a
+    telemetry plane that demonstrably WORKED during the measured
+    window, not one that silently collected nothing.  ``cpus`` is in
+    the record because on a core-starved box the poller thread
+    competes with 3 server processes and the overhead reads high.
+
+    BENCH_FLEETOBS gate; BENCH_FLEETOBS_{SECS,WRITERS,POLL_S,PORT}
+    knobs; BENCH_SMOKE shrinks the windows."""
+    import shutil
+    import threading
+
+    from dragonboat_tpu.audit import audit_set_cmd
+    from dragonboat_tpu.scenario.multiproc import ProcFleet
+
+    smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+
+    def knob(name: str, dflt: str, smoke_dflt: str) -> str:
+        return os.environ.get(name, smoke_dflt if smoke else dflt)
+
+    win = float(knob("BENCH_FLEETOBS_SECS", "5", "2.5"))
+    writers = int(knob("BENCH_FLEETOBS_WRITERS", "4", "2"))
+    poll_s = float(os.environ.get("BENCH_FLEETOBS_POLL_S", "0.25"))
+    base_port = int(os.environ.get("BENCH_FLEETOBS_PORT", "29950"))
+    workdir = "/tmp/bench-fleetobs"
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    SHARD = 1
+    t0 = time.monotonic()
+    fleet = ProcFleet(3, workdir=workdir, base_port=base_port)
+
+    def window() -> int:
+        """Closed-loop writers for ``win`` seconds; returns committed."""
+        stop = threading.Event()
+        counts = [0] * writers
+
+        def w_main(w: int) -> None:
+            h = fleet.gateway.connect(SHARD, timeout=30.0)
+            seq = 0
+            try:
+                while not stop.is_set():
+                    try:
+                        h.sync_propose(
+                            audit_set_cmd(f"fo-w{w}-k{seq % 8}", str(seq)),
+                            timeout=5.0,
+                        )
+                        counts[w] += 1
+                    except Exception:  # noqa: BLE001 — count only commits
+                        pass
+                    seq += 1
+            finally:
+                fleet.gateway.close_handle(h)
+
+        ths = [threading.Thread(target=w_main, args=(w,), daemon=True,
+                                name=f"fo-writer-{w}")
+               for w in range(writers)]
+        for t in ths:
+            t.start()
+        time.sleep(win)
+        stop.set()
+        for t in ths:
+            t.join(timeout=15.0)
+        return sum(counts)
+
+    try:
+        fleet.start()
+        scope = fleet.scope
+        # warm the leader/session path so window A doesn't pay startup
+        h = fleet.gateway.connect(SHARD, timeout=30.0)
+        for i in range(4):
+            h.sync_propose(audit_set_cmd("fo-warm", str(i)), timeout=10.0)
+        fleet.gateway.close_handle(h)
+
+        off = window()                  # A: poller OFF
+        scope.start_poller(poll_s)
+        on = window()                   # B: poller ON
+        scope.close()                   # stop the poller thread
+        scope.poll()                    # final sweep picks up the tail
+
+        stitches = scope.cross_process_stitches()
+        rows = scope.slo_report()
+        off_rate = off / win
+        on_rate = on / win
+        overhead_pct = (100.0 * (off_rate - on_rate) / off_rate
+                        if off_rate > 0 else -1.0)
+        return {
+            "procs": 3,
+            "writers": writers,
+            "window_s": win,
+            "poll_interval_s": poll_s,
+            "committed_per_s_off": round(off_rate, 1),
+            "committed_per_s_on": round(on_rate, 1),
+            "overhead_pct": round(overhead_pct, 1),
+            "polls": scope.polls,
+            "reply_bytes": scope.reply_bytes,
+            "bytes_per_poll": round(
+                scope.reply_bytes / max(1, scope.polls)),
+            "stitches": stitches,
+            "slo_objectives": len(rows),
+            "burning": [r["objective"] for r in rows if r["burning"]],
+            "cpus": os.cpu_count(),
+            "ok": bool(off > 0 and on > 0 and stitches >= 1
+                       and scope.polls >= 2),
+            "secs": round(time.monotonic() - t0, 1),
+        }
+    finally:
+        fleet.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def phase_updatelanes(rows_list=None, reps: int = 3) -> dict:
     """Update-stage residual, scalar (the r8 per-row loop) vs lane
     (r9, ops/hostplane.UpdateLanes), over fabricated generations
@@ -3343,7 +3464,7 @@ def main() -> None:
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
              gateway=None, bigstate=None, hostplane=None,
              pipeline=None, multichip=None, updatelanes=None,
-             day=None, readplane=None) -> None:
+             day=None, readplane=None, fleetobs=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -3416,6 +3537,12 @@ def main() -> None:
                     # replica-mix saturation windows with a mid-window
                     # leader SIGKILL, audit verdict — docs/READPLANE.md)
                     "readplane": readplane,
+                    # r18 schema addition: fleet-scope telemetry guard
+                    # (obs/fleetscope.py; committed/s with the scope
+                    # poller off vs on over a real 3-process fleet +
+                    # reply bytes per bounded poll + stitch/SLO verdict
+                    # — docs/OBSERVABILITY.md "Fleet scope")
+                    "fleetobs": fleetobs,
                 }
             ),
             flush=True,
@@ -3733,6 +3860,25 @@ def main() -> None:
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb, hpb, ppb, mcb, ulb, dayb, rpb)
 
+    # Fleet-scope telemetry guard (host path only, ~20-25s;
+    # BENCH_FLEETOBS gate): commit throughput with the FleetScope
+    # poller off vs on over a real 3-process fleet — the obs-plane tax
+    # plus the stitch/SLO working-plane verdict (docs/OBSERVABILITY.md
+    # "Fleet scope")
+    fob = None
+    if bool(int(os.environ.get("BENCH_FLEETOBS", "1"))) and remaining() > 60:
+        code = (
+            "import json, bench;"
+            "print('BENCHFO ' + json.dumps(bench.phase_fleetobs()))"
+        )
+        fob, fo_err = run_sub(
+            code, "BENCHFO", max(60, min(180, int(remaining() - 30)))
+        )
+        if fob is None:
+            fob = {"error": fo_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb, ppb, mcb, ulb, dayb, rpb, fob)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -3782,6 +3928,11 @@ if __name__ == "__main__":
         # — full-scale defaults (100k sessions, 33 shards) unless
         # BENCH_SMOKE=1 or the BENCH_READPLANE_* knobs say otherwise
         print("BENCHRP " + json.dumps(phase_readplane()), flush=True)
+    elif "phase_fleetobs" in _sys.argv[1:]:
+        # standalone fleet-scope run: `python bench.py phase_fleetobs`
+        # — full windows unless BENCH_SMOKE=1 / BENCH_FLEETOBS_* say
+        # otherwise (docs/OBSERVABILITY.md "Fleet scope")
+        print("BENCHFO " + json.dumps(phase_fleetobs()), flush=True)
     elif "phase_updatelanes" in _sys.argv[1:]:
         # standalone update-lane run: `python bench.py phase_updatelanes`
         # (host-only numpy; BENCH_UPDATELANES_HEAVY=1 adds 50k/250k)

@@ -114,6 +114,14 @@ Rules (ids are stable — baseline entries and ignore comments key on them):
     reads of small metadata carry a ``# raftlint: ignore[stream-read]
     <reason>``.
 
+``obs-bound``
+    The fleet-scope obs plane (``obs/fleetscope.py``,
+    ``gateway/rpc.py``) answers ring-slice queries over the wire: a
+    ``.tail(...)`` / ``.finished_tail(...)`` / ``.recorder_tail(...)``
+    / ``.trace_spans(...)`` call without an explicit ``limit=`` keyword
+    is an unbounded reply payload — one busy ring away from an
+    8MB-frame teardown (docs/OBSERVABILITY.md "Fleet scope").
+
 ``import-hot``
     No function-level imports in the hot modules (``node.py``,
     ``request.py``, ``engine/``): a first call on the step/apply path
@@ -217,6 +225,15 @@ MESH_MODULES = (
     "dragonboat_tpu/ops/colocated.py",
 )
 MESH_HOT_RE = re.compile(r"#\s*mesh-hot\b")
+
+# the fleet-scope obs plane: every obs reply slices its ring with an
+# EXPLICIT limit (docs/OBSERVABILITY.md "Fleet scope")
+OBS_REPLY_MODULES = (
+    "dragonboat_tpu/obs/fleetscope.py",
+    "dragonboat_tpu/gateway/rpc.py",
+)
+_OBS_TAIL_METHODS = {"tail", "finished_tail", "recorder_tail",
+                     "trace_spans"}
 
 # attributes whose read is a static (trace-time, host-free) fact
 _STATIC_FACT_ATTRS = {"shape", "ndim", "size", "dtype"}
@@ -328,6 +345,9 @@ class _Linter(ast.NodeVisitor):
             self.relpath, SYNC_BUDGET_MODULES
         )
         self.check_mesh = _module_matches(self.relpath, MESH_MODULES)
+        self.check_obs_bound = _module_matches(
+            self.relpath, OBS_REPLY_MODULES
+        )
         # count of enclosing `# gateway-hot` / `# hostplane-hot` /
         # `# sync-hot` functions (nested defs inside a hot function
         # inherit the discipline)
@@ -612,6 +632,8 @@ class _Linter(ast.NodeVisitor):
             self._check_host_sync(node)
         if self.check_stream_read:
             self._check_stream_read(node)
+        if self.check_obs_bound:
+            self._check_obs_bound(node)
         if self._sync_depth:
             self._check_sync_budget(node)
         if self._mesh_depth:
@@ -805,6 +827,21 @@ class _Linter(ast.NodeVisitor):
                 "zero-argument .read() buffers a whole stream in memory "
                 "(pass a bounded size; the streaming path must handle "
                 "state larger than RAM — docs/BIGSTATE.md)",
+            )
+
+    def _check_obs_bound(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _OBS_TAIL_METHODS
+            and self._kw(node, "limit") is None
+        ):
+            self._emit(
+                "obs-bound",
+                node.lineno,
+                f".{f.attr}() without an explicit limit= is an unbounded "
+                "obs reply payload (every ring slice must be bounded — "
+                "docs/OBSERVABILITY.md \"Fleet scope\")",
             )
 
     def _check_determinism(self, node: ast.Call) -> None:

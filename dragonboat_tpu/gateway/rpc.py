@@ -43,6 +43,8 @@ from typing import Dict, Optional
 
 from ..client import SERIES_ID_FIRST_PROPOSAL, Session
 from ..logger import get_logger
+from ..obs.fleetscope import ObsService, ObsUnsupported
+from ..obs.trace import UNSAMPLED
 from ..nodehost import (
     NodeHostClosed,
     RequestDropped,
@@ -76,7 +78,11 @@ from ..transport.wire import (
     RPC_ERR_NO_LEASE,
     RPC_ERR_NOT_FOUND,
     RPC_ERR_STALE_BOUND,
+    RPC_OBS_METRICS,
+    RPC_OBS_RECORDER,
+    RPC_OBS_SPANS,
     RPC_OP_FAULT,
+    RPC_OP_OBS,
     RPC_OP_PROPOSE,
     RPC_OP_READ,
     RPC_OP_SESSION_CLOSE,
@@ -91,10 +97,14 @@ from ..transport.wire import (
     RpcRequest,
     RpcResponse,
     WireError,
+    decode_obs_query,
+    decode_obs_reply,
     decode_rpc_request,
     decode_rpc_response,
     decode_rpc_stats,
     decode_rpc_value,
+    encode_obs_query,
+    encode_obs_reply,
     encode_rpc_request,
     encode_rpc_response,
     encode_rpc_stats,
@@ -104,6 +114,19 @@ from ..transport.wire import (
 _log = get_logger("gateway")
 
 _COMPLETED = int(RequestResultCode.COMPLETED)
+
+
+class _WireCtx:
+    """Trace context lifted off an RPC request frame — exactly the two
+    fields ``NodeHost.propose``'s ``parent`` contract reads, so a
+    gateway client's root span stitches into the server-side
+    request→raft→apply spans."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
 
 
 class RpcLeaseNotHeld(RequestError):
@@ -148,6 +171,7 @@ class RpcServer:
         *,
         fault_controller=None,
         allow_fault_ops: bool = False,
+        enable_obs_ops: bool = True,
         max_inflight: int = 64,
         wait_grace: float = 0.25,
     ):
@@ -155,6 +179,11 @@ class RpcServer:
         self.listen_address = listen_address
         self._fault = fault_controller
         self._allow_fault_ops = allow_fault_ops
+        # enable_obs_ops=False simulates a pre-obs server binary:
+        # RPC_OP_OBS falls through to "unknown op" and collectors mark
+        # the process no-obs (the degrade matrix's testable hinge)
+        self._enable_obs_ops = enable_obs_ops
+        self._obs = ObsService(nh)
         self._sem = threading.Semaphore(max_inflight)
         # wait() a touch past the client's own deadline so the CLIENT
         # observes its timeout first and the reply (late TIMEOUT) is
@@ -290,7 +319,13 @@ class RpcServer:
                 s = Session(shard_id=q.shard_id, client_id=q.client_id,
                             series_id=q.series_id,
                             responded_to=q.responded_to)
-                rs = nh.propose(s, q.payload, timeout)
+                # trace context off the frame: the server-side propose
+                # span continues the CLIENT's trace (cross-process
+                # stitch); trace_id 0 = untraced request
+                parent = (
+                    _WireCtx(q.trace_id, q.span_id) if q.trace_id else None
+                )
+                rs = nh.propose(s, q.payload, timeout, parent=parent)
                 # sliced wait: a NodeHost closed mid-flight leaves its
                 # RequestStates permanently pending — detecting that
                 # here turns a full client-timeout stall into a fast
@@ -337,6 +372,8 @@ class RpcServer:
                     return RpcResponse(req_id=q.req_id, code=RPC_ERR_DENIED,
                                        error="fault ops disabled")
                 return self._handle_fault(q)
+            if q.op == RPC_OP_OBS and self._enable_obs_ops:
+                return self._handle_obs(q)
             return RpcResponse(req_id=q.req_id, code=RPC_ERR,
                                error=f"unknown op {q.op}")
         except SystemBusy as e:
@@ -401,6 +438,28 @@ class RpcServer:
         return RpcResponse(req_id=q.req_id, code=_COMPLETED,
                            data=encode_rpc_value(val))
 
+    def _handle_obs(self, q: RpcRequest) -> RpcResponse:
+        """Fleet-scope telemetry queries (``RPC_OP_OBS``, sub-kind in
+        ``flags``).  The query's ``epoch`` is client-held bookkeeping
+        (restart detection happens collector-side against the epoch in
+        the reply) — the server only honors cursor+limit."""
+        try:
+            cursor, _epoch, limit = decode_obs_query(q.payload)
+        except WireError as e:
+            return RpcResponse(req_id=q.req_id, code=RPC_ERR,
+                               error=f"bad obs query: {e}")
+        if q.flags == RPC_OBS_METRICS:
+            reply = self._obs.metrics_snapshot()
+        elif q.flags == RPC_OBS_RECORDER:
+            reply = self._obs.recorder_tail(cursor, limit=limit)
+        elif q.flags == RPC_OBS_SPANS:
+            reply = self._obs.trace_spans(cursor, limit=limit)
+        else:
+            return RpcResponse(req_id=q.req_id, code=RPC_ERR,
+                               error=f"unknown obs kind {q.flags}")
+        return RpcResponse(req_id=q.req_id, code=_COMPLETED,
+                           data=encode_obs_reply(reply))
+
     def _handle_fault(self, q: RpcRequest) -> RpcResponse:
         from .. import faults as faults_mod
 
@@ -448,7 +507,7 @@ class _RemoteCall:
     ``_event.is_set()`` without any lock)."""
 
     __slots__ = ("req_id", "op", "noop", "sent", "expires", "code",
-                 "result", "resp", "error", "_event")
+                 "result", "resp", "error", "span", "traced", "_event")
 
     def __init__(self, req_id: int, op: int, noop: bool, expires: float):
         self.req_id = req_id
@@ -460,6 +519,10 @@ class _RemoteCall:
         self.result: Optional[Result] = None
         self.resp: Optional[RpcResponse] = None
         self.error = ""
+        # client-side rpc span (ends in notify — the single completion
+        # point); traced = this frame carried trace context on the wire
+        self.span = None
+        self.traced = False
         self._event = threading.Event()
 
     def notify(self, code: RequestResultCode, result=None, resp=None,
@@ -469,6 +532,11 @@ class _RemoteCall:
         self.resp = resp
         self.error = error
         self._event.set()
+        sp = self.span
+        if sp is not None:
+            sp.end(
+                "ok" if code == RequestResultCode.COMPLETED else code.name
+            )
 
     def wait(self, timeout: float) -> RequestResultCode:
         if not self._event.wait(timeout):
@@ -520,15 +588,25 @@ class RemoteHostHandle:
         lease_timeout: float = 0.5,
         propose_attempt_cap: float = 2.0,
         breaker: Optional[_Breaker] = None,
+        tracer=None,
     ):
         self.address = address
         self.config = _RemoteConfig(rtt_millisecond)
-        # attrs the gateway probes with getattr(): no recorder/tracer/
+        # attrs the gateway probes with getattr(): no recorder/
         # transport plane on a remote handle (cap feedback, shed dumps
-        # and event taps stay host-side)
+        # and event taps stay host-side).  ``tracer`` is the CLIENT
+        # process's tracer: propose starts an rpc:propose span whose
+        # context rides the request frame — the server-side spans
+        # continue it (the cross-process stitch).
         self.recorder = None
-        self.tracer = None
+        self.tracer = tracer
         self.transport = None
+        # trace degrade latch: old servers reject v1 frames by tearing
+        # the connection; a teardown with traced frames in flight
+        # before ANY traced exchange succeeded latches tracing off for
+        # this address (retries go untraced = byte-identical v0)
+        self._trace_confirmed = False
+        self._trace_disabled = False
         self._connect_timeout = connect_timeout
         self._stats_max_age = stats_max_age
         self._stats_timeout = stats_timeout
@@ -646,6 +724,21 @@ class RemoteHostHandle:
                 "rpc %s: connection lost (%s); failing %d pending",
                 self.address, why, len(pending),
             )
+        if (
+            not self._trace_confirmed
+            and not self._trace_disabled
+            and any(rc.traced for rc in pending.values())
+        ):
+            # an old server tears the connection on the first v1 frame
+            # it sees — before any traced exchange has ever succeeded
+            # that teardown is indistinguishable from "doesn't speak
+            # v1", so degrade: this handle goes untraced from here on
+            self._trace_disabled = True
+            _log.warning(
+                "rpc %s: tore connection on traced frame before any "
+                "confirmation; disabling trace context (old server?)",
+                self.address,
+            )
         self._breaker.failure()
         for rc in pending.values():
             self._fail_rc(rc, why)
@@ -669,6 +762,7 @@ class RemoteHostHandle:
         timeout: float = 1.0,
         arg: int = 0,
         payload: bytes = b"",
+        span=None,
     ) -> _RemoteCall:
         timeout_ms = max(50, min(int(timeout * 1000.0), 0xFFFFFFFF))
         q = RpcRequest(
@@ -678,6 +772,10 @@ class RemoteHostHandle:
             responded_to=session.responded_to if session is not None else 0,
             timeout_ms=timeout_ms, arg=arg, payload=payload,
         )
+        traced = span is not None and not self._trace_disabled
+        if traced:
+            q.trace_id = span.trace_id
+            q.span_id = span.span_id
         buf_noop = session is None or session.is_noop()
         sock = self._ensure_conn()
         now = time.monotonic()
@@ -688,6 +786,8 @@ class RemoteHostHandle:
             q.req_id = self._req_seq
             rc = _RemoteCall(q.req_id, op, buf_noop,
                              now + timeout_ms / 1000.0 + 5.0)
+            rc.span = span
+            rc.traced = traced
             self._pending[q.req_id] = rc
             expired = [
                 p for p in self._pending.values()
@@ -733,6 +833,10 @@ class RemoteHostHandle:
 
     def _complete(self, rc: _RemoteCall, p: RpcResponse) -> None:
         self._breaker.success()
+        if rc.traced:
+            # a traced frame got a reply: the server speaks v1, the
+            # degrade latch can never fire for this handle again
+            self._trace_confirmed = True
         if rc.op == RPC_OP_PROPOSE:
             if p.code <= int(RequestResultCode.COMMITTED):
                 code = RequestResultCode(p.code)
@@ -798,10 +902,25 @@ class RemoteHostHandle:
             # dedupes); noop proposals are never retried, so their one
             # attempt keeps the caller's full timeout.
             timeout = min(timeout, self._propose_attempt_cap)
+        # root span for the wire hop: its context rides the request
+        # frame, so the server-side request→raft→apply spans stitch
+        # into the SAME trace.  parent=None roots a new trace here;
+        # a caller-held parent is continued; UNSAMPLED propagates the
+        # root's no (same contract as NodeHost.propose).
+        span = None
+        tracer = self.tracer
+        if tracer is not None and not self._trace_disabled:
+            if parent is None:
+                span = tracer.start_trace("rpc:propose", session.shard_id)
+            elif parent is not UNSAMPLED:
+                span = tracer.start_span(
+                    "rpc:propose", parent.trace_id, parent.span_id,
+                    session.shard_id,
+                )
         try:
             return self._submit(
                 RPC_OP_PROPOSE, shard_id=session.shard_id, session=session,
-                timeout=timeout, payload=cmd,
+                timeout=timeout, payload=cmd, span=span,
             )
         except (RequestDropped, SystemBusy, OSError) as e:
             # unreachable OR breaker-dark remote: complete as DROPPED
@@ -809,12 +928,16 @@ class RemoteHostHandle:
             # raised errors as TERMINAL, but DROPPED is retryable
             # through other hosts
             rc = _RemoteCall(0, RPC_OP_PROPOSE, session.is_noop(), 0.0)
+            rc.span = span
             rc.notify(RequestResultCode.DROPPED, error=str(e))
             return rc
 
     def sync_propose(self, session: Session, cmd: bytes,
-                     timeout: float = 5.0):
-        rc = self.propose(session, cmd, timeout)
+                     timeout: float = 5.0, parent=None):
+        # parent mirrors NodeHost.sync_propose: a tracer-holding handle
+        # is a drop-in nodehost for propose_with_retry, whose root span
+        # arrives here and parents the rpc:propose wire hop
+        rc = self.propose(session, cmd, timeout, parent=parent)
         return self._finish(rc, timeout + 0.5)
 
     def try_lease_read(self, shard_id: int, query, margin_ticks: int = 2):
@@ -970,6 +1093,34 @@ class RemoteHostHandle:
 
     def remove_event_tap(self, tap) -> None:
         return None
+
+    # -- fleet-scope telemetry (obs/fleetscope.py) -------------------------
+    def obs_query(self, what: str, *, cursor: int = 0, epoch: int = 0,
+                  limit: int = 256, timeout: float = 2.0) -> dict:
+        """One fleet-scope query against the remote (``RPC_OP_OBS``).
+        ``what``: metrics | recorder | spans.  Returns the decoded
+        reply dict annotated with ``bytes`` (the reply payload size,
+        the scope's overhead counter).  Raises :class:`ObsUnsupported`
+        against a pre-obs server (the collector marks it no-obs)."""
+        flags = {
+            "metrics": RPC_OBS_METRICS,
+            "recorder": RPC_OBS_RECORDER,
+            "spans": RPC_OBS_SPANS,
+        }[what]
+        rc = self._submit(
+            RPC_OP_OBS, flags=flags, timeout=timeout,
+            payload=encode_obs_query(cursor=cursor, epoch=epoch,
+                                     limit=limit),
+        )
+        try:
+            result = self._finish(rc, timeout + 0.5)
+        except RequestError as e:
+            if "unknown op" in str(e):
+                raise ObsUnsupported(str(e))
+            raise
+        reply = decode_obs_reply(result.data)
+        reply["bytes"] = len(result.data)
+        return reply
 
     # -- nemesis plane (scenario harness only) -----------------------------
     def send_fault(self, action: str, *, fault: Optional[dict] = None,

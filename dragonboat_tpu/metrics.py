@@ -45,6 +45,43 @@ def _base_name(name: str) -> str:
     return name.split("{", 1)[0]
 
 
+def _parse_labels(series: str) -> Dict[str, str]:
+    """Inverse of :func:`_labeled`: the label dict out of a full series
+    name, honoring the three text-format escapes.  Registry keys are
+    produced by ``_labeled`` so the walk can assume well-formed
+    ``k="v",...`` pairs; anything malformed yields what parsed so far
+    (snapshot is observability, never a raise path)."""
+    i = series.find("{")
+    if i < 0:
+        return {}
+    out: Dict[str, str] = {}
+    s = series[i + 1:series.rfind("}")]
+    pos = 0
+    while pos < len(s):
+        eq = s.find('="', pos)
+        if eq < 0:
+            break
+        key = s[pos:eq]
+        val = []
+        j = eq + 2
+        while j < len(s):
+            c = s[j]
+            if c == "\\" and j + 1 < len(s):
+                nxt = s[j + 1]
+                val.append("\n" if nxt == "n" else nxt)
+                j += 2
+                continue
+            if c == '"':
+                break
+            val.append(c)
+            j += 1
+        out[key] = "".join(val)
+        pos = j + 1
+        if pos < len(s) and s[pos] == ",":
+            pos += 1
+    return out
+
+
 class Counter:
     __slots__ = ("name", "value")
 
@@ -205,6 +242,45 @@ class MetricsRegistry:
         return _T()
 
     # -- export ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Structured, delta-able dump: full series name -> entry with
+        parsed base name/labels, the current value and a ``monotone``
+        flag (counters and histogram count/sum only ever grow — the
+        fleet-scope SLO evaluator deltas exactly those; gauges are
+        levels and must be read, not differenced).  Same
+        snapshot-under-the-lock / format-outside discipline as
+        ``export_text`` (Gauge.get runs user callbacks)."""
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._hists.values())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for c in counters:
+            out["counters"][c.name] = {
+                "name": _base_name(c.name),
+                "labels": _parse_labels(c.name),
+                "value": c.value,
+                "monotone": True,
+            }
+        for g in gauges:
+            out["gauges"][g.name] = {
+                "name": _base_name(g.name),
+                "labels": _parse_labels(g.name),
+                "value": g.get(),
+                "monotone": False,
+            }
+        for h in hists:
+            out["histograms"][h.name] = {
+                "name": _base_name(h.name),
+                "labels": _parse_labels(h.name),
+                "bounds": list(h.bounds),
+                "buckets": list(h.buckets),
+                "count": h.count,
+                "sum": h.total,
+                "monotone": True,
+            }
+        return out
+
     def export_text(self) -> str:
         """Prometheus text exposition format."""
         out = []

@@ -90,6 +90,10 @@ class NodeHost:
     def __init__(self, config: NodeHostConfig):
         config.validate()
         self.config = config
+        # process-identity timestamp the fleet scope reports in every
+        # obs reply: a collector cross-checks uptime against its
+        # epoch-based restart detection (docs/OBSERVABILITY.md)
+        self._started_mono = time.monotonic()
         # shard_id -> node (one replica/shard); guarded-by: _nodes_lock
         self._nodes: Dict[int, Node] = {}
         # quiesce tick-parking: quiesced-idle nodes leave the active
@@ -1172,3 +1176,8 @@ class NodeHost:
 
     def raft_address(self) -> str:
         return self.config.raft_address
+
+    @property
+    def uptime_s(self) -> float:
+        """Seconds since this NodeHost was constructed (obs identity)."""
+        return time.monotonic() - self._started_mono

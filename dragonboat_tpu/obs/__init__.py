@@ -11,10 +11,17 @@ recorder (docs/OBSERVABILITY.md).
   transition too — the moment the front door starts refusing work
   there must be a cross-host record of why).
 
+* :mod:`.fleetscope` — the cross-process telemetry plane: the
+  ``RPC_OP_OBS`` server side plus the :class:`FleetScope` collector
+  merging every fleet process's recorder/span tails into one timeline;
+* :mod:`.slo` — declarative objectives evaluated from fleet metric
+  deltas into burn-rate rows (``FleetScope.slo_report``).
+
 Both are off by default (``NodeHostConfig.enable_tracing`` /
 ``enable_flight_recorder``); the disabled hot paths cost one attribute
 load.
 """
+from .fleetscope import FleetScope, ObsService, ObsUnsupported
 from .recorder import (
     FlightRecorder,
     attach_timeline,
@@ -23,6 +30,7 @@ from .recorder import (
     merged_timeline,
     record_all,
 )
+from .slo import DEFAULT_OBJECTIVES, Objective, evaluate as evaluate_slo
 from .trace import (
     Span,
     Tracer,
@@ -33,11 +41,17 @@ from .trace import (
 )
 
 __all__ = [
+    "DEFAULT_OBJECTIVES",
+    "FleetScope",
     "FlightRecorder",
+    "Objective",
+    "ObsService",
+    "ObsUnsupported",
     "Span",
     "Tracer",
     "UNSAMPLED",
     "attach_timeline",
+    "evaluate_slo",
     "export_merged_json",
     "format_timeline",
     "hosts_timeline",

@@ -18,12 +18,21 @@ Events are ``(monotonic_ts, host, shard_id, kind, detail)`` tuples in a
 per-shard ``deque(maxlen=...)`` — recording is a lock + append, old
 events fall off, a recorder can run for weeks.  ``shard_id 0`` is the
 global lane (host-level and fault-plane events).
+
+Internally each ring entry additionally carries a recorder-wide
+monotone sequence number (assigned under the record lock) so
+:meth:`FlightRecorder.tail` gives remote collectors an EXACT resume
+cursor: seq gaps in a slice are events that fell off a ring, a reply
+whose ``epoch`` changed (or whose seq regressed) is a restarted
+process.  ``events()`` strips the seq — the public Event tuple shape
+is unchanged.
 """
 from __future__ import annotations
 
 import threading
 import time
 from collections import deque
+from random import getrandbits
 from typing import Dict, List, Optional, Tuple
 
 Event = Tuple[float, str, int, str, str]
@@ -42,12 +51,19 @@ class FlightRecorder:
         self._rings: Dict[int, deque] = {}
         self._global: deque = deque(maxlen=global_capacity)
         self.recorded = 0
+        # restart identity: a collector that sees a different epoch (or
+        # a regressed seq) on the same address knows the rings belong
+        # to a NEW process incarnation and resets its cursor
+        self.epoch = getrandbits(63) | 1
+        self._seq = 0
 
     def record(self, shard_id: int, kind: str, detail: str = "") -> None:
-        e: Event = (time.monotonic(), self.host, int(shard_id), kind,
-                    str(detail))
+        ts = time.monotonic()
         with self._lock:
+            self._seq += 1
             self.recorded += 1
+            e = (self._seq, ts, self.host, int(shard_id), kind,
+                 str(detail))
             if shard_id:
                 ring = self._rings.get(shard_id)
                 if ring is None:
@@ -61,12 +77,37 @@ class FlightRecorder:
         lane, or every ring when ``shard_id`` is None."""
         with self._lock:
             if shard_id is None:
-                out = [e for ring in self._rings.values() for e in ring]
+                out = [e[1:] for ring in self._rings.values() for e in ring]
             else:
-                out = list(self._rings.get(shard_id, ()))
-            out.extend(self._global)
+                out = [e[1:] for e in self._rings.get(shard_id, ())]
+            out.extend(e[1:] for e in self._global)
         out.sort(key=lambda e: e[0])
         return out
+
+    def tail(self, cursor: int = 0, *, limit: int = 256) -> dict:
+        """Bounded ring slice past a client-held cursor, for remote
+        collectors (``RPC_OBS_RECORDER``): the oldest ``limit`` events
+        whose seq is past ``cursor``, oldest first, each as
+        ``[seq, ts, host, shard_id, kind, detail]``.  ``next_cursor``
+        resumes the poll exactly; ``dropped`` counts seqs in the window
+        that already fell off a ring (the wrap the cursor can't hide);
+        ``epoch``/``seq`` let the collector detect a restarted process
+        (new epoch, or seq below its cursor)."""
+        with self._lock:
+            rows = [e for ring in self._rings.values()
+                    for e in ring if e[0] > cursor]
+            rows.extend(e for e in self._global if e[0] > cursor)
+            seq = self._seq
+        rows.sort(key=lambda e: e[0])
+        dropped = (rows[-1][0] - cursor - len(rows)) if rows else 0
+        rows = rows[:max(0, int(limit))]
+        return {
+            "epoch": self.epoch,
+            "seq": seq,
+            "next_cursor": rows[-1][0] if rows else cursor,
+            "dropped": dropped,
+            "events": [list(e) for e in rows],
+        }
 
     def dump(self, shard_id: Optional[int] = None) -> str:
         """Human-readable timeline (the auto-dump format)."""

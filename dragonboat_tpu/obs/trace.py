@@ -54,7 +54,7 @@ class Span:
 
     __slots__ = (
         "tracer", "trace_id", "span_id", "parent_id", "name", "host",
-        "shard_id", "start", "end_ts", "status", "annotations",
+        "shard_id", "start", "end_ts", "status", "annotations", "seq",
         "__weakref__",
     )
 
@@ -71,6 +71,9 @@ class Span:
         self.end_ts = 0.0
         self.status = ""
         self.annotations: List[Tuple[float, str]] = []
+        # finished-ring position, assigned in end() under the tracer
+        # lock: the cursor remote collectors resume finished_tail by
+        self.seq = 0
 
     def annotate(self, label: str) -> None:
         self.annotations.append((time.monotonic(), label))
@@ -86,6 +89,8 @@ class Span:
                 return
             self.end_ts = time.monotonic()
             self.status = status
+            tracer._fin_seq += 1
+            self.seq = tracer._fin_seq
             tracer._live.discard(self)
             tracer._spans.append(self)
 
@@ -124,6 +129,10 @@ class Tracer:
         self._rng = Random(seed)
         self.started = 0
         self.unsampled = 0
+        # finished-ring sequencing for remote tails (same restart-
+        # detection contract as FlightRecorder.epoch/_seq)
+        self._fin_seq = 0
+        self.epoch = self._rng.getrandbits(63) | 1
 
     def _id(self) -> int:
         # caller holds self._lock.  63-bit so ids ride u64 wire fields
@@ -168,6 +177,42 @@ class Tracer:
         because it is stuck."""
         with self._lock:
             return list(self._spans) + list(self._live)
+
+    def finished_tail(self, cursor: int = 0, *, limit: int = 256) -> dict:
+        """Bounded finished-span ring slice past a client-held cursor
+        (``RPC_OBS_SPANS``): the oldest ``limit`` spans ended after
+        ``cursor``, serialized as plain dicts.  Mirrors
+        ``FlightRecorder.tail``'s cursor/epoch/dropped contract; open
+        spans are NOT included (they have no seq yet — a collector sees
+        them on the poll after they end)."""
+        with self._lock:
+            rows = [s for s in self._spans if s.seq > cursor]
+            seq = self._fin_seq
+        rows.sort(key=lambda s: s.seq)
+        dropped = (rows[-1].seq - cursor - len(rows)) if rows else 0
+        rows = rows[:max(0, int(limit))]
+        return {
+            "epoch": self.epoch,
+            "seq": seq,
+            "next_cursor": rows[-1].seq if rows else cursor,
+            "dropped": dropped,
+            "spans": [
+                {
+                    "seq": s.seq,
+                    "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                    "parent_id": s.parent_id,
+                    "name": s.name,
+                    "host": s.host,
+                    "shard_id": s.shard_id,
+                    "start": s.start,
+                    "end": s.end_ts,
+                    "status": s.status,
+                    "ann": [[ts, label] for ts, label in list(s.annotations)],
+                }
+                for s in rows
+            ],
+        }
 
     # -- export ----------------------------------------------------------
     def trace_events(self) -> List[dict]:

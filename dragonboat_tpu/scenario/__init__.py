@@ -27,7 +27,12 @@ process behind the RPC/TCP ingress with real gossip liveness, so the
 nemesis's whole-host kill is a true ``SIGKILL``.
 """
 from .fleet import CORE, LAGGARD, SPARE, WITNESS, DayFleet
-from .multiproc import ProcFleet, run_mini_multiproc_day, run_rpc_smoke
+from .multiproc import (
+    ProcFleet,
+    run_fleetobs_smoke,
+    run_mini_multiproc_day,
+    run_rpc_smoke,
+)
 from .plan import DISTURBANCE_CLASSES, DayPlan, Phase, SH_DISK, SH_MEM
 from .report import DayReport
 from .runner import ScenarioRunner
@@ -46,6 +51,7 @@ __all__ = [
     "SPARE",
     "ScenarioRunner",
     "WITNESS",
+    "run_fleetobs_smoke",
     "run_mini_multiproc_day",
     "run_rpc_smoke",
 ]

@@ -41,11 +41,24 @@ from ..faults import assert_recovery_sla, asym_pair
 from ..gateway import Gateway, GatewayBusy, GatewayConfig
 from ..gateway.rpc import RemoteHostHandle, RouteFeeder
 from ..logger import get_logger
+from ..obs import FleetScope, Tracer
 from ..transport.gossip import GossipManager
 
 _log = get_logger("scenario")
 
 SHARD = 1
+
+
+class _GatewayObs:
+    """The PARENT process as a fleet-scope target: the gateway's own
+    metrics registry plus the client tracer whose rpc:propose roots the
+    cross-process stitches.  No flight recorder in the parent."""
+
+    def __init__(self, gateway: Gateway, tracer: Optional[Tracer]):
+        self.metrics = gateway.metrics
+        self.tracer = tracer
+        self.recorder = None
+        self.host = "gateway"
 
 
 class ProcFleet:
@@ -65,6 +78,11 @@ class ProcFleet:
         self.gossip: Optional[GossipManager] = None
         self.gateway: Optional[Gateway] = None
         self.feeder: Optional[RouteFeeder] = None
+        # fleet-scope telemetry: the client-side tracer rides every
+        # handle (trace context on request frames) and the scope polls
+        # every worker + the parent itself
+        self.tracer: Optional[Tracer] = None
+        self.scope: Optional[FleetScope] = None
         if fresh:
             shutil.rmtree(workdir, ignore_errors=True)
         os.makedirs(workdir, exist_ok=True)
@@ -110,6 +128,7 @@ class ProcFleet:
             self.procs[idx] = self._spawn(idx)
         for idx in range(1, self.n + 1):
             self.ready[idx] = self._wait_ready(idx)
+        self.tracer = Tracer(host="gateway", sample_rate=1.0)
         for idx in range(1, self.n + 1):
             # keyed by the child's NodeHostID: with address_by_nodehost_id
             # the membership addresses (and hence the collector's
@@ -117,7 +136,8 @@ class ProcFleet:
             # restart over the same dirs keeps the id — so the handle
             # registration survives kills
             self.handles[self._key(idx)] = RemoteHostHandle(
-                self.ready[idx]["rpc"], rtt_millisecond=20
+                self.ready[idx]["rpc"], rtt_millisecond=20,
+                tracer=self.tracer,
             )
         # observer membership in the children's gossip mesh: liveness
         # for the RouteFeeder comes from DIRECT contact, exactly what a
@@ -137,6 +157,15 @@ class ProcFleet:
         )
         self.feeder = RouteFeeder(self.gateway, self.gossip, interval=0.25)
         self.feeder.start()
+        # the telemetry plane: one collector over every worker (polled
+        # via RPC_OP_OBS) AND the parent gateway process (polled
+        # in-proc) — the merged timeline crosses the process boundary
+        self.scope = FleetScope()
+        for idx in range(1, self.n + 1):
+            self.scope.add_process(self._key(idx),
+                                   self.handles[self._key(idx)])
+        self.scope.add_process("gateway",
+                               _GatewayObs(self.gateway, self.tracer))
 
     def _key(self, idx: int) -> str:
         return self.ready[idx]["nhid"]
@@ -208,6 +237,8 @@ class ProcFleet:
 
     # -- teardown ---------------------------------------------------------
     def close(self) -> None:
+        if self.scope is not None:
+            self.scope.close()
         if self.feeder is not None:
             self.feeder.close()
         if self.gateway is not None:
@@ -364,6 +395,10 @@ def _mp_proc_kill(fleet: ProcFleet, phase, report: dict) -> None:
     the outside)."""
     sla_ticks = int(phase.param("sla_ticks", 4000))
     victim = fleet.leader_slot()
+    if fleet.scope is not None:
+        # the kill window lands on the merged timeline AND the poll
+        # window the SLO evaluator attributes the burn to
+        fleet.scope.mark("proc_kill", f"slot={victim} (leader)")
     fleet.kill(victim)
     t0 = time.monotonic()
     assert_recovery_sla(
@@ -381,6 +416,8 @@ def _mp_proc_kill(fleet: ProcFleet, phase, report: dict) -> None:
         except Exception:  # noqa: BLE001 — still replaying/joining
             pass
         time.sleep(0.2)
+    if fleet.scope is not None:
+        fleet.scope.mark("proc_restart", f"slot={victim}")
 
 
 def _mp_asym_partition(fleet: ProcFleet, phase, report: dict) -> None:
@@ -440,10 +477,13 @@ def run_mini_multiproc_day(n: int = 3, *, workdir: str = "/tmp/mpday",
     try:
         fleet.start()
         gw = fleet.gateway
+        scope = fleet.scope
+        scope.start_poller(0.25)
         rec = HistoryRecorder()
         traffic = _Traffic(gw, rec)
         traffic.start()
         for phase in plan.phases:
+            scope.mark("phase", phase.name)
             if phase.action == "proc_kill":
                 _mp_proc_kill(fleet, phase, report)
             elif phase.action == "asym_partition":
@@ -464,6 +504,69 @@ def run_mini_multiproc_day(n: int = 3, *, workdir: str = "/tmp/mpday",
         assert not stale, "\n".join(v.describe() for v in stale)
         report["audit"] = "ok"
         report["counts"] = rec.counts()
+
+        # -- the telemetry verdict: gap, stitches, burn-rate ledger -----
+        scope.close()
+        scope.poll()  # final sweep so post-cooldown deltas land
+        timeline = scope.merged_timeline()
+        kinds = {e[3] for e in timeline}
+        assert "obs_gap" in kinds, "kill window left no gap on the timeline"
+        assert "proc_kill" in kinds
+        stitches = scope.cross_process_stitches()
+        assert stitches >= 1, "no cross-process trace stitched"
+        slo_rows = scope.slo_report()
+        assert slo_rows, "empty SLO report"
+        report["slo"] = slo_rows
+        report["obs"] = {
+            "stitches": stitches,
+            "polls": scope.polls,
+            "reply_bytes": scope.reply_bytes,
+            "procs": scope.proc_report(),
+        }
         return report
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the ~5s telemetry CI gate (scripts/fleetobs_smoke.sh)
+# ---------------------------------------------------------------------------
+def run_fleetobs_smoke(n: int = 2, *, workdir: str = "/tmp/fleetobs-smoke",
+                       base_port: int = 29850) -> dict:
+    """Fleet-scope smoke: a 2-process fleet takes gateway proposals
+    carrying trace context, the scope polls every process over
+    ``RPC_OP_OBS``, and the gate asserts at least one proposal's trace
+    stitched across the RPC boundary plus a JSON-parseable SLO report
+    with the full objective catalog."""
+    fleet = ProcFleet(n, workdir=workdir, base_port=base_port)
+    try:
+        fleet.start()
+        gw = fleet.gateway
+        scope = fleet.scope
+        h = gw.connect(SHARD, timeout=30.0)
+        for i in range(8):
+            h.sync_propose(audit_set_cmd(f"obs{i}", str(i)), timeout=10.0)
+        assert gw.read(SHARD, "obs0", timeout=10.0) == "0"
+        gw.close_handle(h)
+        # spans end server-side on apply completion; two polls with a
+        # short settle pick up the full request->raft->apply chains
+        scope.poll()
+        time.sleep(0.3)
+        scope.poll()
+        stitches = scope.cross_process_stitches()
+        assert stitches >= 1, (
+            f"no cross-process stitch:\n{scope.dump(SHARD)}"
+        )
+        rows = scope.slo_report()
+        json.dumps(rows)  # the report must be a plain-JSON ledger
+        assert {r["objective"] for r in rows} >= {
+            "commit_p99", "shed_ratio"}, rows
+        return {
+            "stitches": stitches,
+            "polls": scope.polls,
+            "reply_bytes": scope.reply_bytes,
+            "slo_objectives": len(rows),
+            "burning": [r["objective"] for r in rows if r["burning"]],
+        }
     finally:
         fleet.close()

@@ -71,12 +71,20 @@ def main() -> None:
     raft_addr = f"127.0.0.1:{base_port + idx}"
     gossip_addr = f"127.0.0.1:{base_port + 100 + idx}"
     rpc_addr = f"127.0.0.1:{base_port + 200 + idx}"
+    # fleet-scope observability: tracing + flight recorder ON by
+    # default so the parent's FleetScope has something to poll over
+    # RPC_OP_OBS; DRAGONBOAT_PROC_OBS=0 runs the worker dark (the
+    # degrade-matrix shape where recorder_tail answers enabled=False)
+    obs_on = bool(int(os.environ.get("DRAGONBOAT_PROC_OBS", "1")))
     nh = NodeHost(
         NodeHostConfig(
             nodehost_dir=f"{workdir}/nh-{idx}",
             rtt_millisecond=20,
             raft_address=raft_addr,
             address_by_nodehost_id=True,
+            enable_tracing=obs_on,
+            trace_sample_rate=1.0,
+            enable_flight_recorder=obs_on,
             gossip=GossipConfig(
                 bind_address=gossip_addr,
                 # every worker seeds at slot 1's gossip port; the

@@ -43,6 +43,11 @@ class DayReport:
     #: device-path counters from the colocated fleet member's engine
     #: group (empty when the day ran host-only)
     colocated: Dict[str, int] = field(default_factory=dict)
+    #: SLO burn-rate rows from the fleet scope (obs/slo.py) — the
+    #: day's objective ledger with burning windows attributed to the
+    #: collector marks (kill windows, phase boundaries) inside them.
+    #: Carried, not gating: ``ok`` stays the recovery/audit verdict.
+    slo: List[dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -80,6 +85,7 @@ class DayReport:
             "aborted": self.aborted,
             "plan": self.plan,
             "colocated": dict(self.colocated),
+            "slo": list(self.slo),
         }
 
     def to_json(self, path: str = "") -> str:
@@ -125,6 +131,16 @@ class DayReport:
                 f"  {r.get('count', 0):>10}  {r.get('worst_s', 0.0):>7}"
                 f"  {r.get('p99_s', 0.0):>5}  {r.get('min_margin_s', 0.0)}"
             )
+        if self.slo:
+            lines.append("")
+            lines.append("objective             burn   bad/good      "
+                         "burning-windows")
+            for r in self.slo:
+                lines.append(
+                    f"{r['objective']:<20}  {r['burn_rate']:>5}"
+                    f"  {r['bad']:.0f}/{r['good']:.0f}"
+                    f"{'':<6}  {len(r.get('windows', ()))}"
+                )
         verdict = "OK" if self.ok else (
             f"ABORTED in {self.aborted}" if self.aborted else "VIOLATIONS"
         )

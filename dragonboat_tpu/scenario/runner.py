@@ -50,7 +50,7 @@ from ..faults import (
 )
 from ..gateway import GatewayBusy
 from ..logger import get_logger
-from ..obs import record_all
+from ..obs import FleetScope, record_all
 from ..readplane import Consistency
 from .fleet import CORE, LAGGARD, SPARE, WITNESS, DayFleet
 from .plan import SH_DISK, SH_MEM, DayPlan, Phase
@@ -216,6 +216,60 @@ class _Traffic:
             time.sleep(2 * self.pace)
 
 
+class _SlotObs:
+    """Fleet-scope target for one in-proc day slot, resolved at POLL
+    time: a rolling restart replaces ``fleet.hosts[addr]`` with a new
+    NodeHost, and the scope's epoch check reads the new incarnation's
+    rings from their start without re-registration."""
+
+    def __init__(self, fleet: DayFleet, addr: str):
+        self._fleet = fleet
+        self._addr = addr
+        self.host = addr
+
+    def _nh(self):
+        return self._fleet.hosts.get(self._addr)
+
+    def raft_address(self) -> str:
+        return self._addr
+
+    @property
+    def metrics(self):
+        return getattr(self._nh(), "metrics", None)
+
+    @property
+    def recorder(self):
+        return getattr(self._nh(), "recorder", None)
+
+    @property
+    def tracer(self):
+        return getattr(self._nh(), "tracer", None)
+
+    @property
+    def nodehost_id(self):
+        return getattr(self._nh(), "nodehost_id", "")
+
+    @property
+    def uptime_s(self):
+        return getattr(self._nh(), "uptime_s", None)
+
+
+class _GatewayObs:
+    """The day's gateway as a fleet-scope target (its own registry
+    carries the request histogram + shed counters the SLO catalog
+    selects)."""
+
+    def __init__(self, fleet: DayFleet):
+        self._fleet = fleet
+        self.host = "gateway"
+        self.recorder = None
+        self.tracer = None
+
+    @property
+    def metrics(self):
+        return getattr(self._fleet.gateway, "metrics", None)
+
+
 class ScenarioRunner:
     """Execute one :class:`DayPlan`; see module docstring."""
 
@@ -242,6 +296,9 @@ class ScenarioRunner:
         )
         self._dr_epoch = 0
         self._probe_cid: Optional[int] = None
+        # the day's telemetry plane: polled at phase boundaries, its
+        # burn-rate rows land on report.slo (docs/OBSERVABILITY.md)
+        self.scope: Optional[FleetScope] = None
 
     # ------------------------------------------------------------------
     def run(self) -> DayReport:
@@ -258,6 +315,11 @@ class ScenarioRunner:
         traffic = None
         try:
             self.fleet.build()
+            self.scope = FleetScope()
+            for addr in list(self.fleet.hosts):
+                self.scope.add_process(addr, _SlotObs(self.fleet, addr))
+            self.scope.add_process("gateway", _GatewayObs(self.fleet))
+            self.scope.poll()  # baseline window: warmup deltas start here
             self._probe_cid = self.rec.new_client()
             traffic = _Traffic(self.fleet, self.rec, pace=self.traffic_pace)
             traffic.start()
@@ -332,6 +394,8 @@ class ScenarioRunner:
         self.report.recovery = RECOVERY_STATS.snapshot()
         self.report.wall_s = time.monotonic() - t_day
         self._dip_table()
+        if self.scope is not None:
+            self.report.slo = self.scope.slo_report()
         return self.report
 
     # ------------------------------------------------------------------
@@ -363,6 +427,8 @@ class ScenarioRunner:
         record_all(
             self.fleet.live_hosts(), 0, "day:phase", phase.name
         )
+        if self.scope is not None:
+            self.scope.mark("phase", phase.name)
         t0 = time.monotonic()
         s0 = self._sample()
         extras: Dict[str, object] = {}
@@ -432,6 +498,10 @@ class ScenarioRunner:
         record_all(
             self.fleet.live_hosts(), 0, "day:phase-end", phase.name
         )
+        if self.scope is not None:
+            # one poll window per phase: the SLO evaluator's burn rows
+            # attribute straight to phase boundaries
+            self.scope.poll()
 
     def _do_action(self, phase: Phase) -> Dict[str, object]:
         a = phase.action

@@ -673,7 +673,15 @@ def decode_rsm_snapshot(data: bytes) -> dict:
 # untrusted client connections and must never execute code or allocate
 # unboundedly on decode.
 
-RPC_BIN_VER = 0
+# v0: the original layout.  v1 appends a trace-context section (flag
+# byte + trace_id/span_id, the pb.Message discipline) — but the encoder
+# only stamps v1 when trace context is actually present, so an untraced
+# request stays BYTE-IDENTICAL to v0 and an old (v0-only) server keeps
+# working as long as nobody traces at it.  A traced frame against an
+# old server tears the connection (future-version refusal); the client
+# handle latches tracing off for that address and retries untraced
+# (gateway/rpc.py, docs/OBSERVABILITY.md "Degrade matrix").
+RPC_BIN_VER = 1
 
 # request ops
 RPC_OP_PROPOSE = 1
@@ -682,6 +690,9 @@ RPC_OP_SESSION_OPEN = 3
 RPC_OP_SESSION_CLOSE = 4
 RPC_OP_STATS = 5
 RPC_OP_FAULT = 6
+RPC_OP_OBS = 7  # fleet-scope telemetry (obs/fleetscope.py); old
+                # servers answer RPC_ERR "unknown op 7" and the
+                # collector marks the process "no-obs"
 
 # READ flags (RpcRequest.flags)
 RPC_READ_LEASE = 0   # lease fast path ONLY; ERR_NO_LEASE when not held
@@ -700,6 +711,11 @@ RPC_READ_BOUNDED = 4   # bounded staleness: local read stamped with the
 # payload section.  Flag-gated because OLD decoders reject trailing
 # bytes — a new server must never send the section unsolicited.
 RPC_STATS_READ_PATHS = 1
+
+# OBS sub-kinds (RpcRequest.flags for RPC_OP_OBS)
+RPC_OBS_METRICS = 1   # structured MetricsRegistry.snapshot() + identity
+RPC_OBS_RECORDER = 2  # flight-recorder ring slice past a cursor
+RPC_OBS_SPANS = 3     # finished-span ring slice past a cursor
 
 # response codes: 0..6 are RequestResultCode values verbatim; the 0x60
 # block is transport/ingress-level outcomes that have no node-side code
@@ -721,15 +737,18 @@ class RpcRequest:
     client-side; the server reconstructs an ephemeral Session per
     request).  ``timeout_ms`` is the per-request deadline the server
     bounds its own wait by; ``arg`` is op-specific (lease margin ticks
-    for READ/LEASE)."""
+    for READ/LEASE).  ``trace_id``/``span_id`` carry the client root
+    span's context (0 = untraced) so a gateway propose stitches into
+    the server-side request→raft→apply spans — same contract as
+    ``pb.Message.trace_id``."""
 
     __slots__ = ("req_id", "op", "flags", "shard_id", "client_id",
                  "series_id", "responded_to", "timeout_ms", "arg",
-                 "payload")
+                 "payload", "trace_id", "span_id")
 
     def __init__(self, req_id=0, op=0, flags=0, shard_id=0, client_id=0,
                  series_id=0, responded_to=0, timeout_ms=1000, arg=0,
-                 payload=b""):
+                 payload=b"", trace_id=0, span_id=0):
         self.req_id = req_id
         self.op = op
         self.flags = flags
@@ -740,6 +759,8 @@ class RpcRequest:
         self.timeout_ms = timeout_ms
         self.arg = arg
         self.payload = payload
+        self.trace_id = trace_id
+        self.span_id = span_id
 
 
 class RpcResponse:
@@ -760,8 +781,13 @@ class RpcResponse:
 def encode_rpc_request(q: RpcRequest) -> bytes:
     if len(q.payload) > _RPC_MAX_CMD:
         raise WireError(f"rpc payload too large: {len(q.payload)}")
+    # v1 is stamped ONLY when trace context rides the frame: untraced
+    # requests stay byte-identical to v0, so mixed-version fleets only
+    # pay the degrade path when someone actually traces at an old
+    # server (and the client latch then falls back to v0 frames)
+    traced = bool(q.trace_id)
     b = BytesIO()
-    _wu32(b, RPC_BIN_VER)
+    _wu32(b, RPC_BIN_VER if traced else 0)
     _wu64(b, q.req_id)
     _wu8(b, q.op)
     _wu8(b, q.flags)
@@ -772,6 +798,10 @@ def encode_rpc_request(q: RpcRequest) -> bytes:
     _wu32(b, q.timeout_ms)
     _wu32(b, q.arg)
     _wb(b, q.payload)
+    if traced:
+        _wu8(b, 1)
+        _wu64(b, q.trace_id)
+        _wu64(b, q.span_id)
     return b.getvalue()
 
 
@@ -788,6 +818,11 @@ def decode_rpc_request(data: bytes) -> RpcRequest:
         client_id=r.u64(), series_id=r.u64(), responded_to=r.u64(),
         timeout_ms=r.u32(), arg=r.u32(), payload=r.blob(),
     )
+    if bin_ver >= 1:
+        # trace-context section: flag byte + ids (pb.Message discipline)
+        if r.u8():
+            q.trace_id = r.u64()
+            q.span_id = r.u64()
     if len(q.payload) > _RPC_MAX_CMD:
         raise WireError(f"rpc payload too large: {len(q.payload)}")
     if r.pos != len(data):
@@ -965,3 +1000,76 @@ def decode_rpc_stats(data: bytes):
     if r.pos != len(data):
         raise WireError(f"trailing bytes: {len(data) - r.pos}")
     return nodehost_id, raft_address, rows, read_paths
+
+
+# ---------------------------------------------------------------------------
+# fleet-scope obs payloads (obs/fleetscope.py, RPC_OP_OBS)
+# ---------------------------------------------------------------------------
+# The query is positional binary (cursor/epoch don't fit RpcRequest.arg:
+# sequence numbers and epochs are u64).  The reply is versioned JSON —
+# same lane as RPC_OP_FAULT's spec payload: the content is a nested
+# metrics/events/spans dump whose shape evolves faster than a positional
+# layout should, and it only ever flows server -> trusted collector.
+# Replies are still BOUNDED: every ring is sliced with an explicit
+# limit server-side (raftlint's obs-bound rule) and the decoder refuses
+# oversized blobs outright.
+
+OBS_BIN_VER = 1
+
+_OBS_MAX_REPLY = 4 * 1024 * 1024  # decoded-reply bound (collector side)
+
+
+def encode_obs_query(cursor: int = 0, epoch: int = 0,
+                     limit: int = 256) -> bytes:
+    b = BytesIO()
+    _wu32(b, OBS_BIN_VER)
+    _wu64(b, cursor)
+    _wu64(b, epoch)
+    _wu32(b, limit)
+    return b.getvalue()
+
+
+def decode_obs_query(data: bytes):
+    """(cursor, epoch, limit); an empty payload decodes as defaults so
+    a hand-rolled probe without a query section still answers."""
+    if not data:
+        return 0, 0, 256
+    r = _R(data)
+    bin_ver = r.u32()
+    if bin_ver > OBS_BIN_VER:
+        raise WireError(
+            f"obs query bin_ver {bin_ver} is newer than supported "
+            f"{OBS_BIN_VER}"
+        )
+    cursor = r.u64()
+    epoch = r.u64()
+    limit = r.u32()
+    if r.pos != len(data):
+        raise WireError(f"trailing bytes: {len(data) - r.pos}")
+    return cursor, epoch, limit
+
+
+def encode_obs_reply(obj: dict) -> bytes:
+    import json as _json
+
+    body = {"v": OBS_BIN_VER}
+    body.update(obj)
+    data = _json.dumps(body, separators=(",", ":")).encode("utf-8")
+    if len(data) > _OBS_MAX_REPLY:
+        raise WireError(f"obs reply too large: {len(data)}")
+    return data
+
+
+def decode_obs_reply(data: bytes) -> dict:
+    import json as _json
+
+    if len(data) > _OBS_MAX_REPLY:
+        raise WireError(f"obs reply too large: {len(data)}")
+    try:
+        obj = _json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"bad obs reply: {e}")
+    v = obj.get("v") if isinstance(obj, dict) else None
+    if not isinstance(v, int) or v > OBS_BIN_VER or v < 1:
+        raise WireError(f"obs reply version {v!r} not supported")
+    return obj

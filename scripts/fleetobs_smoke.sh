@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Fleet-scope telemetry smoke (docs/OBSERVABILITY.md "Fleet scope"): a
+# REAL two-OS-process fleet (scenario/procworker children, RPC/TCP +
+# gossip) under gateway proposals CARRYING TRACE CONTEXT, with the
+# parent's FleetScope polling every process over RPC_OP_OBS.  Asserts
+#   1. at least one proposal's trace stitched ACROSS the RPC boundary
+#      (client rpc:propose span + server-side spans, same trace_id,
+#      distinct hosts),
+#   2. the scope's poll loop collected metrics/recorder/span tails from
+#      every process (bounded ring slices — the obs-bound lint rule),
+#   3. the SLO burn-rate ledger evaluates as plain JSON and carries the
+#      full default objective catalog (commit_p99, shed_ratio, ...).
+# ~5s — wired into tier1.sh as a post-step.  The SIGKILL-gap acceptance
+# run (leader killed mid-day, obs_gap on the merged timeline) is the
+# DRAGONBOAT_MULTIPROC=1 gear of tests/test_fleetobs.py, not run here.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import logging
+
+logging.basicConfig(level=logging.ERROR)
+
+from dragonboat_tpu.scenario import run_fleetobs_smoke
+
+out = run_fleetobs_smoke(n=2, workdir="/tmp/fleetobs-smoke-ci",
+                         base_port=29850)
+assert out["stitches"] >= 1, out
+assert out["polls"] >= 2 and out["reply_bytes"] > 0, out
+print(
+    "FLEETOBS_SMOKE_OK "
+    f"procs=2 stitches={out['stitches']} polls={out['polls']} "
+    f"reply_bytes={out['reply_bytes']} "
+    f"slo_objectives={out['slo_objectives']} "
+    f"burning={json.dumps(out['burning'])}"
+)
+EOF

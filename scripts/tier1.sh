@@ -27,7 +27,11 @@
 # the read-plane smoke (scripts/readplane_smoke.sh, ~3s: 3-replica
 # shard behind the gateway, one read per consistency level with the
 # follower path actually taken, full audit incl. the bounded-read
-# containment pass green)
+# containment pass green),
+# the fleet-scope telemetry smoke (scripts/fleetobs_smoke.sh, ~5s:
+# 2-process fleet under traced gateway proposals, >=1 trace stitched
+# across the RPC boundary, bounded obs tails polled from every
+# process, JSON SLO burn-rate ledger with the full objective catalog)
 # and the static-analysis gates + analyzer
 # self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
 # Prints
@@ -54,5 +58,6 @@ timeout -k 10 240 bash scripts/multichip_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/scenario_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/rpc_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/readplane_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/fleetobs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

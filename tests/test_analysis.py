@@ -1073,3 +1073,53 @@ def test_stream_read_ignore_annotation_is_live():
     )
     fs = lint_source(stripped, "dragonboat_tpu/bigstate/dr.py")
     assert len(fs) == 2 and rules_of(fs) == {"stream-read"}
+
+
+# ---------------------------------------------------------------------------
+# obs-bound (the fleet-scope obs plane: every ring slice is bounded)
+# ---------------------------------------------------------------------------
+OBS_BOUND_SRC = '''
+def answer(rec, tracer, svc, cursor):
+    a = rec.tail(cursor)
+    b = tracer.finished_tail(cursor)
+    c = svc.recorder_tail(cursor, limit=256)
+    d = svc.trace_spans(cursor, limit=64)
+    return a, b, c, d
+
+
+def drain(rec, cursor):
+    # raftlint: ignore[obs-bound] local dump path, never crosses the wire
+    return rec.tail(cursor)
+'''
+
+
+def test_obs_bound_flags_unlimited_tails_in_obs_modules():
+    for mod in (
+        "dragonboat_tpu/obs/fleetscope.py",
+        "dragonboat_tpu/gateway/rpc.py",
+    ):
+        fs = lint_source(OBS_BOUND_SRC, mod)
+        # the two limit-less slices flagged; the explicit limit= calls
+        # and the annotated drain() pass
+        assert rules_of(fs) == {"obs-bound"} and len(fs) == 2, (mod, fs)
+
+
+def test_obs_bound_scoped_to_obs_reply_modules():
+    assert lint_source(OBS_BOUND_SRC, "dragonboat_tpu/obs/recorder.py") == []
+    assert lint_source(OBS_BOUND_SRC, "dragonboat_tpu/nodehost.py") == []
+
+
+def test_obs_bound_ignore_annotation_is_live():
+    stripped = OBS_BOUND_SRC.replace(
+        "# raftlint: ignore[obs-bound]", "# stripped"
+    )
+    fs = lint_source(stripped, "dragonboat_tpu/obs/fleetscope.py")
+    assert len(fs) == 3 and rules_of(fs) == {"obs-bound"}
+
+
+def test_obs_bound_repo_is_clean():
+    # the real obs plane must itself obey the rule it ships
+    for rel in raftlint.OBS_REPLY_MODULES:
+        with open(os.path.join(REPO, rel)) as f:
+            fs = lint_source(f.read(), rel)
+        assert not [x for x in fs if x.rule == "obs-bound"], (rel, fs)
