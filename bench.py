@@ -866,6 +866,56 @@ def phase_jaxcheck() -> dict:
     }
 
 
+def phase_wirecheck() -> dict:
+    """Wire-plane auditor bench guard (analysis/wirecheck,
+    docs/ANALYSIS.md "Wire-plane audit").
+
+    Times the FULL audit at the lint-gate fuzz depth (goldens + skew
+    matrix + 500 mutations/decoder + rot guards) — the number
+    scripts/lint.sh's <60s gate budget rides on — and measures
+    per-codec encode/decode throughput over the registry's canonical
+    frames so a codec perf regression (a decoder growing an O(n^2)
+    scan, an encoder copying twice) shows in the r-ledgers, not only
+    as a mysteriously slower transport.  Host-only bytes work: no
+    device, no sockets, no disk."""
+    import time as _time
+
+    from dragonboat_tpu.analysis import wire_registry, wirecheck
+
+    t0 = _time.perf_counter()
+    findings = wirecheck.audit(fuzz_n=500)
+    wall = _time.perf_counter() - t0
+    codecs: dict = {}
+    for e in wire_registry.REGISTRY:
+        label = next(iter(e.samples))
+        blob = e.samples[label]()
+        # enough reps for a stable number, capped so the big frames
+        # (snapshotio container) don't dominate the phase budget
+        n = max(20, min(2000, (4 << 20) // max(len(blob), 1)))
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            e.decode(blob)
+        dt = _time.perf_counter() - t0
+        row = {
+            "bytes": len(blob),
+            "dec_mb_s": round(len(blob) * n / dt / 1e6, 1),
+        }
+        if e.encode is not None:
+            t0 = _time.perf_counter()
+            for _ in range(n):
+                e.encode()
+            et = _time.perf_counter() - t0
+            row["enc_mb_s"] = round(len(blob) * n / et / 1e6, 1)
+        codecs[e.name] = row
+    return {
+        "codecs_registered": len(wire_registry.REGISTRY),
+        "goldens": sum(len(e.samples) for e in wire_registry.REGISTRY),
+        "findings": len(findings),
+        "audit_wall_s": round(wall, 2),
+        "codecs": codecs,
+    }
+
+
 def phase_hostplane(rows_list=None, launches: int = 6) -> dict:
     """Host-plane plan/merge stage cost, scalar (the r5 shape) vs
     vectorized (r6, ops/hostplane.py), over fabricated generations.
@@ -3464,7 +3514,8 @@ def main() -> None:
              balance=None, obs=None, lockcheck=None, jaxcheck=None,
              gateway=None, bigstate=None, hostplane=None,
              pipeline=None, multichip=None, updatelanes=None,
-             day=None, readplane=None, fleetobs=None) -> None:
+             day=None, readplane=None, fleetobs=None,
+             wirecheck=None) -> None:
         # schema note (r5, verdict #9): "device_loop" is phase B — the
         # raw kernel+router loop with NO NodeHost/WAL/sessions/futures
         # (the r4 JSON called this "consensus", inviting its 19k/s to be
@@ -3543,6 +3594,12 @@ def main() -> None:
                     # reply bytes per bounded poll + stitch/SLO verdict
                     # — docs/OBSERVABILITY.md "Fleet scope")
                     "fleetobs": fleetobs,
+                    # r19 schema addition: wire-plane auditor guard
+                    # (analysis/wirecheck; full-audit wall time at the
+                    # lint-gate fuzz depth + per-codec encode/decode
+                    # MB/s over the golden corpus — docs/ANALYSIS.md
+                    # "Wire-plane audit")
+                    "wirecheck": wirecheck,
                 }
             ),
             flush=True,
@@ -3879,6 +3936,24 @@ def main() -> None:
         emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
              lck, jck, gwb, bsb, hpb, ppb, mcb, ulb, dayb, rpb, fob)
 
+    # Wire-plane auditor guard (host-only bytes work, ~5s;
+    # BENCH_WIRECHECK gate): full wirecheck audit wall time at the
+    # lint-gate fuzz depth + per-codec encode/decode MB/s over the
+    # golden corpus (docs/ANALYSIS.md "Wire-plane audit")
+    wck = None
+    if bool(int(os.environ.get("BENCH_WIRECHECK", "1"))) and remaining() > 45:
+        code = (
+            "import json, bench;"
+            "print('BENCHWIRE ' + json.dumps(bench.phase_wirecheck()))"
+        )
+        wck, wc_err = run_sub(
+            code, "BENCHWIRE", max(45, min(120, int(remaining() - 30)))
+        )
+        if wck is None:
+            wck = {"error": wc_err or "failed"}
+        emit(ticks_per_sec, a_groups, device_loop, consensus, balance, obs,
+             lck, jck, gwb, bsb, hpb, ppb, mcb, ulb, dayb, rpb, fob, wck)
+
     # phase-A retry polish: only with phases B/C already banked and time
     # left over (a failed A records -1 above; a smaller-G fallback is
     # clearly labeled via phase_a_groups)
@@ -3933,6 +4008,10 @@ if __name__ == "__main__":
         # — full windows unless BENCH_SMOKE=1 / BENCH_FLEETOBS_* say
         # otherwise (docs/OBSERVABILITY.md "Fleet scope")
         print("BENCHFO " + json.dumps(phase_fleetobs()), flush=True)
+    elif "phase_wirecheck" in _sys.argv[1:]:
+        # standalone wire-plane run: `python bench.py phase_wirecheck`
+        # (docs/ANALYSIS.md "Wire-plane audit")
+        print("BENCHWIRE " + json.dumps(phase_wirecheck()), flush=True)
     elif "phase_updatelanes" in _sys.argv[1:]:
         # standalone update-lane run: `python bench.py phase_updatelanes`
         # (host-only numpy; BENCH_UPDATELANES_HEAVY=1 adds 50k/250k)

@@ -1,6 +1,7 @@
 """CLI: ``python -m dragonboat_tpu.analysis [--baseline F] [paths...]``
-(raftlint) or ``python -m dragonboat_tpu.analysis --jax [--baseline F]``
-(the device-plane program auditor, docs/ANALYSIS.md)."""
+(raftlint), ``python -m dragonboat_tpu.analysis --jax [--baseline F]``
+(the device-plane program auditor) or ``--wire [--baseline F]
+[--update-goldens]`` (the wire-compat auditor, docs/ANALYSIS.md)."""
 import sys
 
 argv = sys.argv[1:]
@@ -9,6 +10,12 @@ if "--jax" in argv:
     from .jaxcheck import main as _jax_main
 
     sys.exit(_jax_main(argv))
+
+if "--wire" in argv:
+    argv.remove("--wire")
+    from .wirecheck import main as _wire_main
+
+    sys.exit(_wire_main(argv))
 
 from .raftlint import main
 

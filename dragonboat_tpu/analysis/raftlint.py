@@ -177,6 +177,14 @@ WIDTH_MODULES = (
     "dragonboat_tpu/storage/tan.py",
     "dragonboat_tpu/storage/kvlogdb.py",
     "dragonboat_tpu/storage/snapshotio.py",
+    # codec modules grown after the original rule list froze
+    # (PR 20 wirecheck sweep): resume frames, rpc value/stats,
+    # bigstate checkpoint/WAL records, journal framing, kvstore blocks
+    "dragonboat_tpu/transport/tcp.py",
+    "dragonboat_tpu/gateway/rpc.py",
+    "dragonboat_tpu/bigstate/ondisk.py",
+    "dragonboat_tpu/storage/journal.py",
+    "dragonboat_tpu/storage/kvstore.py",
 )
 # the pure-device modules: host syncs are banned outright (engine.py /
 # colocated.py legitimately sync — that is where launches read back)

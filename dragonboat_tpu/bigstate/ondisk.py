@@ -42,6 +42,7 @@ import threading
 import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..pb import MASK64
 from ..statemachine import IOnDiskStateMachine, Result, SnapshotStopped
 from ..storage import vfs as vfs_mod
 
@@ -275,7 +276,7 @@ class OnDiskKV(IOnDiskStateMachine):
         if self._wal is None:
             raise RuntimeError("OnDiskKV.update before open()")
         for e in entries:
-            body = _u64.pack(e.index) + e.cmd
+            body = _u64.pack(e.index & MASK64) + e.cmd
             frame = _frame_hdr.pack(len(body), zlib.crc32(body)) + body
             self._wal.write(frame)
             self._wal_bytes += len(frame)
@@ -331,8 +332,8 @@ class OnDiskKV(IOnDiskStateMachine):
 
         def all_chunks() -> Iterator[bytes]:
             yield _u32.pack(_MAGIC) + bytes([_BASE_VERSION])
-            yield _u64.pack(applied)
-            yield _u64.pack(count)
+            yield _u64.pack(applied & MASK64)
+            yield _u64.pack(count & MASK64)
             for k, v in seq:
                 body = _u32.pack(len(k)) + k + v
                 yield _frame_hdr.pack(len(body), zlib.crc32(body))
@@ -367,7 +368,7 @@ class OnDiskKV(IOnDiskStateMachine):
         """Stream the prepared view record-by-record (bounded memory)."""
         applied, data = ctx
         w.write(_u32.pack(_MAGIC) + bytes([_BASE_VERSION]))
-        w.write(_u64.pack(applied))
+        w.write(_u64.pack(applied & MASK64))
         w.write(_u64.pack(len(data)))
         i = 0
         for k, v in data.items():

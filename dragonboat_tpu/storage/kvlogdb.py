@@ -25,6 +25,7 @@ from ..pb import MASK64, Bootstrap, Entry, Snapshot, State, Update
 from ..raftio import ILogDB, NodeInfo, RaftState
 from ..transport.wire import (
     _R,
+    WireError,
     _r_entry,
     _r_snapshot,
     _w_entry,
@@ -78,6 +79,8 @@ def _enc_state(st: State) -> bytes:
 
 
 def _dec_state(data: bytes) -> State:
+    if len(data) != 24:
+        raise WireError(f"state record must be 24 bytes, got {len(data)}")
     t, v, c = struct.unpack("<QQQ", data)
     return State(term=t, vote=v, commit=c)
 

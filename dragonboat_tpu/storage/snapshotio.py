@@ -249,14 +249,37 @@ class _SMStream:
                 f"block {self._block}: checksum mismatch"
             )
         if flags & BF_ZLIB:
-            body = zlib.decompress(body)
+            # bounded: a forged block must not expand past what a
+            # legitimate writer could ever have produced (zip bomb)
+            d = zlib.decompressobj()
+            try:
+                body = d.decompress(body, MAX_BLOCK_SIZE + 1)
+            except zlib.error as e:
+                raise SnapshotCorruptError(
+                    f"block {self._block}: bad zlib stream: {e}"
+                )
+            if d.unconsumed_tail or len(body) > MAX_BLOCK_SIZE:
+                raise SnapshotCorruptError(
+                    f"block {self._block}: decompressed block exceeds "
+                    f"{MAX_BLOCK_SIZE} bytes"
+                )
         elif flags & BF_SNAPPY:
             if self._snappy is None:
                 raise SnapshotCorruptError(
                     f"block {self._block}: snappy-compressed but snappy "
                     "is unavailable"
                 )
-            body = self._snappy.decompress(body)
+            try:
+                body = self._snappy.decompress(body)
+            except Exception as e:
+                raise SnapshotCorruptError(
+                    f"block {self._block}: bad snappy stream: {e!r}"
+                )
+            if len(body) > MAX_BLOCK_SIZE:
+                raise SnapshotCorruptError(
+                    f"block {self._block}: decompressed block exceeds "
+                    f"{MAX_BLOCK_SIZE} bytes"
+                )
         self._block += 1
         return body
 

@@ -21,7 +21,7 @@ import zlib
 from typing import Optional
 
 from ..logger import get_logger
-from ..pb import Chunk, MessageBatch
+from ..pb import MASK64, Chunk, MessageBatch
 from ..raftio import (
     ChunkHandler,
     IConnection,
@@ -319,7 +319,8 @@ class TCPTransport(ITransport):
                     if self.resume_handler is not None:
                         cursor = self.resume_handler(decode_chunk(payload))
                     _write_frame(
-                        sock, KIND_RESUME_RESP, struct.pack("<Q", cursor)
+                        sock, KIND_RESUME_RESP,
+                        struct.pack("<Q", cursor & MASK64),
                     )
                 else:
                     raise WireError(f"unknown frame kind {kind}")

@@ -171,13 +171,29 @@ class _R:
         return self.take(n)
 
     def s(self) -> str:
-        return self.blob().decode("utf-8")
+        try:
+            return self.blob().decode("utf-8")
+        except UnicodeDecodeError as e:
+            # UnicodeDecodeError is a ValueError; the frame-error
+            # contract (wirecheck fuzz) wants the narrow type so the
+            # transport loop never has to catch anything broader
+            raise WireError(f"invalid utf-8 string field: {e}")
 
     def count(self) -> int:
         n = self.u32()
         if n > MAX_ITEMS:
             raise WireError(f"count too large: {n}")
         return n
+
+
+def _enum(cls, v: int):
+    """Enum conversion under the frame-error contract: an unknown
+    discriminant byte is malformed wire data (WireError), not a
+    ValueError leaking enum internals to the transport loop."""
+    try:
+        return cls(v)
+    except ValueError:
+        raise WireError(f"unknown {cls.__name__} value {v}")
 
 
 # ---------------------------------------------------------------------------
@@ -197,7 +213,7 @@ def _w_entry(b: BytesIO, e: Entry) -> None:
 def _r_entry(r: _R) -> Entry:
     term = r.u64()
     index = r.u64()
-    etype = EntryType(r.u8())
+    etype = _enum(EntryType, r.u8())
     key = r.u64()
     client_id = r.u64()
     series_id = r.u64()
@@ -297,7 +313,7 @@ def _r_snapshot(r: _R) -> Snapshot:
     witness = bool(r.u8())
     imported = bool(r.u8())
     stype = r.u8()
-    compression = CompressionType(r.u8())
+    compression = _enum(CompressionType, r.u8())
     return Snapshot(
         filepath=filepath,
         file_size=file_size,
@@ -352,7 +368,7 @@ def _w_message(b: BytesIO, m: Message) -> None:
 
 
 def _r_message(r: _R, bin_ver: int = MESSAGE_BATCH_BIN_VER) -> Message:
-    mtype = MessageType(r.u8())
+    mtype = _enum(MessageType, r.u8())
     reject = bool(r.u8())
     to, from_, shard_id, term, log_term, log_index, commit, hint, hint_high = (
         r.u64() for _ in range(9)
@@ -445,7 +461,17 @@ _CF_DUMMY = 2
 _CF_FILE_INFO = 4
 
 
+# per-chunk payload bound, enforced BOTH ways (the OBS-reply
+# discipline): legit chunks are Soft.snapshot_chunk_size (2MB default),
+# so a length field anywhere near this is a forged frame, not data
+_CHUNK_MAX_DATA = 16 * 1024 * 1024
+
+
 def encode_chunk(c: Chunk) -> bytes:
+    if len(c.data) > _CHUNK_MAX_DATA:
+        raise WireError(
+            f"chunk data {len(c.data)}B exceeds {_CHUNK_MAX_DATA}B"
+        )
     b = BytesIO()
     for v in (
         c.shard_id,
@@ -498,6 +524,10 @@ def decode_chunk(data: bytes) -> Chunk:
     flags = r.u8()
     filepath = r.s()
     payload = r.blob()
+    if len(payload) > _CHUNK_MAX_DATA:
+        raise WireError(
+            f"chunk data {len(payload)}B exceeds {_CHUNK_MAX_DATA}B"
+        )
     membership = _r_membership(r)
     file_info = SnapshotFile()
     file_chunk_id = file_chunk_count = 0
@@ -560,7 +590,7 @@ def encode_config_change(cc: "ConfigChange") -> bytes:
 def decode_config_change(data: bytes) -> "ConfigChange":
     r = _R(data)
     ccid = r.u64()
-    cctype = ConfigChangeType(r.u8())
+    cctype = _enum(ConfigChangeType, r.u8())
     replica_id = r.u64()
     address = r.s()
     initialize = bool(r.u8())
@@ -575,6 +605,11 @@ def decode_config_change(data: bytes) -> "ConfigChange":
     )
 
 
+# per-result payload bound, both ways: cached session results are
+# proposal-sized, never snapshot-sized
+_SESSION_MAX_RESULT = 8 * 1024 * 1024
+
+
 def encode_session_table(sessions) -> bytes:
     """``sessions``: iterable of (client_id, responded_to,
     {series_id: Result}) in LRU order (order is preserved)."""
@@ -587,6 +622,11 @@ def encode_session_table(sessions) -> bytes:
         _wu32(b, len(history))
         for sid in sorted(history):
             res = history[sid]
+            if len(res.data) > _SESSION_MAX_RESULT:
+                raise WireError(
+                    f"session result {len(res.data)}B exceeds "
+                    f"{_SESSION_MAX_RESULT}B"
+                )
             _wu64(b, sid)
             _wu64(b, res.value)
             _wb(b, res.data)
@@ -606,6 +646,11 @@ def decode_session_table(data: bytes):
             sid = r.u64()
             value = r.u64()
             rdata = r.blob()
+            if len(rdata) > _SESSION_MAX_RESULT:
+                raise WireError(
+                    f"session result {len(rdata)}B exceeds "
+                    f"{_SESSION_MAX_RESULT}B"
+                )
             history[sid] = Result(value=value, data=rdata)
         out.append((client_id, responded_to, history))
     if r.pos != len(data):
@@ -614,6 +659,11 @@ def decode_session_table(data: bytes):
 
 
 RSM_SNAPSHOT_VERSION = 2
+
+# session-table section bound, both ways.  sm_data stays at the global
+# MAX_PAYLOAD (a full state-machine image is legitimately huge); the
+# session table is LRU-capped and can never approach this honestly.
+_RSM_MAX_SESSIONS = 64 * 1024 * 1024
 
 
 def encode_rsm_snapshot(
@@ -625,6 +675,10 @@ def encode_rsm_snapshot(
     sm_data,
     on_disk: bool,
 ) -> bytes:
+    if len(sessions) > _RSM_MAX_SESSIONS:
+        raise WireError(
+            f"session table {len(sessions)}B exceeds {_RSM_MAX_SESSIONS}B"
+        )
     b = BytesIO()
     _wu8(b, RSM_SNAPSHOT_VERSION)
     _wu8(b, int(on_disk))
@@ -648,6 +702,10 @@ def decode_rsm_snapshot(data: bytes) -> dict:
     term = r.u64()
     membership = _r_membership(r)
     sessions = r.blob()
+    if len(sessions) > _RSM_MAX_SESSIONS:
+        raise WireError(
+            f"session table {len(sessions)}B exceeds {_RSM_MAX_SESSIONS}B"
+        )
     sm_data = r.blob()
     if r.pos != len(data):
         raise WireError(f"trailing bytes: {len(data) - r.pos}")
@@ -931,6 +989,12 @@ def decode_rpc_value(data: bytes):
     return v
 
 
+# stats bounds, both ways: a host serves thousands of shards at most,
+# and the read-path label set is a small fixed vocabulary
+_STATS_MAX_ROWS = 1 << 16
+_STATS_MAX_READ_PATHS = 1 << 12
+
+
 def encode_rpc_stats(nodehost_id: str, raft_address: str, rows,
                      read_paths=None) -> bytes:
     """STATS response payload: the host identity plus its
@@ -948,6 +1012,15 @@ def encode_rpc_stats(nodehost_id: str, raft_address: str, rows,
     _ws(b, nodehost_id)
     _ws(b, raft_address)
     rows = list(rows)
+    if len(rows) > _STATS_MAX_ROWS:
+        raise WireError(
+            f"stats rows {len(rows)} exceeds {_STATS_MAX_ROWS}"
+        )
+    if read_paths is not None and len(read_paths) > _STATS_MAX_READ_PATHS:
+        raise WireError(
+            f"read-path rows {len(read_paths)} exceeds "
+            f"{_STATS_MAX_READ_PATHS}"
+        )
     _wu32(b, len(rows))
     for row in rows:
         for k in ("shard_id", "replica_id", "leader_id", "term",
@@ -970,7 +1043,10 @@ def decode_rpc_stats(data: bytes):
     nodehost_id = r.s()
     raft_address = r.s()
     rows = []
-    for _ in range(r.count()):
+    n_rows = r.count()
+    if n_rows > _STATS_MAX_ROWS:
+        raise WireError(f"stats rows {n_rows} exceeds {_STATS_MAX_ROWS}")
+    for _ in range(n_rows):
         shard_id = r.u64()
         replica_id = r.u64()
         leader_id = r.u64()
@@ -994,7 +1070,12 @@ def decode_rpc_stats(data: bytes):
     # here and the caller sees empty counts)
     read_paths = {}
     if r.pos != len(data):
-        for _ in range(r.count()):
+        n_paths = r.count()
+        if n_paths > _STATS_MAX_READ_PATHS:
+            raise WireError(
+                f"read-path rows {n_paths} exceeds {_STATS_MAX_READ_PATHS}"
+            )
+        for _ in range(n_paths):
             k = r.s()
             read_paths[k] = r.u64()
     if r.pos != len(data):

@@ -31,9 +31,13 @@
 # the fleet-scope telemetry smoke (scripts/fleetobs_smoke.sh, ~5s:
 # 2-process fleet under traced gateway proposals, >=1 trace stitched
 # across the RPC boundary, bounded obs tails polled from every
-# process, JSON SLO burn-rate ledger with the full objective catalog)
+# process, JSON SLO burn-rate ledger with the full objective catalog),
+# the wire-compat smoke (scripts/wirecheck_smoke.sh, ~3s: the full
+# wirecheck gate — goldens/skew/fuzz/rot-guards — plus a live
+# mutated-golden true positive)
 # and the static-analysis gates + analyzer
-# self-tests (scripts/lint.sh: raftlint + jaxcheck + fixtures, <3m).
+# self-tests (scripts/lint.sh: raftlint + jaxcheck + wirecheck +
+# fixtures, <3m).
 # Prints
 # DOTS_PASSED=<n> and a TIER1_BUDGET runtime line against the 870s
 # ROADMAP budget, and exits non-zero if any step fails.
@@ -59,5 +63,6 @@ timeout -k 10 120 bash scripts/scenario_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/rpc_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/readplane_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 120 bash scripts/fleetobs_smoke.sh || rc=$((rc == 0 ? 1 : rc))
+timeout -k 10 120 bash scripts/wirecheck_smoke.sh || rc=$((rc == 0 ? 1 : rc))
 timeout -k 10 300 bash scripts/lint.sh || rc=$((rc == 0 ? 1 : rc))
 exit $rc

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Wire-compat smoke (docs/ANALYSIS.md "Wire-plane audit"): the full
+# wirecheck gate exactly as lint.sh runs it (goldens + skew matrix +
+# 500-mutation deterministic fuzz + rot guards, EMPTY baseline), then
+# a live true-positive: a bit-flipped golden copy in a scratch dir
+# must produce a golden-drift finding NAMING the mutated frame — the
+# auditor is proven non-vacuous on every tier-1 run.  ~3s.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail
+timeout -k 5 60 env JAX_PLATFORMS=cpu python -m dragonboat_tpu.analysis \
+    --wire --baseline dragonboat_tpu/analysis/wire_baseline.txt || exit 1
+exec env JAX_PLATFORMS=cpu timeout -k 5 60 python - <<'EOF'
+import shutil, sys, tempfile
+
+from dragonboat_tpu.analysis import wire_registry
+from dragonboat_tpu.analysis.wirecheck import (
+    GOLDENS_DIR, check_goldens, golden_name,
+)
+
+e = wire_registry.entry("batch")
+with tempfile.TemporaryDirectory() as tmp:
+    shutil.copytree(GOLDENS_DIR, tmp, dirs_exist_ok=True)
+    path = f"{tmp}/{golden_name('batch', 'v1')}"
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(path, "wb").write(bytes(blob))
+    findings = check_goldens([e], tmp)
+rules = {f.rule for f in findings}
+named = any("batch" in f.message for f in findings)
+if rules != {"golden-drift"} or not named:
+    print(f"WIRECHECK_SMOKE: FAIL {findings}")
+    sys.exit(1)
+print("WIRECHECK_SMOKE: ok (gate green, mutated golden named)")
+EOF

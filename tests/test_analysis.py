@@ -1123,3 +1123,209 @@ def test_obs_bound_repo_is_clean():
         with open(os.path.join(REPO, rel)) as f:
             fs = lint_source(f.read(), rel)
         assert not [x for x in fs if x.rule == "obs-bound"], (rel, fs)
+
+
+# ---------------------------------------------------------------------------
+# wirecheck: the wire-plane auditor (codec registry, goldens, skew
+# matrix, deterministic fuzz, rot guards) — true-positive fixtures per
+# rule + the zero-unbaselined-tree gate, mirroring the raftlint section
+# ---------------------------------------------------------------------------
+import struct as _struct
+
+from dragonboat_tpu.analysis import wire_registry, wirecheck
+from dragonboat_tpu.analysis.wire_registry import CodecEntry
+from dragonboat_tpu.analysis.wirecheck import (
+    check_decode_bounds_source,
+    check_fuzz,
+    check_goldens,
+    check_skew,
+    golden_name,
+    scan_module_source,
+)
+
+
+def _entry(**kw):
+    base = dict(
+        name="fx",
+        module="fx.py",
+        samples={"v0": lambda: _struct.pack("<QQQ", 1, 2, 3)},
+        decode=lambda d: _struct.unpack("<QQQ", d),
+        errors=(ValueError,),
+    )
+    base.update(kw)
+    return CodecEntry(**base)
+
+
+class TestWirecheckGoldens:
+    def test_mutated_golden_is_named_frame_failure(self, tmp_path):
+        e = wire_registry.entry("config_change")
+        gdir = str(tmp_path)
+        check_goldens([e], gdir, update=True)
+        assert check_goldens([e], gdir) == []  # fresh corpus: clean
+        path = tmp_path / golden_name("config_change", "v0")
+        blob = bytearray(path.read_bytes())
+        blob[0] ^= 0xFF
+        path.write_bytes(bytes(blob))
+        fs = [f for f in check_goldens([e], gdir)]
+        assert [f.rule for f in fs] == ["golden-drift"]
+        assert "config_change" in fs[0].message  # NAMES the frame
+        assert golden_name("config_change", "v0") in fs[0].path
+
+    def test_missing_golden_reported(self, tmp_path):
+        e = wire_registry.entry("config_change")
+        fs = check_goldens([e], str(tmp_path))
+        assert {f.rule for f in fs} == {"golden-missing"}
+
+
+class TestWirecheckSkew:
+    def test_future_frame_decoding_silently_is_flagged(self, tmp_path):
+        # a decoder that ACCEPTS a future frame = silent field shift
+        e = _entry(decode=lambda d: 1, future=lambda: b"\xff" * 24)
+        fs = check_skew([e], str(tmp_path))
+        assert any(
+            f.rule == "skew-matrix" and "DECODED" in f.message for f in fs
+        )
+
+    def test_future_frame_broad_error_is_flagged(self, tmp_path):
+        def boom(d):
+            raise KeyError("nope")  # not the narrow type
+
+        e = _entry(decode=boom, future=lambda: b"\xff" * 24)
+        fs = check_skew([e], str(tmp_path))
+        assert any(
+            f.rule == "skew-matrix" and "narrow error" in f.message
+            for f in fs
+        )
+
+    def test_real_registry_skew_matrix_is_clean(self):
+        assert check_skew(list(wire_registry.REGISTRY),
+                          wirecheck.GOLDENS_DIR) == []
+
+
+class TestWirecheckFuzz:
+    def test_bare_struct_error_escape_caught(self, tmp_path):
+        fs = check_fuzz([_entry()], str(tmp_path), n=50)
+        assert any(f.rule == "fuzz-escape" and "struct" in f.message.lower()
+                   for f in fs)
+
+    def test_unbounded_allocation_caught(self, tmp_path):
+        e = _entry(
+            samples={"v0": lambda: b"\x00" * 8},
+            decode=lambda d: bytes(8 * 1024 * 1024),
+        )
+        fs = check_fuzz([e], str(tmp_path), n=5)
+        assert [f.rule for f in fs] == ["fuzz-alloc"]
+
+    def test_narrow_errors_pass(self, tmp_path):
+        def dec(d):
+            if len(d) != 24:
+                raise ValueError("bad length")
+            return _struct.unpack("<QQQ", d)
+
+        assert check_fuzz([_entry(decode=dec)], str(tmp_path), n=50) == []
+
+    def test_fuzz_is_deterministic(self, tmp_path):
+        runs = [check_fuzz([_entry()], str(tmp_path), n=30)
+                for _ in range(2)]
+        assert runs[0] == runs[1]  # same seed -> same first escape
+
+
+class TestWirecheckRotGuards:
+    FIXTURE = (
+        "KIND_WIDGET = 9\n"
+        "WIDGET_BIN_VER = 1\n"
+        "def decode_widget(data):\n"
+        "    return data\n"
+    )
+
+    def test_unregistered_surface_flagged(self):
+        fs = scan_module_source(self.FIXTURE, "m.py",
+                                claimed=("KIND_WIDGET",))
+        assert {f.rule for f in fs} == {"unregistered-codec"}
+        flagged = {f.message.split("`")[1] for f in fs}
+        assert flagged == {"WIDGET_BIN_VER", "decode_widget"}
+
+    def test_fully_claimed_surface_is_clean(self):
+        fs = scan_module_source(
+            self.FIXTURE, "m.py",
+            claimed=("KIND_WIDGET", "WIDGET_BIN_VER", "decode_widget"),
+        )
+        assert fs == []
+
+    def test_adding_decoder_to_covered_module_fails_gate(self):
+        # the acceptance pin: an unregistered decode_* appended to a
+        # REAL covered module must surface as a finding
+        rel = "dragonboat_tpu/transport/wire.py"
+        with open(os.path.join(REPO, rel)) as f:
+            src = f.read()
+        claimed = wire_registry.claimed_names(rel)
+        assert scan_module_source(src, rel, claimed) == []
+        src += "\ndef decode_widget(data):\n    return data\n"
+        fs = scan_module_source(src, rel, claimed)
+        assert [f.rule for f in fs] == ["unregistered-codec"]
+        assert "decode_widget" in fs[0].message
+
+    def test_decode_bound_stripped_cap_flagged(self):
+        src = (
+            "import struct\n"
+            "def decode_widget(data):\n"
+            "    n = struct.unpack(\"<I\", data)[0]\n"
+            "    return data.ljust(n)\n"
+        )
+        fs = check_decode_bounds_source(src, "m.py", ["decode_widget"])
+        assert [f.rule for f in fs] == ["decode-bound"]
+
+    def test_decode_bound_bare_zlib_flagged(self):
+        src = (
+            "import zlib\n"
+            "MAX_W = 10\n"
+            "def decode_widget(data):\n"
+            "    if len(data) > MAX_W:\n"
+            "        raise ValueError\n"
+            "    return zlib.decompress(data)\n"
+        )
+        fs = check_decode_bounds_source(src, "m.py", ["decode_widget"])
+        assert [f.rule for f in fs] == ["decode-bound"]
+        assert "zlib.decompress" in fs[0].message
+
+    def test_decode_bound_capped_decoder_clean(self):
+        src = (
+            "import struct\n"
+            "MAX_W = 10\n"
+            "def decode_widget(data):\n"
+            "    n = struct.unpack(\"<I\", data)[0]\n"
+            "    if n > MAX_W:\n"
+            "        raise ValueError\n"
+            "    return data.ljust(n)\n"
+        )
+        assert check_decode_bounds_source(
+            src, "m.py", ["decode_widget"]
+        ) == []
+
+    def test_missing_registered_decoder_flagged(self):
+        fs = check_decode_bounds_source("x = 1\n", "m.py", ["decode_gone"])
+        assert [f.rule for f in fs] == ["decode-bound"]
+        assert "not found" in fs[0].message
+
+
+def test_wire_baseline_ratchet_rides_raftlint_machinery(tmp_path):
+    fs = [Finding("fx.py", 1, "fuzz-escape", "m")]
+    p = tmp_path / "wb.txt"
+    write_baseline(str(p), fs)
+    new, stale = gate(fs, load_baseline(str(p)))
+    assert new == [] and stale == []
+    new, _ = gate(fs + [Finding("fx.py", 2, "fuzz-escape", "m")],
+                  load_baseline(str(p)))
+    assert len(new) == 2
+
+
+def test_wire_tree_gate_is_clean_with_checked_in_baseline():
+    """THE wire gate, same shape as scripts/lint.sh: zero unbaselined
+    findings over the full registry (goldens + skew + fuzz + rot
+    guards) with the checked-in (EMPTY) wire_baseline.txt."""
+    findings = wirecheck.audit(fuzz_n=40)
+    baseline = load_baseline(
+        os.path.join(REPO, "dragonboat_tpu/analysis/wire_baseline.txt")
+    )
+    new, _ = gate(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)

@@ -180,30 +180,28 @@ class TestWireTraceContext:
         # so the batch header is versioned: an unknown FUTURE version
         # must fail loudly (parsing it would shift fields), while the
         # known PAST version still decodes so a rolling upgrade keeps
-        # talking (v0 messages simply have no flag byte to read)
-        from io import BytesIO
+        # talking (v0 messages simply have no flag byte to read).
+        # The v0 byte layout is pinned ONCE by the golden corpus
+        # (tests/wire_goldens/batch__v0.bin); the future frame comes
+        # from the registry's canonical builder — no hand-built frames.
+        import os
 
-        m = Message(type=MessageType.HEARTBEAT, to=2, from_=1, shard_id=1)
+        from dragonboat_tpu.analysis import wire_registry
+        from dragonboat_tpu.analysis.wirecheck import (
+            GOLDENS_DIR,
+            golden_name,
+        )
 
-        def batch_bytes(bin_ver, strip_flag_byte):
-            b = BytesIO()
-            wire._ws(b, "a:1")
-            wire._wu64(b, 0)
-            wire._wu32(b, bin_ver)
-            wire._wu32(b, 1)
-            mb = BytesIO()
-            wire._w_message(mb, m)
-            raw = mb.getvalue()
-            b.write(raw[:-1] if strip_flag_byte else raw)
-            return b.getvalue()
-
-        out = wire.decode_batch(batch_bytes(0, strip_flag_byte=True))
+        path = os.path.join(GOLDENS_DIR, golden_name("batch", "v0"))
+        with open(path, "rb") as f:
+            v0 = f.read()
+        out = wire.decode_batch(v0)
         assert out.bin_ver == 0
         assert out.messages[0].trace_id == 0
         assert out.messages[0].shard_id == 1
 
         with pytest.raises(wire.WireError, match="newer"):
-            wire.decode_batch(batch_bytes(2, strip_flag_byte=False))
+            wire.decode_batch(wire_registry.entry("batch").future())
 
         # re-encoding always emits the current format, whatever was read
         assert wire.decode_batch(wire.encode_batch(out)).bin_ver == 1

@@ -366,3 +366,63 @@ def test_external_files_stream_across_hosts():
         _settings.Soft.snapshot_chunk_size = old_chunk
         for h in nhs.values():
             h.close()
+
+
+class TestBoundedBlockDecompress:
+    """Regression for the wirecheck fuzz-alloc finding (PR 20): a forged
+    zlib block must not expand past MAX_BLOCK_SIZE (decompression bomb),
+    and a corrupt compressed stream must fail with the narrow
+    SnapshotCorruptError, never a bare zlib.error."""
+
+    @staticmethod
+    def _block(body: bytes, flags: int) -> bytes:
+        import zlib
+
+        return (
+            struct.pack("<I", len(body))
+            + struct.pack("<I", zlib.crc32(body))
+            + bytes([flags])
+            + body
+        )
+
+    def test_zlib_bomb_block_rejected(self, monkeypatch):
+        import zlib
+
+        import dragonboat_tpu.storage.snapshotio as sio
+
+        # 100k of zeros compresses to ~120B: passes the on-wire length
+        # check, used to allocate the full expansion on decompress
+        bomb = zlib.compress(b"\x00" * 100_000)
+        monkeypatch.setattr(sio, "MAX_BLOCK_SIZE", 4096)
+        stream = sio._SMStream(
+            io.BytesIO(self._block(bomb, sio.BF_ZLIB)), 0, None
+        )
+        with pytest.raises(SnapshotCorruptError):
+            stream.read()
+
+    def test_corrupt_zlib_stream_is_narrow_error(self):
+        import dragonboat_tpu.storage.snapshotio as sio
+
+        stream = sio._SMStream(
+            io.BytesIO(self._block(b"not-a-zlib-stream", sio.BF_ZLIB)),
+            0,
+            None,
+        )
+        with pytest.raises(SnapshotCorruptError):
+            stream.read()
+
+    def test_legit_zlib_block_still_decodes(self):
+        import zlib
+
+        import dragonboat_tpu.storage.snapshotio as sio
+
+        payload = b"the-sm-bytes" * 10
+        stream = sio._SMStream(
+            io.BytesIO(
+                self._block(zlib.compress(payload), sio.BF_ZLIB)
+                + struct.pack("<I", 0)  # end sentinel
+            ),
+            0,
+            None,
+        )
+        assert stream.read() == payload

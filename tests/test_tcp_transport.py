@@ -211,7 +211,10 @@ class TestTCPSockets:
     def test_resume_query_roundtrip(self, pair):
         """The resumable-stream frame pair (docs/BIGSTATE.md): a
         KIND_RESUME_QUERY on the snapshot socket answers with the
-        receiver's cursor; no handler installed answers 0."""
+        receiver's cursor; no handler installed answers 0.  The resume
+        RESPONSE byte layout (u64 cursor) is pinned by the golden
+        corpus (tests/wire_goldens/resume_resp__v0.bin) — this test
+        covers only the socket behavior."""
         a, b, _, _ = pair
         probe = Chunk(
             shard_id=3, replica_id=2, from_=1, chunk_count=9,

@@ -15,7 +15,7 @@ import random
 
 import pytest
 
-from dragonboat_tpu.pb import ConfigChange, ConfigChangeType, Membership
+from dragonboat_tpu.pb import Chunk, ConfigChange, ConfigChangeType, Membership
 from dragonboat_tpu.statemachine import Result
 from dragonboat_tpu.transport.wire import (
     WireError,
@@ -128,6 +128,96 @@ class TestHostileBytes:
             )
         with pytest.raises(WireError):
             decoder(good + b"\x00")
+
+
+class TestDecodeBounds:
+    """Regression tests for the wirecheck fuzz findings (PR 20): every
+    decoder fails with the NARROW frame-error type, and the per-codec
+    payload caps are enforced symmetrically (the OBS-reply standard)."""
+
+    def test_invalid_utf8_is_wire_error(self):
+        # _R.s() used to let UnicodeDecodeError escape to the transport
+        blob = bytearray(encode_config_change(
+            ConfigChange(replica_id=1, address="AB")
+        ))
+        i = bytes(blob).index(b"AB")
+        blob[i:i + 2] = b"\xff\xfe"
+        with pytest.raises(WireError):
+            decode_config_change(bytes(blob))
+
+    def test_unknown_enum_byte_is_wire_error(self):
+        # enum conversion used to let ValueError("... not a valid
+        # ConfigChangeType") escape; offset 8 is the type byte
+        blob = bytearray(encode_config_change(ConfigChange(replica_id=1)))
+        blob[8] = 0xEE
+        with pytest.raises(WireError):
+            decode_config_change(bytes(blob))
+
+    def test_chunk_data_cap_both_ways(self, monkeypatch):
+        import dragonboat_tpu.transport.wire as wire_mod
+
+        c = Chunk(shard_id=1, replica_id=2, from_=3, data=b"z" * 100)
+        blob = wire_mod.encode_chunk(c)
+        monkeypatch.setattr(wire_mod, "_CHUNK_MAX_DATA", 64)
+        with pytest.raises(WireError):
+            wire_mod.decode_chunk(blob)
+        with pytest.raises(WireError):
+            wire_mod.encode_chunk(c)
+
+    def test_session_result_cap_both_ways(self, monkeypatch):
+        import dragonboat_tpu.transport.wire as wire_mod
+
+        rows = [(1, 0, {1: Result(value=1, data=b"r" * 100)})]
+        blob = encode_session_table(rows)
+        monkeypatch.setattr(wire_mod, "_SESSION_MAX_RESULT", 64)
+        with pytest.raises(WireError):
+            decode_session_table(blob)
+        with pytest.raises(WireError):
+            encode_session_table(rows)
+
+    def test_rsm_sessions_cap_both_ways(self, monkeypatch):
+        import dragonboat_tpu.transport.wire as wire_mod
+
+        kw = dict(index=1, term=1, membership=Membership(),
+                  sessions=b"s" * 100, sm_data=b"", on_disk=False)
+        blob = encode_rsm_snapshot(**kw)
+        monkeypatch.setattr(wire_mod, "_RSM_MAX_SESSIONS", 64)
+        with pytest.raises(WireError):
+            decode_rsm_snapshot(blob)
+        with pytest.raises(WireError):
+            encode_rsm_snapshot(**kw)
+
+    def test_stats_caps_both_ways(self, monkeypatch):
+        import dragonboat_tpu.transport.wire as wire_mod
+
+        row = {"shard_id": 1, "replica_id": 1, "leader_id": 1, "term": 1,
+               "applied": 1, "proposals": 1, "device": -1,
+               "membership": Membership()}
+        blob = wire_mod.encode_rpc_stats("nh", "a:1", [row] * 3)
+        monkeypatch.setattr(wire_mod, "_STATS_MAX_ROWS", 2)
+        with pytest.raises(WireError):
+            wire_mod.decode_rpc_stats(blob)
+        with pytest.raises(WireError):
+            wire_mod.encode_rpc_stats("nh", "a:1", [row] * 3)
+        monkeypatch.setattr(wire_mod, "_STATS_MAX_ROWS", 1 << 16)
+        paths = {f"p{i}": i for i in range(3)}
+        blob = wire_mod.encode_rpc_stats("nh", "a:1", [], read_paths=paths)
+        monkeypatch.setattr(wire_mod, "_STATS_MAX_READ_PATHS", 2)
+        with pytest.raises(WireError):
+            wire_mod.decode_rpc_stats(blob)
+        with pytest.raises(WireError):
+            wire_mod.encode_rpc_stats("nh", "a:1", [], read_paths=paths)
+
+    def test_kvlogdb_state_record_is_wire_error(self):
+        # _dec_state used to unpack blindly: bare struct.error escaped
+        from dragonboat_tpu.storage.kvlogdb import _dec_state, _enc_state
+        from dragonboat_tpu.pb import State
+
+        st = State(term=3, vote=2, commit=1)
+        assert _dec_state(_enc_state(st)) == st
+        for bad in (b"", b"\x01" * 23, b"\x01" * 25):
+            with pytest.raises(WireError):
+                _dec_state(bad)
 
 
 def test_no_pickle_in_library():
